@@ -1,0 +1,25 @@
+"""sparknet_tpu — a TPU-native distributed deep-learning framework.
+
+Re-implements the capabilities of SparkNet (AMPLab; Spark driver + embedded
+Caffe/CUDA workers over JNA) as an idiomatic JAX/XLA stack:
+
+- Caffe-compatible prototxt front end (``sparknet_tpu.proto``) so the
+  reference model zoo (LeNet, cifar10_quick/full, AlexNet/CaffeNet,
+  GoogLeNet, VGG-16) loads unmodified.
+- A functional graph compiler (``sparknet_tpu.graph``) that lowers
+  ``NetParameter`` graphs to pure ``init``/``apply`` functions compiled by
+  ``jax.jit`` — replacing Caffe's ``Net::Init`` + 107 CUDA kernel files.
+- All six Caffe solvers with all seven LR policies (``sparknet_tpu.solvers``).
+- A host data plane with background prefetch (``sparknet_tpu.data``) and an
+  optional C++ fast path (``sparknet_tpu.native``), replacing the
+  JNA-callback JavaDataLayer feed.
+- Parallel training strategies (``sparknet_tpu.parallel``): synchronous
+  per-step gradient ``psum`` (Caffe P2PSync semantics) and τ-step local SGD
+  with weight averaging (SparkNet semantics), both as single compiled
+  ``shard_map`` programs over a ``jax.sharding.Mesh`` — the driver bottleneck
+  of the reference is gone.
+
+Reference survey: SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"

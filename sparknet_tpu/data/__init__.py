@@ -1,0 +1,8 @@
+from .partition import PartitionedDataset
+from .minibatch import MinibatchSampler, make_minibatches
+from .prefetch import PrefetchIterator, device_feed
+from .transforms import (
+    center_crop, random_crop_mirror, subtract_mean, compute_mean_image,
+)
+from .cifar import load_cifar10_binary, write_cifar10_binary, CIFAR_SHAPE
+from .mnist import load_mnist_idx, write_mnist_idx

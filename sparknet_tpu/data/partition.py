@@ -1,0 +1,68 @@
+"""PartitionedDataset — the local stand-in for Spark's RDD tier.
+
+The reference loads, decodes, and shards data as Spark RDDs
+(reference: src/main/scala/loaders/ImageNetLoader.scala:91 →
+RDD[(Array[Byte], Int)]; coalesce + per-partition sizes at
+src/main/scala/apps/ImageNetApp.scala:89-95).  The north star keeps Spark as
+the multi-host data tier; this class provides the same partition semantics
+for single-host runs and tests (SURVEY.md §7.1 "local sharded loader for
+dev"), and its partition-indexed API is exactly what a Spark/pjit bridge
+feeds per TPU-VM worker.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+
+class PartitionedDataset:
+    """An ordered list of partitions, each a list of records."""
+
+    def __init__(self, partitions: Sequence[list[Any]]):
+        self.partitions = [list(p) for p in partitions]
+
+    @classmethod
+    def from_items(cls, items: Iterable[Any], num_partitions: int,
+                   shuffle: bool = False, seed: int = 0) -> "PartitionedDataset":
+        """Round-robin shard (the parallelize + coalesce analog)."""
+        items = list(items)
+        if shuffle:
+            random.Random(seed).shuffle(items)
+        parts: list[list[Any]] = [[] for _ in range(num_partitions)]
+        for i, item in enumerate(items):
+            parts[i % num_partitions].append(item)
+        return cls(parts)
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    def partition_sizes(self) -> list[int]:
+        """Per-partition element counts (the zipPartitions sizes RDD,
+        reference: ImageNetApp.scala:94-95)."""
+        return [len(p) for p in self.partitions]
+
+    def count(self) -> int:
+        return sum(len(p) for p in self.partitions)
+
+    def map(self, fn: Callable[[Any], Any]) -> "PartitionedDataset":
+        return PartitionedDataset([[fn(x) for x in p] for p in self.partitions])
+
+    def map_partitions(self, fn: Callable[[list[Any]], list[Any]]
+                       ) -> "PartitionedDataset":
+        return PartitionedDataset([fn(list(p)) for p in self.partitions])
+
+    def coalesce(self, n: int) -> "PartitionedDataset":
+        flat = [x for p in self.partitions for x in p]
+        return PartitionedDataset.from_items(flat, n)
+
+    def iterator(self, partition: int) -> Iterator[Any]:
+        return iter(self.partitions[partition])
+
+    def reduce(self, fn: Callable[[Any, Any], Any]) -> Any:
+        acc = None
+        for p in self.partitions:
+            for x in p:
+                acc = x if acc is None else fn(acc, x)
+        return acc

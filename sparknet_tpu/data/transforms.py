@@ -1,0 +1,76 @@
+"""Host-side image preprocessing.
+
+The numpy equivalents of the reference's preprocessing tier: mean-image
+computation (reference: src/main/scala/preprocessing/ComputeMean.scala:8-44),
+random-crop + mean-subtract train preprocessing and center-crop test
+preprocessing closures (reference: src/main/scala/apps/ImageNetApp.scala:
+155-169 and :117-131), the crop-into-float-buffer hot path
+(reference: src/main/java/libs/ByteImage.java:77-95 cropInto), and Caffe's
+DataTransformer crop/mirror/scale semantics (reference:
+caffe/src/caffe/data_transformer.cpp).
+
+These run vectorized over whole minibatches (the reference loops per image
+per pixel through JNA — its measured hot spot, CallbackBenchmarkSpec).  An
+optional C++ fast path lives in sparknet_tpu.native.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_mean_image(images: np.ndarray) -> np.ndarray:
+    """Mean image over the dataset (ComputeMean.apply analog — the
+    distributed pixel-sum reduce collapses to one vectorized mean here;
+    per-partition sums for the Spark tier are just np.sum per partition)."""
+    return images.astype(np.float64).mean(axis=0).astype(np.float32)
+
+
+def subtract_mean(batch: np.ndarray, mean: np.ndarray | float) -> np.ndarray:
+    return batch.astype(np.float32) - mean
+
+
+def random_crop_mirror(batch: np.ndarray, crop: int,
+                       rng: np.random.Generator,
+                       mirror: bool = True,
+                       mean: np.ndarray | float | None = None) -> np.ndarray:
+    """Random crop to (crop, crop) + horizontal mirror, vectorized
+    (DataTransformer train path; ImageNetApp train preprocessing closure)."""
+    n, c, h, w = batch.shape
+    out = np.empty((n, c, crop, crop), np.float32)
+    ys = rng.integers(0, h - crop + 1, size=n)
+    xs = rng.integers(0, w - crop + 1, size=n)
+    flips = rng.integers(0, 2, size=n).astype(bool) if mirror else np.zeros(n, bool)
+    for i in range(n):
+        img = batch[i, :, ys[i]:ys[i] + crop, xs[i]:xs[i] + crop]
+        out[i] = img[:, :, ::-1] if flips[i] else img
+    if mean is not None:
+        if isinstance(mean, np.ndarray) and mean.shape[-1] != crop:
+            mean = center_crop_mean(mean, crop)
+        out -= mean
+    return out
+
+
+def center_crop(batch: np.ndarray, crop: int,
+                mean: np.ndarray | float | None = None) -> np.ndarray:
+    """Deterministic center crop (test path; ImageNetApp.scala:117-131)."""
+    _, _, h, w = batch.shape
+    y = (h - crop) // 2
+    x = (w - crop) // 2
+    out = batch[:, :, y:y + crop, x:x + crop].astype(np.float32)
+    if mean is not None:
+        if isinstance(mean, np.ndarray) and mean.shape[-1] != crop:
+            mean = center_crop_mean(mean, crop)
+        out = out - mean
+    return out
+
+
+def center_crop_mean(mean: np.ndarray, crop: int) -> np.ndarray:
+    h, w = mean.shape[-2], mean.shape[-1]
+    y, x = (h - crop) // 2, (w - crop) // 2
+    return mean[..., y:y + crop, x:x + crop]
+
+
+def scale(batch: np.ndarray, factor: float) -> np.ndarray:
+    """DataTransformer `scale` (e.g. 1/255 for LeNet/MNIST)."""
+    return batch.astype(np.float32) * factor

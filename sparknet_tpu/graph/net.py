@@ -1,0 +1,249 @@
+"""Graph compiler: NetParameter -> pure init/apply functions.
+
+The TPU-native replacement for Caffe's ``Net`` (reference:
+caffe/src/caffe/net.cpp:40 ``Init`` — phase filtering, topological wiring via
+AppendTop/AppendBottom at net.cpp:385/444, per-layer SetUp with shape
+inference) and its executor (``ForwardFromTo``/``BackwardFromTo``,
+net.cpp:565/635).  Differences by design:
+
+- The graph lowers to one pure function; ``jax.jit`` compiles forward, and
+  backward is ``jax.grad`` of it — there are no per-layer Backward
+  implementations and no topological scheduler to maintain.
+- ``InsertSplits`` (reference: caffe/src/caffe/util/insert_splits.cpp:12) is
+  unnecessary: fan-out in a functional graph is just reusing a value; XLA
+  accumulates the cotangents.
+- Blob memory management (``SyncedMemory`` CPU/GPU state machine, reference:
+  caffe/src/caffe/syncedmem.hpp:62) is XLA's problem, not ours.
+
+Parameter storage is a flat ``{key: [blobs...]}`` dict keyed by layer name,
+with cross-layer sharing via ``ParamSpec.name`` (reference: net.cpp
+AppendParam sharing semantics) resolved to owner keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import LayerImpl, Shape, get_layer_impl
+from ..proto.caffe_pb import (
+    LayerParameter,
+    NetParameter,
+    NetState,
+    Phase,
+)
+
+# WeightCollection — the {layer name -> list of arrays} container the driver
+# averages (reference: src/main/scala/libs/Net.scala:14-47).  Here it is just
+# a pytree alias; elementwise add / scalarDivide are jax.tree_util one-liners.
+WeightCollection = dict[str, list[jax.Array]]
+
+
+@dataclasses.dataclass
+class NetOutputs:
+    """Result of one forward pass."""
+
+    blobs: dict[str, jax.Array]      # net-output blobs (unconsumed tops)
+    loss: jax.Array                  # Σ loss_weight · top
+    params: WeightCollection         # params incl. forward-state updates (BN)
+
+
+@dataclasses.dataclass
+class _LayerNode:
+    lp: LayerParameter
+    impl: LayerImpl
+    bottoms: list[str]
+    tops: list[str]
+    param_key: str            # owner layer name holding this layer's blobs
+    lr_mults: list[float]
+    decay_mults: list[float]
+
+
+class Net:
+    """A phase-filtered, shape-inferred, executable network."""
+
+    def __init__(self, net_param: NetParameter, state: NetState | None = None,
+                 *, compute_dtype=None):
+        if state is None:
+            state = net_param.state or NetState()
+        self.state = state
+        self.param = net_param.filtered(state)
+        self.name = net_param.name
+        self.compute_dtype = compute_dtype
+        self.nodes: list[_LayerNode] = []
+        self.blob_shapes: dict[str, Shape] = {}
+        self.input_blobs: dict[str, Shape] = {}
+
+        # net-level input declarations (legacy `input:` + `input_shape:`)
+        for i, name in enumerate(self.param.input):
+            shape = tuple(self.param.input_shape[i].dim)
+            self.blob_shapes[name] = shape
+            self.input_blobs[name] = shape
+
+        shared_owner: dict[str, tuple[str, int]] = {}  # ParamSpec.name -> (layer, idx)
+        consumed: set[str] = set()
+
+        for lp in self.param.layer:
+            impl = get_layer_impl(lp.type)
+            tops = list(lp.top)
+            bottoms = list(lp.bottom)
+            for b in bottoms:
+                if b not in self.blob_shapes:
+                    raise ValueError(
+                        f"layer {lp.name!r} bottom {b!r} unknown "
+                        f"(known: {sorted(self.blob_shapes)})")
+                consumed.add(b)
+            bshapes = [self.blob_shapes[b] for b in bottoms]
+            oshapes = impl.out_shapes(lp, bshapes)
+            if not tops:
+                tops = [lp.name] if oshapes else []
+            while len(tops) < len(oshapes):
+                tops.append(f"{lp.name}_top{len(tops)}")
+            for t, s in zip(tops, oshapes):
+                self.blob_shapes[t] = tuple(int(d) for d in s)
+            if getattr(impl, "is_input", lambda: False)():
+                for t, s in zip(tops, oshapes):
+                    self.input_blobs[t] = tuple(int(d) for d in s)
+
+            # param sharing resolution
+            param_key = lp.name
+            specs = lp.param
+            lr_mults = [ps.lr_mult for ps in specs]
+            decay_mults = [ps.decay_mult for ps in specs]
+            if specs and specs[0].name:
+                owner = shared_owner.get(specs[0].name)
+                if owner is None:
+                    shared_owner[specs[0].name] = (lp.name, 0)
+                else:
+                    param_key = owner[0]
+            if lp.type == "BatchNorm":
+                lr_mults = [0.0, 0.0, 0.0]
+                decay_mults = [0.0, 0.0, 0.0]
+            self.nodes.append(_LayerNode(
+                lp=lp, impl=impl, bottoms=bottoms, tops=tops,
+                param_key=param_key, lr_mults=lr_mults, decay_mults=decay_mults,
+            ))
+
+        produced = [t for n in self.nodes for t in n.tops]
+        self.output_blobs = [t for t in dict.fromkeys(produced)
+                             if t not in consumed and t not in self.input_blobs]
+
+    # -- construction -----------------------------------------------------
+    def init(self, rng: jax.Array) -> WeightCollection:
+        """Create all learnable blobs with Caffe-filler init (the SetUp pass
+        of reference net.cpp:73-133)."""
+        params: WeightCollection = {}
+        for node in self.nodes:
+            if node.param_key != node.lp.name:
+                continue  # shared; owner creates
+            rng, sub = jax.random.split(rng)
+            bshapes = [self.blob_shapes[b] for b in node.bottoms]
+            blobs = node.impl.init(sub, node.lp, bshapes)
+            if blobs:
+                params[node.lp.name] = list(blobs)
+        return params
+
+    def lr_mult_tree(self, params: WeightCollection) -> WeightCollection:
+        """Per-blob lr multipliers, same pytree structure as params
+        (ParamSpec.lr_mult, reference: caffe.proto ParamSpec)."""
+        return self._mult_tree(params, "lr_mults", 1.0)
+
+    def decay_mult_tree(self, params: WeightCollection) -> WeightCollection:
+        return self._mult_tree(params, "decay_mults", 1.0)
+
+    def _mult_tree(self, params, attr, default):
+        out: WeightCollection = {}
+        by_name = {n.lp.name: n for n in self.nodes}
+        for key, blobs in params.items():
+            mults = getattr(by_name[key], attr, []) if key in by_name else []
+            out[key] = [
+                jnp.asarray(mults[i] if i < len(mults) else default)
+                for i in range(len(blobs))
+            ]
+        return out
+
+    # -- execution --------------------------------------------------------
+    def apply(self, params: WeightCollection, inputs: Mapping[str, jax.Array],
+              *, train: bool | None = None, rng: jax.Array | None = None,
+              ) -> NetOutputs:
+        """One forward pass.  ``inputs`` binds every input blob (data-layer
+        top).  Returns net outputs, the weighted loss sum, and params with
+        any forward-state updates (BatchNorm running stats) applied."""
+        blobs, loss, new_params = self._run(params, inputs, train, rng)
+        out = {t: blobs[t] for t in self.output_blobs}
+        return NetOutputs(blobs=out, loss=loss, params=new_params)
+
+    def apply_all(self, params, inputs, *, train=None, rng=None
+                  ) -> dict[str, jax.Array]:
+        """Forward returning every intermediate blob (debug; the analog of
+        reading arbitrary blobs over the reference's FFI introspection,
+        libccaffe/ccaffe.cpp:86-139)."""
+        blobs, _, _ = self._run(params, inputs, train, rng)
+        return blobs
+
+    def _run(self, params, inputs, train, rng):
+        """The layer-by-layer forward shared by apply/apply_all."""
+        if train is None:
+            train = self.state.phase == Phase.TRAIN
+        if rng is None and any(n.impl.needs_rng(n.lp, train) for n in self.nodes):
+            raise ValueError(
+                f"net {self.name!r} needs an rng in this mode "
+                f"(stochastic layer present)")
+        for name in self.input_blobs:
+            if name not in inputs:
+                raise ValueError(f"missing input blob {name!r}")
+        blobs: dict[str, jax.Array] = dict(inputs)
+        new_params = dict(params)
+        loss = jnp.zeros((), jnp.float32)
+        for node in self.nodes:
+            if getattr(node.impl, "is_input", lambda: False)():
+                continue
+            layer_rng = None
+            if rng is not None and node.impl.needs_rng(node.lp, train):
+                rng, layer_rng = jax.random.split(rng)
+            p = new_params.get(node.param_key, [])
+            bots = [blobs[b] for b in node.bottoms]
+            result = node.impl.apply(node.lp, p, bots, train, layer_rng)
+            if getattr(node.impl, "has_state", False):
+                tops, updated = result
+                new_params[node.param_key] = list(updated)
+            else:
+                tops = result
+            for t, v in zip(node.tops, tops):
+                blobs[t] = v
+            # loss accumulation (reference: Layer::SetLossWeights +
+            # Net::Forward summing weighted tops)
+            weights = list(node.lp.loss_weight)
+            if not weights and node.impl.is_loss():
+                weights = [1.0] + [0.0] * (len(node.tops) - 1)
+            for w, v in zip(weights, tops):
+                if w:
+                    loss = loss + w * jnp.sum(v)
+        return blobs, loss, new_params
+
+    # -- introspection (FFI-parity helpers; reference: ccaffe.cpp:86-139,
+    #    Net.scala:64-66) --------------------------------------------------
+    @property
+    def num_layers(self) -> int:
+        return len(self.nodes)
+
+    def layer_names(self) -> list[str]:
+        return [n.lp.name for n in self.nodes]
+
+    def layer_num_weights(self, params: WeightCollection) -> dict[str, int]:
+        return {k: len(v) for k, v in params.items()}
+
+
+# -- WeightCollection math (reference: Net.scala:17-46) ---------------------
+
+def weights_add(a: WeightCollection, b: WeightCollection) -> WeightCollection:
+    """Elementwise sum — WeightCollection.add (reference: Net.scala:27-46)."""
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def weights_scalar_divide(w: WeightCollection, v: float) -> WeightCollection:
+    """In the reference this is in-place (Net.scala:17-23); pure here."""
+    return jax.tree_util.tree_map(lambda x: x / v, w)

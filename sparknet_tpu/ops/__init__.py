@@ -1,0 +1,2 @@
+from .registry import LayerImpl, register_layer, get_layer_impl, registered_types
+from . import data, vision, neuron, common, loss  # noqa: F401  (register ops)

@@ -1,0 +1,577 @@
+"""Common layers: InnerProduct, BatchNorm, Scale, Bias, MVN, Embed, shape
+ops (Flatten/Reshape/Concat/Slice/Split/Tile), Eltwise, Reduction, Filter,
+BatchReindex, ArgMax, Softmax, Accuracy, Silence.
+
+Reference implementations: caffe/src/caffe/layers/*.cpp grouped under
+caffe/include/caffe/common_layers.hpp.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..proto.caffe_pb import FillerParameter, LayerParameter
+from .fillers import fill
+from .registry import LayerImpl, Shape, register_layer
+
+
+def _canon_axis(axis: int, ndim: int) -> int:
+    return axis + ndim if axis < 0 else axis
+
+
+@register_layer("InnerProduct")
+class InnerProductLayer(LayerImpl):
+    """Fully-connected layer (reference:
+    caffe/src/caffe/layers/inner_product_layer.cpp): flattens from `axis`,
+    weight (num_output, dim) — or (dim, num_output) with transpose — plus
+    optional bias.  Lowers to a single MXU GEMM."""
+
+    def _geom(self, lp: LayerParameter, bottom_shape: Shape):
+        p = lp.sub("inner_product_param")
+        num_output = int(p.get("num_output", 0))
+        axis = _canon_axis(int(p.get("axis", 1)), len(bottom_shape))
+        transpose = bool(p.get("transpose", False))
+        bias_term = bool(p.get("bias_term", True))
+        dim = math.prod(bottom_shape[axis:])
+        return num_output, axis, dim, transpose, bias_term
+
+    def out_shapes(self, lp, bottom_shapes):
+        num_output, axis, _, _, _ = self._geom(lp, bottom_shapes[0])
+        return [tuple(bottom_shapes[0][:axis]) + (num_output,)]
+
+    def init(self, rng, lp, bottom_shapes):
+        num_output, _, dim, transpose, bias_term = self._geom(lp, bottom_shapes[0])
+        p = lp.sub("inner_product_param")
+        wf = FillerParameter.from_pmsg(p.get("weight_filler"))
+        r1, r2 = jax.random.split(rng)
+        wshape = (dim, num_output) if transpose else (num_output, dim)
+        blobs = [fill(r1, wf, wshape)]
+        if bias_term:
+            bf = FillerParameter.from_pmsg(p.get("bias_filler"))
+            blobs.append(fill(r2, bf, (num_output,)))
+        return blobs
+
+    def apply(self, lp, params, bottoms, train, rng):
+        num_output, axis, dim, transpose, bias_term = self._geom(lp, bottoms[0].shape)
+        x = bottoms[0].reshape(bottoms[0].shape[:axis] + (dim,))
+        w = params[0]
+        y = x @ w if transpose else x @ w.T
+        if bias_term:
+            y = y + params[1]
+        return [y]
+
+
+@register_layer("BatchNorm")
+class BatchNormLayer(LayerImpl):
+    """Caffe BatchNorm (reference: caffe/src/caffe/layers/batch_norm_layer.cpp):
+    three non-learnable blobs — running mean (C,), running variance (C,),
+    scale factor (1,) — updated during training forward with
+    moving_average_fraction; affine transform is a separate Scale layer.
+    `use_global_stats` defaults to the phase (test → true)."""
+
+    has_state = True
+
+    def init(self, rng, lp, bottom_shapes):
+        c = bottom_shapes[0][1]
+        return [jnp.zeros((c,)), jnp.zeros((c,)), jnp.zeros((1,))]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("batch_norm_param")
+        use_global = bool(p.get("use_global_stats", not train))
+        maf = float(p.get("moving_average_fraction", 0.999))
+        eps = float(p.get("eps", 1e-5))
+        x = bottoms[0]
+        mean_b, var_b, scale_b = params
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        if use_global:
+            factor = jnp.where(scale_b[0] == 0, 0.0, 1.0 / jnp.where(scale_b[0] == 0, 1.0, scale_b[0]))
+            mean = mean_b * factor
+            var = var_b * factor
+            y = (x - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + eps)
+            return [y], list(params)
+        axes = (0,) + tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean((x - mean.reshape(bshape)) ** 2, axis=axes)
+        y = (x - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + eps)
+        # caffe applies an unbiased correction m/(m-1) to the stored variance
+        m = x.size // x.shape[1]
+        bias_corr = m / max(m - 1, 1)
+        new_params = [
+            mean_b * maf + jax.lax.stop_gradient(mean),
+            var_b * maf + bias_corr * jax.lax.stop_gradient(var),
+            scale_b * maf + 1.0,
+        ]
+        return [y], new_params
+
+
+def _scale_shape(lp: LayerParameter, key: str, bottom_shape: Shape) -> tuple[int, Shape]:
+    p = lp.sub(key)
+    axis = _canon_axis(int(p.get("axis", 1)), len(bottom_shape))
+    num_axes = int(p.get("num_axes", 1))
+    if num_axes == -1:
+        shape = tuple(bottom_shape[axis:])
+    else:
+        shape = tuple(bottom_shape[axis:axis + num_axes])
+    return axis, shape
+
+
+def _broadcastable(v: jax.Array, axis: int, x: jax.Array) -> jax.Array:
+    shape = [1] * x.ndim
+    for i, d in enumerate(v.shape):
+        shape[axis + i] = d
+    return v.reshape(shape)
+
+
+@register_layer("Scale")
+class ScaleLayer(LayerImpl):
+    """y = x · γ (+ β), γ broadcast from `axis` (reference:
+    caffe/src/caffe/layers/scale_layer.cpp).  Two-bottom form multiplies by
+    the second bottom instead of a learned blob."""
+
+    def init(self, rng, lp, bottom_shapes):
+        if len(lp.bottom) > 1:
+            blobs = []
+            shape = tuple(bottom_shapes[1])
+        else:
+            _, shape = _scale_shape(lp, "scale_param", bottom_shapes[0])
+            p = lp.sub("scale_param")
+            f = FillerParameter.from_pmsg(p.get("filler"))
+            if not p.has("filler"):
+                f = FillerParameter(type="constant", value=1.0)
+            blobs = [fill(rng, f, shape)]
+        if bool(lp.sub("scale_param").get("bias_term", False)):
+            bf = FillerParameter.from_pmsg(lp.sub("scale_param").get("bias_filler"))
+            blobs.append(fill(jax.random.fold_in(rng, 1), bf, shape))
+        return blobs
+
+    def apply(self, lp, params, bottoms, train, rng):
+        x = bottoms[0]
+        axis, _ = _scale_shape(lp, "scale_param", x.shape)
+        bias_term = bool(lp.sub("scale_param").get("bias_term", False))
+        if len(bottoms) > 1:
+            gamma = bottoms[1]
+            beta = params[0] if bias_term and params else None
+        else:
+            gamma = params[0]
+            beta = params[1] if bias_term and len(params) > 1 else None
+        y = x * _broadcastable(gamma, axis, x)
+        if beta is not None:
+            y = y + _broadcastable(beta, axis, x)
+        return [y]
+
+
+@register_layer("Bias")
+class BiasLayer(LayerImpl):
+    """y = x + β, β broadcast from `axis` (reference:
+    caffe/src/caffe/layers/bias_layer.cpp)."""
+
+    def init(self, rng, lp, bottom_shapes):
+        if len(lp.bottom) > 1:
+            return []
+        _, shape = _scale_shape(lp, "bias_param", bottom_shapes[0])
+        f = FillerParameter.from_pmsg(lp.sub("bias_param").get("filler"))
+        return [fill(rng, f, shape)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        x = bottoms[0]
+        axis, _ = _scale_shape(lp, "bias_param", x.shape)
+        beta = bottoms[1] if len(bottoms) > 1 else params[0]
+        return [x + _broadcastable(beta, axis, x)]
+
+
+@register_layer("MVN")
+class MVNLayer(LayerImpl):
+    """Mean-variance normalization per sample (reference:
+    caffe/src/caffe/layers/mvn_layer.cpp); across_channels widens the
+    normalization axes to include C."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("mvn_param")
+        across = bool(p.get("across_channels", False))
+        normalize_variance = bool(p.get("normalize_variance", True))
+        eps = float(p.get("eps", 1e-9))
+        x = bottoms[0]
+        axes = tuple(range(1, x.ndim)) if across else tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        y = x - mean
+        if normalize_variance:
+            std = jnp.sqrt(jnp.mean(y * y, axis=axes, keepdims=True))
+            y = y / (std + eps)
+        return [y]
+
+
+@register_layer("Embed")
+class EmbedLayer(LayerImpl):
+    """Index lookup into a (input_dim, num_output) table (reference:
+    caffe/src/caffe/layers/embed_layer.cpp); equivalent to InnerProduct on
+    one-hot input."""
+
+    def _geom(self, lp):
+        p = lp.sub("embed_param")
+        return (int(p.get("num_output", 0)), int(p.get("input_dim", 0)),
+                bool(p.get("bias_term", True)))
+
+    def out_shapes(self, lp, bottom_shapes):
+        num_output, _, _ = self._geom(lp)
+        return [tuple(bottom_shapes[0]) + (num_output,)]
+
+    def init(self, rng, lp, bottom_shapes):
+        num_output, input_dim, bias_term = self._geom(lp)
+        p = lp.sub("embed_param")
+        r1, r2 = jax.random.split(rng)
+        blobs = [fill(r1, FillerParameter.from_pmsg(p.get("weight_filler")),
+                      (input_dim, num_output))]
+        if bias_term:
+            blobs.append(fill(r2, FillerParameter.from_pmsg(p.get("bias_filler")),
+                              (num_output,)))
+        return blobs
+
+    def apply(self, lp, params, bottoms, train, rng):
+        _, _, bias_term = self._geom(lp)
+        idx = bottoms[0].astype(jnp.int32)
+        y = params[0][idx]
+        if bias_term:
+            y = y + params[1]
+        return [y]
+
+
+@register_layer("Flatten")
+class FlattenLayer(LayerImpl):
+    """Flatten axes [axis, end_axis] (reference: flatten_layer.cpp)."""
+
+    def _axes(self, lp, ndim):
+        p = lp.sub("flatten_param")
+        axis = _canon_axis(int(p.get("axis", 1)), ndim)
+        end = _canon_axis(int(p.get("end_axis", -1)), ndim)
+        return axis, end
+
+    def out_shapes(self, lp, bottom_shapes):
+        s = bottom_shapes[0]
+        axis, end = self._axes(lp, len(s))
+        mid = math.prod(s[axis:end + 1])
+        return [tuple(s[:axis]) + (mid,) + tuple(s[end + 1:])]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        return [bottoms[0].reshape(self.out_shapes(lp, [bottoms[0].shape])[0])]
+
+
+@register_layer("Reshape")
+class ReshapeLayer(LayerImpl):
+    """Reshape with 0 (copy dim) and -1 (infer) entries (reference:
+    reshape_layer.cpp), over the [axis, axis+num_axes) window."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        s = list(bottom_shapes[0])
+        p = lp.sub("reshape_param")
+        spec = [int(d) for d in p.get("shape").get_all("dim")] if p.get("shape") else []
+        axis = _canon_axis(int(p.get("axis", 0)), len(s))
+        num_axes = int(p.get("num_axes", -1))
+        window = s[axis:] if num_axes == -1 else s[axis:axis + num_axes]
+        out_window: list[int] = []
+        infer = -1
+        for i, d in enumerate(spec):
+            if d == 0:
+                out_window.append(window[i])
+            elif d == -1:
+                infer = i
+                out_window.append(1)
+            else:
+                out_window.append(d)
+        total = math.prod(window)
+        if infer >= 0:
+            known = math.prod(out_window)
+            out_window[infer] = total // known
+        head = s[:axis]
+        tail = [] if num_axes == -1 else s[axis + num_axes:]
+        return [tuple(head) + tuple(out_window) + tuple(tail)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        return [bottoms[0].reshape(self.out_shapes(lp, [bottoms[0].shape])[0])]
+
+
+@register_layer("Concat")
+class ConcatLayer(LayerImpl):
+    """Concatenate along `axis` (default 1; legacy concat_dim) —
+    concat_layer.cpp."""
+
+    def _axis(self, lp, ndim):
+        p = lp.sub("concat_param")
+        if p.has("concat_dim"):
+            return int(p.get("concat_dim"))
+        return _canon_axis(int(p.get("axis", 1)), ndim)
+
+    def out_shapes(self, lp, bottom_shapes):
+        axis = self._axis(lp, len(bottom_shapes[0]))
+        s = list(bottom_shapes[0])
+        s[axis] = sum(bs[axis] for bs in bottom_shapes)
+        return [tuple(s)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        return [jnp.concatenate(bottoms, axis=self._axis(lp, bottoms[0].ndim))]
+
+
+@register_layer("Slice")
+class SliceLayer(LayerImpl):
+    """Split along `axis` at slice_point (or evenly) — slice_layer.cpp."""
+
+    def _geom(self, lp, shape, ntop):
+        p = lp.sub("slice_param")
+        if p.has("slice_dim"):
+            axis = int(p.get("slice_dim"))
+        else:
+            axis = _canon_axis(int(p.get("axis", 1)), len(shape))
+        points = [int(x) for x in p.get_all("slice_point")]
+        if not points:
+            if shape[axis] % ntop:
+                raise ValueError(
+                    f"layer {lp.name!r}: axis dim {shape[axis]} not divisible "
+                    f"into {ntop} equal slices (give slice_point)")
+            step = shape[axis] // ntop
+            points = [step * i for i in range(1, ntop)]
+        bounds = [0] + points + [shape[axis]]
+        return axis, bounds
+
+    def out_shapes(self, lp, bottom_shapes):
+        ntop = max(len(lp.top), 1)
+        axis, bounds = self._geom(lp, bottom_shapes[0], ntop)
+        outs = []
+        for i in range(len(bounds) - 1):
+            s = list(bottom_shapes[0])
+            s[axis] = bounds[i + 1] - bounds[i]
+            outs.append(tuple(s))
+        return outs
+
+    def apply(self, lp, params, bottoms, train, rng):
+        ntop = max(len(lp.top), 1)
+        x = bottoms[0]
+        axis, bounds = self._geom(lp, x.shape, ntop)
+        idx = [slice(None)] * x.ndim
+        outs = []
+        for i in range(len(bounds) - 1):
+            idx[axis] = slice(bounds[i], bounds[i + 1])
+            outs.append(x[tuple(idx)])
+        return outs
+
+
+@register_layer("Split")
+class SplitLayer(LayerImpl):
+    """Fan-out copy: one bottom to N tops (split_layer.cpp).  The reference
+    inserts these automatically (util/insert_splits.cpp); JAX's functional
+    graphs make the automatic insertion unnecessary, but the explicit layer
+    type is still supported."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        return [tuple(bottom_shapes[0])] * max(len(lp.top), 1)
+
+    def apply(self, lp, params, bottoms, train, rng):
+        return [bottoms[0]] * max(len(lp.top), 1)
+
+
+@register_layer("Eltwise")
+class EltwiseLayer(LayerImpl):
+    """PROD / SUM (with coeffs) / MAX over equal-shaped bottoms
+    (eltwise_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("eltwise_param")
+        op = str(p.get("operation", "SUM"))
+        if op == "PROD":
+            y = bottoms[0]
+            for b in bottoms[1:]:
+                y = y * b
+        elif op == "SUM":
+            coeffs = [float(c) for c in p.get_all("coeff")] or [1.0] * len(bottoms)
+            if len(coeffs) != len(bottoms):
+                raise ValueError(
+                    f"layer {lp.name!r}: eltwise coeff count {len(coeffs)} "
+                    f"!= bottom count {len(bottoms)}")
+            y = coeffs[0] * bottoms[0]
+            for c, b in zip(coeffs[1:], bottoms[1:]):
+                y = y + c * b
+        elif op == "MAX":
+            y = bottoms[0]
+            for b in bottoms[1:]:
+                y = jnp.maximum(y, b)
+        else:
+            raise ValueError(f"unknown eltwise op {op!r}")
+        return [y]
+
+
+@register_layer("Reduction")
+class ReductionLayer(LayerImpl):
+    """Reduce trailing axes from `axis` with SUM/ASUM/SUMSQ/MEAN × coeff
+    (reduction_layer.cpp)."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        p = lp.sub("reduction_param")
+        axis = _canon_axis(int(p.get("axis", 0)), len(bottom_shapes[0]))
+        return [tuple(bottom_shapes[0][:axis])]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("reduction_param")
+        op = str(p.get("operation", "SUM"))
+        axis = _canon_axis(int(p.get("axis", 0)), bottoms[0].ndim)
+        coeff = float(p.get("coeff", 1.0))
+        x = bottoms[0]
+        axes = tuple(range(axis, x.ndim))
+        if op == "SUM":
+            y = jnp.sum(x, axis=axes)
+        elif op == "ASUM":
+            y = jnp.sum(jnp.abs(x), axis=axes)
+        elif op == "SUMSQ":
+            y = jnp.sum(x * x, axis=axes)
+        elif op == "MEAN":
+            y = jnp.mean(x, axis=axes)
+        else:
+            raise ValueError(f"unknown reduction op {op!r}")
+        return [coeff * y]
+
+
+@register_layer("Tile")
+class TileLayer(LayerImpl):
+    """Repeat along `axis` `tiles` times (tile_layer.cpp)."""
+
+    def _geom(self, lp, ndim):
+        p = lp.sub("tile_param")
+        return _canon_axis(int(p.get("axis", 1)), ndim), int(p.get("tiles", 1))
+
+    def out_shapes(self, lp, bottom_shapes):
+        axis, tiles = self._geom(lp, len(bottom_shapes[0]))
+        s = list(bottom_shapes[0])
+        s[axis] *= tiles
+        return [tuple(s)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        axis, tiles = self._geom(lp, bottoms[0].ndim)
+        reps = [1] * bottoms[0].ndim
+        reps[axis] = tiles
+        return [jnp.tile(bottoms[0], reps)]
+
+
+@register_layer("BatchReindex")
+class BatchReindexLayer(LayerImpl):
+    """Gather batch items by an index bottom (batch_reindex_layer.cpp)."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        return [tuple(bottom_shapes[1][:1]) + tuple(bottom_shapes[0][1:])]
+
+    def min_bottoms(self) -> int:
+        return 2
+
+    def apply(self, lp, params, bottoms, train, rng):
+        return [bottoms[0][bottoms[1].astype(jnp.int32)]]
+
+
+@register_layer("Filter")
+class FilterLayer(LayerImpl):
+    """Select batch items where the last bottom (selector) is nonzero
+    (filter_layer.cpp).  The output batch size is data-dependent, which XLA
+    cannot compile; this layer therefore only works outside `jit` (eager),
+    matching its rarity — no zoo model uses it."""
+
+    def min_bottoms(self) -> int:
+        return 2
+
+    def out_shapes(self, lp, bottom_shapes):
+        # batch dim unknown until runtime; report input shape
+        return [tuple(s) for s in bottom_shapes[:-1]]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        sel = bottoms[-1].reshape(-1)
+        idx = jnp.nonzero(sel)[0]  # errors under jit by design
+        return [b[idx] for b in bottoms[:-1]]
+
+
+@register_layer("ArgMax")
+class ArgMaxLayer(LayerImpl):
+    """Top-k indices (and optionally values) (argmax_layer.cpp)."""
+
+    def _geom(self, lp):
+        p = lp.sub("argmax_param")
+        return (bool(p.get("out_max_val", False)), int(p.get("top_k", 1)),
+                p.get("axis"))
+
+    def out_shapes(self, lp, bottom_shapes):
+        out_max_val, top_k, axis = self._geom(lp)
+        s = bottom_shapes[0]
+        if axis is not None:
+            axis = _canon_axis(int(axis), len(s))
+            out = list(s)
+            out[axis] = top_k
+            return [tuple(out)]
+        return [(s[0], 2 if out_max_val else 1, top_k)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        out_max_val, top_k, axis = self._geom(lp)
+        x = bottoms[0]
+        if axis is not None:
+            axis = _canon_axis(int(axis), x.ndim)
+            xt = jnp.moveaxis(x, axis, -1)
+            vals, idxs = jax.lax.top_k(xt, top_k)
+            pick = vals if out_max_val else idxs.astype(x.dtype)
+            return [jnp.moveaxis(pick, -1, axis)]
+        flat = x.reshape(x.shape[0], -1)
+        vals, idxs = jax.lax.top_k(flat, top_k)
+        idxs = idxs.astype(x.dtype)
+        if out_max_val:
+            return [jnp.stack([idxs, vals], axis=1)]
+        return [idxs[:, None, :]]
+
+
+@register_layer("Softmax")
+class SoftmaxLayer(LayerImpl):
+    """Numerically-stable softmax along `axis` (softmax_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        axis = _canon_axis(int(lp.sub("softmax_param").get("axis", 1)),
+                           bottoms[0].ndim)
+        return [jax.nn.softmax(bottoms[0], axis=axis)]
+
+
+@register_layer("Accuracy")
+class AccuracyLayer(LayerImpl):
+    """Top-k classification accuracy with optional ignore_label (reference:
+    caffe/src/caffe/layers/accuracy_layer.cpp).  bottom[0] scores
+    (N, C, spatial...), bottom[1] integer labels."""
+
+    def min_bottoms(self) -> int:
+        return 2
+
+    def out_shapes(self, lp, bottom_shapes):
+        return [()]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("accuracy_param")
+        top_k = int(p.get("top_k", 1))
+        axis = _canon_axis(int(p.get("axis", 1)), bottoms[0].ndim)
+        ignore = p.get("ignore_label")
+        scores, labels = bottoms[0], bottoms[1]
+        labels = labels.reshape(labels.shape[0], -1) if labels.ndim > 1 else labels[:, None]
+        sc = jnp.moveaxis(scores, axis, -1)
+        sc = sc.reshape(sc.shape[0], -1, sc.shape[-1])  # (N, spatial, C)
+        lab = labels.astype(jnp.int32).reshape(sc.shape[0], -1)
+        true_score = jnp.take_along_axis(sc, lab[:, :, None], axis=-1)
+        # rank of true label = #classes with strictly greater score; ties
+        # resolved optimistically like caffe's (>=) partial sort
+        rank = jnp.sum(sc > true_score, axis=-1)
+        correct = (rank < top_k).astype(jnp.float32)
+        if ignore is not None:
+            mask = (lab != int(ignore)).astype(jnp.float32)
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+            return [jnp.sum(correct * mask) / denom]
+        return [jnp.mean(correct)]
+
+
+@register_layer("Silence")
+class SilenceLayer(LayerImpl):
+    """Consume bottoms, produce nothing (silence_layer.cpp)."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        return []
+
+    def apply(self, lp, params, bottoms, train, rng):
+        return []

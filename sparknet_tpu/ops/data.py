@@ -1,0 +1,145 @@
+"""Input/data layer types.
+
+In the reference, data enters the graph through ``JavaDataLayer`` — a C++
+layer whose forward upcalls into the JVM to fill a CPU buffer (reference:
+caffe/src/caffe/layers/java_data_layer.cpp:36-44, registered at :47; proto
+schema caffe/src/caffe/proto/caffe.proto:991-993).  Here a data-type layer is
+simply a *graph input*: the host pipeline (sparknet_tpu.data) produces batch
+arrays and the executor binds them to the layer's tops; there is no callback,
+no FFI, and the transfer to HBM is an async ``device_put`` handled by the
+feeder.  Shape declarations mirror ``JavaDataParameter.shape``/
+``label_shape``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..proto.caffe_pb import BlobShape, FillerParameter, LayerParameter
+from .fillers import fill
+from .registry import LayerImpl, Shape, register_layer
+
+
+class InputLikeLayer(LayerImpl):
+    """Base for layers whose tops are host-fed graph inputs."""
+
+    def min_bottoms(self) -> int:
+        return 0
+
+    def is_input(self) -> bool:
+        return True
+
+    def apply(self, lp, params, bottoms, train, rng):
+        raise RuntimeError(
+            f"input layer {lp.name!r} must be fed by the executor, not applied"
+        )
+
+
+@register_layer("JavaData")
+class JavaDataLayer(InputLikeLayer):
+    """Host-fed data layer (reference: java_data_layer.cpp; shape decl
+    caffe.proto:991-993 JavaDataParameter)."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        p = lp.sub("java_data_param")
+        shapes: list[Shape] = []
+        data_shape = p.get("shape")
+        if data_shape is None:
+            raise ValueError(f"JavaData layer {lp.name!r} missing shape")
+        shapes.append(tuple(BlobShape.from_pmsg(data_shape).dim))
+        label_shape = p.get("label_shape")
+        if len(lp.top) > 1:
+            if label_shape is not None:
+                shapes.append(tuple(BlobShape.from_pmsg(label_shape).dim))
+            else:
+                shapes.append((shapes[0][0],))
+        return shapes
+
+
+@register_layer("Input")
+class InputLayer(InputLikeLayer):
+    """Shape-declared input blob (caffe InputLayer; `input_param { shape }`).
+    Also backs legacy net-level `input:`/`input_shape:` declarations."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        p = lp.sub("input_param")
+        shapes = [tuple(BlobShape.from_pmsg(s).dim) for s in p.get_all("shape")]
+        if not shapes:
+            raise ValueError(f"Input layer {lp.name!r} missing input_param.shape")
+        if len(shapes) == 1 and len(lp.top) > 1:
+            shapes = shapes * len(lp.top)
+        return shapes
+
+
+@register_layer("MemoryData")
+class MemoryDataLayer(InputLikeLayer):
+    """Host-fed (data, label) pair with MemoryDataParameter dims
+    (reference: caffe/src/caffe/layers/memory_data_layer.cpp)."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        p = lp.sub("memory_data_param")
+        n = int(p.get("batch_size", 1))
+        c = int(p.get("channels", 1))
+        h = int(p.get("height", 1))
+        w = int(p.get("width", 1))
+        return [(n, c, h, w), (n,)]
+
+
+@register_layer("DummyData")
+class DummyDataLayer(LayerImpl):
+    """Filler-generated synthetic data (reference:
+    caffe/src/caffe/layers/dummy_data_layer.cpp) — used heavily by the
+    reference's solver/net tests as an in-memory fake data source."""
+
+    def min_bottoms(self) -> int:
+        return 0
+
+    def is_input(self) -> bool:
+        return False
+
+    def needs_rng(self, lp, train: bool = True) -> bool:
+        fillers = lp.sub("dummy_data_param").get_all("data_filler")
+        if not fillers:
+            return False  # default constant filler
+        return any(f.get("type", "constant") != "constant" for f in fillers)
+
+    def _shapes(self, lp: LayerParameter) -> list[Shape]:
+        p = lp.sub("dummy_data_param")
+        shapes = [tuple(BlobShape.from_pmsg(s).dim) for s in p.get_all("shape")]
+        if not shapes:
+            # legacy num/channels/height/width
+            def rep(key: str) -> list[int]:
+                return [int(v) for v in p.get_all(key)]
+            nums, chans, hs, ws = rep("num"), rep("channels"), rep("height"), rep("width")
+            k = max(len(nums), 1)
+            for i in range(k):
+                def pick(lst: list[int]) -> int:
+                    if not lst:
+                        return 1
+                    return lst[i] if i < len(lst) else lst[0]
+                shapes.append((pick(nums), pick(chans), pick(hs), pick(ws)))
+        ntop = max(len(lp.top), 1)
+        if len(shapes) == 1 and ntop > 1:
+            shapes = shapes * ntop
+        return shapes
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        return self._shapes(lp)
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("dummy_data_param")
+        fillers = [FillerParameter.from_pmsg(f) for f in p.get_all("data_filler")]
+        shapes = self._shapes(lp)
+        tops = []
+        for i, shape in enumerate(shapes):
+            f = fillers[i] if i < len(fillers) else (
+                fillers[0] if fillers else FillerParameter())
+            if f.type == "constant":
+                tops.append(jnp.full(shape, f.value, jnp.float32))
+            else:
+                rng, sub = jax.random.split(rng)
+                tops.append(fill(sub, f, shape))
+        return tops

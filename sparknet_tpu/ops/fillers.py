@@ -1,0 +1,65 @@
+"""Weight-initialization fillers.
+
+Caffe-exact semantics for the filler family (reference:
+caffe/include/caffe/filler.hpp:31-146): constant, uniform, gaussian, xavier,
+msra, positive_unitball, bilinear.  Fan-in/fan-out conventions follow
+XavierFiller/MSRAFiller exactly: fan_in = count/num_output(=shape[0]),
+fan_out = count/channels(=shape[1]).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..proto.caffe_pb import FillerParameter
+
+Shape = tuple[int, ...]
+
+
+def fill(rng: jax.Array, filler: FillerParameter, shape: Shape,
+         dtype=jnp.float32) -> jax.Array:
+    t = filler.type
+    if t == "constant":
+        return jnp.full(shape, filler.value, dtype)
+    if t == "uniform":
+        return jax.random.uniform(rng, shape, dtype, minval=filler.min, maxval=filler.max)
+    if t == "gaussian":
+        # sparse gaussian (filler.hpp GaussianFiller sparse_) is not supported;
+        # no zoo model uses it.
+        return filler.mean + filler.std * jax.random.normal(rng, shape, dtype)
+    if t in ("xavier", "msra"):
+        count = math.prod(shape)
+        fan_in = count // shape[0] if shape else 1
+        fan_out = count // shape[1] if len(shape) > 1 else count
+        vn = filler.variance_norm
+        if vn == "AVERAGE":
+            n = (fan_in + fan_out) / 2.0
+        elif vn == "FAN_OUT":
+            n = fan_out
+        else:
+            n = fan_in
+        if t == "xavier":
+            scale = math.sqrt(3.0 / n)
+            return jax.random.uniform(rng, shape, dtype, minval=-scale, maxval=scale)
+        std = math.sqrt(2.0 / n)
+        return std * jax.random.normal(rng, shape, dtype)
+    if t == "positive_unitball":
+        x = jax.random.uniform(rng, shape, dtype)
+        flat = x.reshape(shape[0], -1)
+        flat = flat / jnp.sum(flat, axis=1, keepdims=True)
+        return flat.reshape(shape)
+    if t == "bilinear":
+        # upsampling kernel for deconv (filler.hpp BilinearFiller)
+        kh, kw = shape[-2], shape[-1]
+        f = math.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        xs = jnp.arange(kw)
+        ys = jnp.arange(kh)
+        wx = 1 - jnp.abs(xs / f - c)
+        wy = 1 - jnp.abs(ys / f - c)
+        k = jnp.outer(wy, wx)
+        return jnp.broadcast_to(k, shape).astype(dtype)
+    raise ValueError(f"unknown filler type {t!r}")

@@ -1,0 +1,154 @@
+"""Loss layers.
+
+Reference implementations: caffe/src/caffe/layers/{softmax_loss,
+euclidean_loss,hinge_loss,infogain_loss,sigmoid_cross_entropy_loss,
+multinomial_logistic_loss,contrastive_loss}_layer.cpp (headers:
+caffe/include/caffe/loss_layers.hpp).  Normalization conventions are matched
+exactly — they determine effective learning rates, hence accuracy-trajectory
+parity (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import _canon_axis
+from .registry import LayerImpl, register_layer
+
+_LOG_THRESHOLD = 1e-20
+_FLT_MIN = 1.1754944e-38
+
+
+class LossLayer(LayerImpl):
+    def min_bottoms(self) -> int:
+        return 2
+
+    def out_shapes(self, lp, bottom_shapes):
+        return [()]
+
+
+@register_layer("SoftmaxWithLoss")
+class SoftmaxWithLossLayer(LossLayer):
+    """Softmax + multinomial logistic loss, fused for stability
+    (softmax_loss_layer.cpp).  `loss_param { ignore_label, normalize }`:
+    normalize=true (default) divides by the count of valid predictions
+    (N × spatial), false divides by N."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("loss_param")
+        ignore = p.get("ignore_label")
+        normalize = bool(p.get("normalize", True))
+        axis = _canon_axis(int(lp.sub("softmax_param").get("axis", 1)),
+                           bottoms[0].ndim)
+        scores, labels = bottoms[0], bottoms[1]
+        logp = jax.nn.log_softmax(scores, axis=axis)
+        lp_ = jnp.moveaxis(logp, axis, -1)
+        n = lp_.shape[0]
+        lp_ = lp_.reshape(n, -1, lp_.shape[-1])            # (N, spatial, C)
+        lab = labels.astype(jnp.int32).reshape(n, -1)      # (N, spatial)
+        # ignored labels may be out of range (e.g. 255); clip the gather
+        # index — the masked term is dropped below anyway
+        safe = jnp.clip(lab, 0, lp_.shape[-1] - 1)
+        nll = -jnp.take_along_axis(lp_, safe[:, :, None], axis=-1)[..., 0]
+        if ignore is not None:
+            mask = (lab != int(ignore)).astype(nll.dtype)
+            nll = nll * mask
+            count = jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            count = float(nll.size)
+        total = jnp.sum(nll)
+        return [total / count if normalize else total / n]
+
+
+@register_layer("MultinomialLogisticLoss")
+class MultinomialLogisticLossLayer(LossLayer):
+    """-log(prob[label]) averaged over batch; input is already a probability
+    distribution (multinomial_logistic_loss_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        probs, labels = bottoms[0], bottoms[1]
+        n = probs.shape[0]
+        lab = labels.astype(jnp.int32).reshape(n)
+        p = probs.reshape(n, -1)[jnp.arange(n), lab]
+        return [-jnp.sum(jnp.log(jnp.maximum(p, _LOG_THRESHOLD))) / n]
+
+
+@register_layer("EuclideanLoss")
+class EuclideanLossLayer(LossLayer):
+    """sum((a-b)²) / 2N (euclidean_loss_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        d = bottoms[0] - bottoms[1]
+        return [jnp.sum(d * d) / (2.0 * d.shape[0])]
+
+
+@register_layer("SigmoidCrossEntropyLoss")
+class SigmoidCrossEntropyLossLayer(LossLayer):
+    """Per-element logistic loss from logits, summed and divided by N
+    (sigmoid_cross_entropy_loss_layer.cpp), computed in the same stable form:
+    x - x·t + log(1 + e^-|x|) + max(-x, 0)·0 rearrangement."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        x, t = bottoms[0], bottoms[1].astype(bottoms[0].dtype)
+        n = x.shape[0]
+        loss = jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        return [jnp.sum(loss) / n]
+
+
+@register_layer("HingeLoss")
+class HingeLossLayer(LossLayer):
+    """One-vs-all hinge loss with L1/L2 norm (hinge_loss_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        norm = str(lp.sub("hinge_loss_param").get("norm", "L1"))
+        scores, labels = bottoms[0], bottoms[1]
+        n = scores.shape[0]
+        s = scores.reshape(n, -1)
+        lab = labels.astype(jnp.int32).reshape(n)
+        sign = jnp.where(jax.nn.one_hot(lab, s.shape[1], dtype=s.dtype) > 0, 1.0, -1.0)
+        margin = jnp.maximum(0.0, 1.0 - sign * s)
+        if norm == "L2":
+            return [jnp.sum(margin * margin) / n]
+        return [jnp.sum(margin) / n]
+
+
+@register_layer("InfogainLoss")
+class InfogainLossLayer(LossLayer):
+    """-Σ_j H[label, j]·log(p_j) / N with an infogain matrix H supplied as a
+    third bottom (infogain_loss_layer.cpp; the file-source variant of H is
+    served by the checkpoint reader instead of a private proto load)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        probs, labels = bottoms[0], bottoms[1]
+        if len(bottoms) < 3:
+            raise ValueError("InfogainLoss requires H as third bottom")
+        H = bottoms[2].reshape(probs.shape[1], probs.shape[1])
+        n = probs.shape[0]
+        lab = labels.astype(jnp.int32).reshape(n)
+        logp = jnp.log(jnp.maximum(probs.reshape(n, -1), _LOG_THRESHOLD))
+        return [-jnp.sum(H[lab] * logp) / n]
+
+
+@register_layer("ContrastiveLoss")
+class ContrastiveLossLayer(LossLayer):
+    """Siamese contrastive loss (contrastive_loss_layer.cpp):
+    y·d² + (1−y)·max(margin − d, 0)² (legacy: margin − d²), over 2N."""
+
+    def min_bottoms(self) -> int:
+        return 3
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("contrastive_loss_param")
+        margin = float(p.get("margin", 1.0))
+        legacy = bool(p.get("legacy_version", False))
+        a, b, y = bottoms[0], bottoms[1], bottoms[2].astype(bottoms[0].dtype)
+        n = a.shape[0]
+        d2 = jnp.sum((a - b) ** 2, axis=1)
+        y = y.reshape(n)
+        if legacy:
+            neg = jnp.maximum(margin - d2, 0.0)
+        else:
+            dist = jnp.maximum(margin - jnp.sqrt(d2 + 1e-12), 0.0)
+            neg = dist * dist
+        return [jnp.sum(y * d2 + (1.0 - y) * neg) / (2.0 * n)]

@@ -1,0 +1,150 @@
+"""Neuron (elementwise) layers.
+
+Reference implementations: caffe/src/caffe/layers/{relu,prelu,sigmoid,tanh,
+absval,bnll,dropout,exp,log,power,threshold}_layer.cpp (headers grouped in
+caffe/include/caffe/neuron_layers.hpp).  Each is a one-liner under XLA, which
+fuses them into adjacent matmul/conv HLOs — there is nothing to hand-schedule.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..proto.caffe_pb import FillerParameter
+from .fillers import fill
+from .registry import LayerImpl, register_layer
+
+
+@register_layer("ReLU")
+class ReLULayer(LayerImpl):
+    """max(x,0) + negative_slope·min(x,0) (relu_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        slope = float(lp.sub("relu_param").get("negative_slope", 0.0))
+        x = bottoms[0]
+        if slope == 0.0:
+            return [jnp.maximum(x, 0.0)]
+        return [jnp.maximum(x, 0.0) + slope * jnp.minimum(x, 0.0)]
+
+
+@register_layer("PReLU")
+class PReLULayer(LayerImpl):
+    """Learnable per-channel slope (prelu_layer.cpp); blob shape (C,),
+    channel_shared collapses it to (1,); default filler constant 0.25."""
+
+    def init(self, rng, lp, bottom_shapes):
+        p = lp.sub("prelu_param")
+        shared = bool(p.get("channel_shared", False))
+        c = 1 if shared else bottom_shapes[0][1]
+        f = FillerParameter.from_pmsg(p.get("filler"))
+        if not p.has("filler"):
+            f = FillerParameter(type="constant", value=0.25)
+        return [fill(rng, f, (c,))]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        x = bottoms[0]
+        slope = params[0].reshape(1, -1, *([1] * (x.ndim - 2)))
+        return [jnp.maximum(x, 0.0) + slope * jnp.minimum(x, 0.0)]
+
+
+@register_layer("Sigmoid")
+class SigmoidLayer(LayerImpl):
+    def apply(self, lp, params, bottoms, train, rng):
+        return [jax.nn.sigmoid(bottoms[0])]
+
+
+@register_layer("TanH")
+class TanHLayer(LayerImpl):
+    def apply(self, lp, params, bottoms, train, rng):
+        return [jnp.tanh(bottoms[0])]
+
+
+@register_layer("AbsVal")
+class AbsValLayer(LayerImpl):
+    def apply(self, lp, params, bottoms, train, rng):
+        return [jnp.abs(bottoms[0])]
+
+
+@register_layer("BNLL")
+class BNLLLayer(LayerImpl):
+    """log(1+exp(x)), computed stably as in bnll_layer.cpp."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        x = bottoms[0]
+        return [jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))]
+
+
+@register_layer("Dropout")
+class DropoutLayer(LayerImpl):
+    """Train-time inverted dropout: zero with prob p, scale survivors by
+    1/(1-p); identity at test (dropout_layer.cpp:20-45)."""
+
+    def needs_rng(self, lp, train: bool = True) -> bool:
+        return train and float(lp.sub("dropout_param").get("dropout_ratio", 0.5)) > 0
+
+    def apply(self, lp, params, bottoms, train, rng):
+        ratio = float(lp.sub("dropout_param").get("dropout_ratio", 0.5))
+        x = bottoms[0]
+        if not train or ratio == 0.0:
+            return [x]
+        keep = 1.0 - ratio
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0)]
+
+
+@register_layer("Exp")
+class ExpLayer(LayerImpl):
+    """y = base^(shift + scale·x), natural base when base == -1
+    (exp_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("exp_param")
+        base = float(p.get("base", -1.0))
+        scale = float(p.get("scale", 1.0))
+        shift = float(p.get("shift", 0.0))
+        inner = shift + scale * bottoms[0]
+        if base == -1.0:
+            return [jnp.exp(inner)]
+        return [jnp.exp(inner * math.log(base))]
+
+
+@register_layer("Log")
+class LogLayer(LayerImpl):
+    """y = log_base(shift + scale·x) (log_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("log_param")
+        base = float(p.get("base", -1.0))
+        scale = float(p.get("scale", 1.0))
+        shift = float(p.get("shift", 0.0))
+        y = jnp.log(shift + scale * bottoms[0])
+        if base != -1.0:
+            y = y / math.log(base)
+        return [y]
+
+
+@register_layer("Power")
+class PowerLayer(LayerImpl):
+    """y = (shift + scale·x)^power (power_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("power_param")
+        power = float(p.get("power", 1.0))
+        scale = float(p.get("scale", 1.0))
+        shift = float(p.get("shift", 0.0))
+        inner = shift + scale * bottoms[0]
+        if power == 1.0:
+            return [inner]
+        return [inner ** power]
+
+
+@register_layer("Threshold")
+class ThresholdLayer(LayerImpl):
+    """y = 1[x > threshold] (threshold_layer.cpp)."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        t = float(lp.sub("threshold_param").get("threshold", 0.0))
+        return [(bottoms[0] > t).astype(bottoms[0].dtype)]

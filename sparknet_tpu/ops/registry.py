@@ -1,0 +1,87 @@
+"""Layer registry — string type -> implementation.
+
+Mirrors Caffe's ``LayerRegistry`` + ``REGISTER_LAYER_CLASS`` (reference:
+caffe/include/caffe/layer_factory.hpp:55-136), but an "implementation" here
+is a stateless object with pure functions: shape inference, parameter
+initialization, and forward application.  Backward is free — the whole net is
+differentiated by ``jax.grad``; there is no per-layer Backward_cpu/gpu to
+write (reference: caffe/include/caffe/layer.hpp:335-341 dispatch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+
+from ..proto.caffe_pb import LayerParameter
+
+Shape = tuple[int, ...]
+
+
+class LayerImpl:
+    """Base layer implementation.
+
+    Subclasses override:
+      - ``out_shapes(lp, bottom_shapes)``: infer top shapes (concrete python
+        ints — runs at graph-build time, keeping everything static for XLA).
+      - ``init(rng, lp, bottom_shapes)``: create learnable blobs (list of
+        arrays), mirroring each Caffe layer's ``LayerSetUp`` filler logic.
+      - ``apply(lp, params, bottoms, train, rng)``: forward compute. Returns
+        the list of top arrays, or ``(tops, new_params)`` for layers with
+        forward-updated state (BatchNorm running stats).
+    """
+
+    type: str = ""
+
+    def min_bottoms(self) -> int:
+        return 1
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        return [tuple(bottom_shapes[0])]
+
+    def init(self, rng: jax.Array, lp: LayerParameter,
+             bottom_shapes: Sequence[Shape]) -> list[jax.Array]:
+        return []
+
+    def apply(self, lp: LayerParameter, params: Sequence[jax.Array],
+              bottoms: Sequence[jax.Array], train: bool,
+              rng: jax.Array | None) -> Any:
+        raise NotImplementedError(self.type)
+
+    def is_loss(self) -> bool:
+        """Whether top[0] carries an implicit loss_weight of 1
+        (Caffe: Layer::SetUp assigns loss weight to *Loss layers)."""
+        return self.type.endswith("Loss")
+
+    def needs_rng(self, lp: LayerParameter, train: bool = True) -> bool:
+        """Whether apply() requires an rng in the given mode (Dropout only
+        when training; DummyData with random fillers in any phase)."""
+        return False
+
+
+_REGISTRY: dict[str, LayerImpl] = {}
+
+
+def register_layer(type_name: str) -> Callable[[type], type]:
+    def deco(cls: type) -> type:
+        impl = cls()
+        impl.type = type_name
+        if type_name in _REGISTRY:
+            raise ValueError(f"layer type {type_name!r} registered twice")
+        _REGISTRY[type_name] = impl
+        return cls
+    return deco
+
+
+def get_layer_impl(type_name: str) -> LayerImpl:
+    try:
+        return _REGISTRY[type_name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown layer type: {type_name!r} (known: {sorted(_REGISTRY)})"
+        ) from None
+
+
+def registered_types() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,344 @@
+"""Vision layers: Convolution, Deconvolution, Pooling, LRN, Im2col, SPP.
+
+Caffe-exact shape/padding semantics (reference:
+caffe/src/caffe/layers/base_conv_layer.cpp shape setup,
+caffe/src/caffe/layers/pooling_layer.cpp:90-110 ceil-mode output sizing,
+caffe/src/caffe/layers/lrn_layer.cpp scale formula).  All of Caffe's
+im2col + GEMM lowering (caffe/src/caffe/util/im2col.cpp/.cu,
+math_functions) collapses into ``lax.conv_general_dilated``, which XLA tiles
+onto the MXU directly.  Layout is logical NCHW to match prototxt semantics;
+XLA's layout assignment picks the physical TPU layout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..proto.caffe_pb import FillerParameter, LayerParameter
+from .fillers import fill
+from .registry import LayerImpl, Shape, register_layer
+
+DIMNUMS = ("NCHW", "OIHW", "NCHW")
+
+
+def _pair(p, key: str, default: int, hkey: str | None = None, wkey: str | None = None):
+    """Caffe's kernel/stride/pad convention: repeated `key` or `key_h`/`key_w`."""
+    hkey = hkey or f"{key}_h"
+    wkey = wkey or f"{key}_w"
+    vals = [int(v) for v in p.get_all(key)]
+    if p.has(hkey) or p.has(wkey):
+        return int(p.get(hkey, default)), int(p.get(wkey, default))
+    if len(vals) >= 2:
+        return vals[0], vals[1]
+    if len(vals) == 1:
+        return vals[0], vals[0]
+    return default, default
+
+
+def conv_geometry(lp: LayerParameter):
+    p = lp.sub("convolution_param")
+    kh, kw = _pair(p, "kernel_size", 0, "kernel_h", "kernel_w")
+    sh, sw = _pair(p, "stride", 1)
+    ph, pw = _pair(p, "pad", 0)
+    dh, dw = _pair(p, "dilation", 1)
+    num_output = int(p.get("num_output", 0))
+    group = int(p.get("group", 1))
+    bias_term = bool(p.get("bias_term", True))
+    if kh <= 0 or kw <= 0:
+        raise ValueError(
+            f"layer {lp.name!r}: kernel_size (or kernel_h/kernel_w) required")
+    if num_output <= 0:
+        raise ValueError(f"layer {lp.name!r}: num_output required")
+    return kh, kw, sh, sw, ph, pw, dh, dw, num_output, group, bias_term
+
+
+@register_layer("Convolution")
+class ConvolutionLayer(LayerImpl):
+    """2-D convolution (reference: caffe/src/caffe/layers/conv_layer.cpp;
+    weight blob (out, in/group, kh, kw), out_dim = (in + 2p - ke)/s + 1 with
+    ke = d*(k-1)+1, floor division — base_conv_layer.cpp compute_output_shape)."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        n, c, h, w = bottom_shapes[0]
+        kh, kw, sh, sw, ph, pw, dh, dw, num_output, group, _ = conv_geometry(lp)
+        keh, kew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+        oh = (h + 2 * ph - keh) // sh + 1
+        ow = (w + 2 * pw - kew) // sw + 1
+        return [(n, num_output, oh, ow) for _ in lp.bottom]
+
+    def init(self, rng, lp, bottom_shapes):
+        _, c, _, _ = bottom_shapes[0]
+        kh, kw, _, _, _, _, _, _, num_output, group, bias_term = conv_geometry(lp)
+        p = lp.sub("convolution_param")
+        wf = FillerParameter.from_pmsg(p.get("weight_filler"))
+        r1, r2 = jax.random.split(rng)
+        blobs = [fill(r1, wf, (num_output, c // group, kh, kw))]
+        if bias_term:
+            bf = FillerParameter.from_pmsg(p.get("bias_filler"))
+            blobs.append(fill(r2, bf, (num_output,)))
+        return blobs
+
+    def apply(self, lp, params, bottoms, train, rng):
+        kh, kw, sh, sw, ph, pw, dh, dw, num_output, group, bias_term = conv_geometry(lp)
+        weight = params[0]
+        tops = []
+        for x in bottoms:
+            y = lax.conv_general_dilated(
+                x, weight,
+                window_strides=(sh, sw),
+                padding=((ph, ph), (pw, pw)),
+                rhs_dilation=(dh, dw),
+                feature_group_count=group,
+                dimension_numbers=DIMNUMS,
+            )
+            if bias_term:
+                y = y + params[1].reshape(1, -1, 1, 1)
+            tops.append(y)
+        return tops
+
+
+@register_layer("Deconvolution")
+class DeconvolutionLayer(LayerImpl):
+    """Transposed convolution (reference:
+    caffe/src/caffe/layers/deconv_layer.cpp; weight blob (in, out/group, kh,
+    kw), out_dim = s*(in-1) + ke - 2p).  Implemented as an input-dilated
+    forward conv with spatially flipped, group-transposed weights — the exact
+    transpose of ConvolutionLayer, without writing a backward pass."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        n, c, h, w = bottom_shapes[0]
+        kh, kw, sh, sw, ph, pw, dh, dw, num_output, group, _ = conv_geometry(lp)
+        keh, kew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+        oh = sh * (h - 1) + keh - 2 * ph
+        ow = sw * (w - 1) + kew - 2 * pw
+        return [(n, num_output, oh, ow) for _ in lp.bottom]
+
+    def init(self, rng, lp, bottom_shapes):
+        _, c, _, _ = bottom_shapes[0]
+        kh, kw, _, _, _, _, _, _, num_output, group, bias_term = conv_geometry(lp)
+        p = lp.sub("convolution_param")
+        wf = FillerParameter.from_pmsg(p.get("weight_filler"))
+        r1, r2 = jax.random.split(rng)
+        blobs = [fill(r1, wf, (c, num_output // group, kh, kw))]
+        if bias_term:
+            bf = FillerParameter.from_pmsg(p.get("bias_filler"))
+            blobs.append(fill(r2, bf, (num_output,)))
+        return blobs
+
+    def apply(self, lp, params, bottoms, train, rng):
+        kh, kw, sh, sw, ph, pw, dh, dw, num_output, group, bias_term = conv_geometry(lp)
+        w = params[0]  # (C_in, C_out/group, kh, kw)
+        c_in = w.shape[0]
+        # -> (C_out, C_in/group, kh, kw), spatially flipped
+        wg = w.reshape(group, c_in // group, num_output // group, kh, kw)
+        wg = jnp.transpose(wg, (0, 2, 1, 3, 4)).reshape(
+            num_output, c_in // group, kh, kw)
+        wg = jnp.flip(wg, axis=(-2, -1))
+        keh, kew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+        tops = []
+        for x in bottoms:
+            y = lax.conv_general_dilated(
+                x, wg,
+                window_strides=(1, 1),
+                padding=((keh - 1 - ph, keh - 1 - ph), (kew - 1 - pw, kew - 1 - pw)),
+                lhs_dilation=(sh, sw),
+                rhs_dilation=(dh, dw),
+                feature_group_count=group,
+                dimension_numbers=DIMNUMS,
+            )
+            if bias_term:
+                y = y + params[1].reshape(1, -1, 1, 1)
+            tops.append(y)
+        return tops
+
+
+def pool_output_size(h: int, w: int, kh: int, kw: int, sh: int, sw: int,
+                     ph: int, pw: int) -> tuple[int, int]:
+    """Caffe's ceil-mode pooled size with the start-inside-padding clip
+    (reference: pooling_layer.cpp:90-102)."""
+    oh = int(math.ceil((h + 2 * ph - kh) / sh)) + 1
+    ow = int(math.ceil((w + 2 * pw - kw) / sw)) + 1
+    if ph or pw:
+        if (oh - 1) * sh >= h + ph:
+            oh -= 1
+        if (ow - 1) * sw >= w + pw:
+            ow -= 1
+    return oh, ow
+
+
+def _pool_geometry(lp: LayerParameter, bottom_shape: Shape):
+    p = lp.sub("pooling_param")
+    n, c, h, w = bottom_shape
+    if bool(p.get("global_pooling", False)):
+        kh, kw, sh, sw, ph, pw = h, w, 1, 1, 0, 0
+    else:
+        kh, kw = _pair(p, "kernel_size", 0, "kernel_h", "kernel_w")
+        sh, sw = _pair(p, "stride", 1)
+        ph, pw = _pair(p, "pad", 0)
+        if kh <= 0 or kw <= 0:
+            raise ValueError(
+                f"layer {lp.name!r}: kernel_size (or kernel_h/kernel_w) "
+                f"required unless global_pooling")
+    method = str(p.get("pool", "MAX"))
+    return kh, kw, sh, sw, ph, pw, method
+
+
+def max_pool(x, kh, kw, sh, sw, ph, pw, oh, ow):
+    h, w = x.shape[2], x.shape[3]
+    pad_hi_h = (oh - 1) * sh + kh - h - ph
+    pad_hi_w = (ow - 1) * sw + kw - w - pw
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, kh, kw), (1, 1, sh, sw),
+        ((0, 0), (0, 0), (ph, max(pad_hi_h, 0)), (pw, max(pad_hi_w, 0))),
+    )
+
+
+def ave_pool(x, kh, kw, sh, sw, ph, pw, oh, ow):
+    """Caffe AVE pooling: zero-pad, divide by the pool window size clipped to
+    the padded extent [0, dim+pad) — not the kernel area and not the valid
+    area (reference: pooling_layer.cpp Forward_cpu AVE branch)."""
+    h, w = x.shape[2], x.shape[3]
+    pad_hi_h = (oh - 1) * sh + kh - h - ph
+    pad_hi_w = (ow - 1) * sw + kw - w - pw
+    s = lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, kh, kw), (1, 1, sh, sw),
+        ((0, 0), (0, 0), (ph, max(pad_hi_h, 0)), (pw, max(pad_hi_w, 0))),
+    )
+
+    def counts(dim: int, k: int, stride: int, pad: int, out: int) -> np.ndarray:
+        starts = np.arange(out) * stride - pad
+        ends = np.minimum(starts + k, dim + pad)
+        return (ends - starts).astype(np.float32)
+
+    ch = counts(h, kh, sh, ph, oh)
+    cw = counts(w, kw, sw, pw, ow)
+    denom = jnp.asarray(np.outer(ch, cw))[None, None, :, :]
+    return s / denom
+
+
+@register_layer("Pooling")
+class PoolingLayer(LayerImpl):
+    """MAX/AVE/STOCHASTIC pooling (reference: pooling_layer.cpp).  STOCHASTIC
+    uses the test-time weighted-average form (sum x² / sum x) in both modes;
+    no zoo model trains with stochastic pooling."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        n, c, h, w = bottom_shapes[0]
+        kh, kw, sh, sw, ph, pw, _ = _pool_geometry(lp, bottom_shapes[0])
+        oh, ow = pool_output_size(h, w, kh, kw, sh, sw, ph, pw)
+        return [(n, c, oh, ow)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        x = bottoms[0]
+        n, c, h, w = x.shape
+        kh, kw, sh, sw, ph, pw, method = _pool_geometry(lp, x.shape)
+        oh, ow = pool_output_size(h, w, kh, kw, sh, sw, ph, pw)
+        if method == "MAX":
+            return [max_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)]
+        if method == "AVE":
+            return [ave_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)]
+        if method == "STOCHASTIC":
+            num = ave_pool(x * x, kh, kw, sh, sw, ph, pw, oh, ow)
+            den = ave_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)
+            return [num / jnp.where(den == 0, 1.0, den)]
+        raise ValueError(f"unknown pool method {method!r}")
+
+
+@register_layer("LRN")
+class LRNLayer(LayerImpl):
+    """Local response normalization (reference:
+    caffe/src/caffe/layers/lrn_layer.cpp): scale = k + (alpha/n)·Σ x² over a
+    size-n window, out = x / scale^beta.  ACROSS_CHANNELS windows the channel
+    axis; WITHIN_CHANNEL uses AVE-pooling semantics spatially."""
+
+    def apply(self, lp, params, bottoms, train, rng):
+        p = lp.sub("lrn_param")
+        size = int(p.get("local_size", 5))
+        alpha = float(p.get("alpha", 1.0))
+        beta = float(p.get("beta", 0.75))
+        k = float(p.get("k", 1.0))
+        region = str(p.get("norm_region", "ACROSS_CHANNELS"))
+        x = bottoms[0]
+        sq = x * x
+        if region == "ACROSS_CHANNELS":
+            pre = (size - 1) // 2
+            post = size - 1 - pre
+            ssum = lax.reduce_window(
+                sq, 0.0, lax.add, (1, size, 1, 1), (1, 1, 1, 1),
+                ((0, 0), (pre, post), (0, 0), (0, 0)),
+            )
+        else:  # WITHIN_CHANNEL: x · (1 + α·avgpool(x²))^-β  (lrn_layer.cpp
+            # WithinChannelForward: square → AVE pool → power(shift=1,
+            # scale=α, power=-β) → eltwise product; k is unused there)
+            pre = (size - 1) // 2
+            h, w = x.shape[2], x.shape[3]
+            savg = ave_pool(sq, size, size, 1, 1, pre, pre, h, w)
+            return [x * (1.0 + alpha * savg) ** (-beta)]
+        scale = k + (alpha / size) * ssum
+        return [x / scale ** beta]
+
+
+@register_layer("Im2col")
+class Im2colLayer(LayerImpl):
+    """Patch extraction as a standalone layer (reference:
+    caffe/src/caffe/layers/im2col_layer.cpp)."""
+
+    def out_shapes(self, lp, bottom_shapes):
+        n, c, h, w = bottom_shapes[0]
+        kh, kw, sh, sw, ph, pw, dh, dw, _, _, _ = conv_geometry(lp)
+        keh, kew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+        oh = (h + 2 * ph - keh) // sh + 1
+        ow = (w + 2 * pw - kew) // sw + 1
+        return [(n, c * kh * kw, oh, ow)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        kh, kw, sh, sw, ph, pw, dh, dw, _, _, _ = conv_geometry(lp)
+        y = lax.conv_general_dilated_patches(
+            bottoms[0], (kh, kw), (sh, sw), ((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw), dimension_numbers=DIMNUMS,
+        )
+        return [y]
+
+
+@register_layer("SPP")
+class SPPLayer(LayerImpl):
+    """Spatial pyramid pooling (reference: caffe/src/caffe/layers/spp_layer.cpp):
+    pyramid_height levels; level l has 2^l × 2^l bins, each max-pooled and
+    flattened, concatenated along channels."""
+
+    def _levels(self, lp, shape):
+        p = lp.sub("spp_param")
+        height = int(p.get("pyramid_height", 1))
+        n, c, h, w = shape
+        out = []
+        for l in range(height):
+            bins = 2 ** l
+            kh = int(math.ceil(h / bins))
+            kw = int(math.ceil(w / bins))
+            ph = (kh * bins - h + 1) // 2
+            pw = (kw * bins - w + 1) // 2
+            out.append((bins, kh, kw, ph, pw))
+        return out
+
+    def out_shapes(self, lp, bottom_shapes):
+        n, c, h, w = bottom_shapes[0]
+        total = sum(c * bins * bins for bins, *_ in self._levels(lp, bottom_shapes[0]))
+        return [(n, total)]
+
+    def apply(self, lp, params, bottoms, train, rng):
+        x = bottoms[0]
+        n, c, h, w = x.shape
+        p = lp.sub("spp_param")
+        method = str(p.get("pool", "MAX"))
+        outs = []
+        for bins, kh, kw, ph, pw in self._levels(lp, x.shape):
+            fn = max_pool if method == "MAX" else ave_pool
+            y = fn(x, kh, kw, kh, kw, ph, pw, bins, bins)
+            outs.append(y.reshape(n, -1))
+        return [jnp.concatenate(outs, axis=1)]

@@ -1,0 +1,15 @@
+from .textformat import PMessage, parse, serialize, ParseError
+from .caffe_pb import (
+    BlobShape,
+    FillerParameter,
+    LayerParameter,
+    NetParameter,
+    NetState,
+    NetStateRule,
+    SolverParameter,
+    Phase,
+    load_net_prototxt,
+    load_solver_prototxt,
+    load_solver_prototxt_with_net,
+    replace_data_layers,
+)

@@ -1,0 +1,484 @@
+"""Typed views over parsed prototxt for the Caffe config schema.
+
+The reference's single source of truth is ``caffe.proto`` (reference:
+caffe/src/caffe/proto/caffe.proto:64 NetParameter, :102 SolverParameter,
+:310 LayerParameter); the JVM side uses 85k lines of protoc-generated Java
+(src/main/java/caffe/Caffe.java).  Here we keep the parsed ``PMessage`` as
+the backing store and expose typed dataclass views for the messages the
+framework logic touches; per-layer parameter sub-messages stay as PMessage
+and are read with defaulting accessors by the op implementations — the same
+division of labor protobuf's descriptor layer provides, in ~2 orders of
+magnitude less code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any
+
+from .textformat import PMessage, parse, serialize
+
+
+class Phase(enum.IntEnum):
+    TRAIN = 0
+    TEST = 1
+
+
+def _phase_of(v: Any) -> Phase | None:
+    if v is None:
+        return None
+    if isinstance(v, Phase):
+        return v
+    if isinstance(v, str):
+        return Phase[v]
+    return Phase(int(v))
+
+
+@dataclasses.dataclass
+class BlobShape:
+    dim: list[int]
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage) -> "BlobShape":
+        return cls(dim=[int(d) for d in m.get_all("dim")])
+
+    def to_pmsg(self) -> PMessage:
+        m = PMessage()
+        for d in self.dim:
+            m.add("dim", int(d))
+        return m
+
+
+@dataclasses.dataclass
+class FillerParameter:
+    """Weight-init config (reference: caffe/include/caffe/filler.hpp:31-146)."""
+
+    type: str = "constant"
+    value: float = 0.0
+    min: float = 0.0
+    max: float = 1.0
+    mean: float = 0.0
+    std: float = 1.0
+    sparse: int = -1
+    variance_norm: str = "FAN_IN"  # FAN_IN | FAN_OUT | AVERAGE
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage | None) -> "FillerParameter":
+        if m is None:
+            return cls()
+        return cls(
+            type=str(m.get("type", "constant")),
+            value=float(m.get("value", 0.0)),
+            min=float(m.get("min", 0.0)),
+            max=float(m.get("max", 1.0)),
+            mean=float(m.get("mean", 0.0)),
+            std=float(m.get("std", 1.0)),
+            sparse=int(m.get("sparse", -1)),
+            variance_norm=str(m.get("variance_norm", "FAN_IN")),
+        )
+
+
+@dataclasses.dataclass
+class NetStateRule:
+    """Phase/level/stage inclusion rule (reference: caffe.proto:263)."""
+
+    phase: Phase | None = None
+    min_level: int | None = None
+    max_level: int | None = None
+    stage: list[str] = dataclasses.field(default_factory=list)
+    not_stage: list[str] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage) -> "NetStateRule":
+        return cls(
+            phase=_phase_of(m.get("phase")),
+            min_level=m.get("min_level"),
+            max_level=m.get("max_level"),
+            stage=[str(s) for s in m.get_all("stage")],
+            not_stage=[str(s) for s in m.get_all("not_stage")],
+        )
+
+    def matches(self, state: "NetState") -> bool:
+        """Mirror of Net::StateMeetsRule (reference: caffe/src/caffe/net.cpp:287-329)."""
+        if self.phase is not None and self.phase != state.phase:
+            return False
+        if self.min_level is not None and state.level < int(self.min_level):
+            return False
+        if self.max_level is not None and state.level > int(self.max_level):
+            return False
+        for s in self.stage:
+            if s not in state.stage:
+                return False
+        for s in self.not_stage:
+            if s in state.stage:
+                return False
+        return True
+
+
+@dataclasses.dataclass
+class NetState:
+    phase: Phase = Phase.TEST
+    level: int = 0
+    stage: list[str] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage | None) -> "NetState":
+        if m is None:
+            return cls()
+        return cls(
+            phase=_phase_of(m.get("phase")) or Phase.TEST,
+            level=int(m.get("level", 0)),
+            stage=[str(s) for s in m.get_all("stage")],
+        )
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    """Per-learnable-blob training config (lr_mult/decay_mult)."""
+
+    name: str | None = None
+    lr_mult: float = 1.0
+    decay_mult: float = 1.0
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage) -> "ParamSpec":
+        return cls(
+            name=m.get("name"),
+            lr_mult=float(m.get("lr_mult", 1.0)),
+            decay_mult=float(m.get("decay_mult", 1.0)),
+        )
+
+
+# V1LayerParameter enum type names -> V2 string type names
+# (reference: caffe/src/caffe/util/upgrade_proto.cpp UpgradeV1LayerType)
+_V1_TYPE_MAP = {
+    "ABSVAL": "AbsVal", "ACCURACY": "Accuracy", "ARGMAX": "ArgMax",
+    "BNLL": "BNLL", "CONCAT": "Concat", "CONTRASTIVE_LOSS": "ContrastiveLoss",
+    "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+    "DATA": "Data", "DROPOUT": "Dropout", "DUMMY_DATA": "DummyData",
+    "EUCLIDEAN_LOSS": "EuclideanLoss", "ELTWISE": "Eltwise", "EXP": "Exp",
+    "FLATTEN": "Flatten", "HDF5_DATA": "HDF5Data", "HDF5_OUTPUT": "HDF5Output",
+    "HINGE_LOSS": "HingeLoss", "IM2COL": "Im2col", "IMAGE_DATA": "ImageData",
+    "INFOGAIN_LOSS": "InfogainLoss", "INNER_PRODUCT": "InnerProduct",
+    "LRN": "LRN", "MEMORY_DATA": "MemoryData",
+    "MULTINOMIAL_LOGISTIC_LOSS": "MultinomialLogisticLoss", "MVN": "MVN",
+    "POOLING": "Pooling", "POWER": "Power", "RELU": "ReLU",
+    "SIGMOID": "Sigmoid", "SIGMOID_CROSS_ENTROPY_LOSS": "SigmoidCrossEntropyLoss",
+    "SILENCE": "Silence", "SOFTMAX": "Softmax", "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "SPLIT": "Split", "SLICE": "Slice", "TANH": "TanH",
+    "WINDOW_DATA": "WindowData", "THRESHOLD": "Threshold",
+}
+
+# V1 nested blobs_lr/weight_decay -> ParamSpec
+_PARAM_SUBMSG_KEYS = (
+    "transform_param", "loss_param", "accuracy_param", "argmax_param",
+    "batch_norm_param", "bias_param", "concat_param", "contrastive_loss_param",
+    "convolution_param", "data_param", "dropout_param", "dummy_data_param",
+    "eltwise_param", "embed_param", "exp_param", "flatten_param",
+    "hdf5_data_param", "hdf5_output_param", "hinge_loss_param",
+    "image_data_param", "infogain_loss_param", "inner_product_param",
+    "input_param", "log_param", "lrn_param", "memory_data_param", "mvn_param",
+    "pooling_param", "power_param", "prelu_param", "python_param",
+    "reduction_param", "relu_param", "reshape_param", "scale_param",
+    "sigmoid_param", "softmax_param", "spp_param", "slice_param",
+    "tanh_param", "threshold_param", "tile_param", "window_data_param",
+    "java_data_param",
+)
+
+
+@dataclasses.dataclass
+class LayerParameter:
+    """One layer of the net graph (reference: caffe.proto:310)."""
+
+    name: str = ""
+    type: str = ""
+    bottom: list[str] = dataclasses.field(default_factory=list)
+    top: list[str] = dataclasses.field(default_factory=list)
+    phase: Phase | None = None
+    loss_weight: list[float] = dataclasses.field(default_factory=list)
+    param: list[ParamSpec] = dataclasses.field(default_factory=list)
+    include: list[NetStateRule] = dataclasses.field(default_factory=list)
+    exclude: list[NetStateRule] = dataclasses.field(default_factory=list)
+    propagate_down: list[bool] = dataclasses.field(default_factory=list)
+    # type-specific sub-configs, kept schema-free:
+    params: dict[str, PMessage] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage, v1: bool = False) -> "LayerParameter":
+        type_ = m.get("type", "")
+        if v1 and isinstance(type_, str) and type_ in _V1_TYPE_MAP:
+            type_ = _V1_TYPE_MAP[type_]
+        lp = cls(
+            name=str(m.get("name", "")),
+            type=str(type_),
+            bottom=[str(b) for b in m.get_all("bottom")],
+            top=[str(t) for t in m.get_all("top")],
+            phase=_phase_of(m.get("phase")),
+            loss_weight=[float(w) for w in m.get_all("loss_weight")],
+            include=[NetStateRule.from_pmsg(r) for r in m.get_all("include")],
+            exclude=[NetStateRule.from_pmsg(r) for r in m.get_all("exclude")],
+            propagate_down=[bool(p) for p in m.get_all("propagate_down")],
+        )
+        # params: new-style `param { lr_mult ... }`; V1-style scalar
+        # blobs_lr / weight_decay lists (upgrade_proto.cpp semantics).
+        pmsgs = [p for p in m.get_all("param") if isinstance(p, PMessage)]
+        shared_names = [p for p in m.get_all("param") if isinstance(p, str)]
+        if pmsgs:
+            lp.param = [ParamSpec.from_pmsg(p) for p in pmsgs]
+        elif v1 and (m.has("blobs_lr") or m.has("weight_decay") or shared_names):
+            lrs = [float(x) for x in m.get_all("blobs_lr")]
+            wds = [float(x) for x in m.get_all("weight_decay")]
+            n = max(len(lrs), len(wds), len(shared_names))
+            for i in range(n):
+                lp.param.append(ParamSpec(
+                    name=shared_names[i] if i < len(shared_names) else None,
+                    lr_mult=lrs[i] if i < len(lrs) else 1.0,
+                    decay_mult=wds[i] if i < len(wds) else 1.0,
+                ))
+        for key in _PARAM_SUBMSG_KEYS:
+            sub = m.get(key)
+            if isinstance(sub, PMessage):
+                lp.params[key] = sub
+        return lp
+
+    def sub(self, key: str) -> PMessage:
+        """Type-specific sub-config, empty message if absent."""
+        return self.params.get(key) or PMessage()
+
+    def included_in(self, state: NetState) -> bool:
+        """Mirror of Net::FilterNet layer inclusion (reference: net.cpp:256-286):
+        no rules -> included; include rules -> any match; exclude -> none match;
+        plus the direct `phase` field used by ProtoLoader.replaceDataLayers."""
+        if self.phase is not None and self.phase != state.phase:
+            return False
+        if self.include:
+            return any(r.matches(state) for r in self.include)
+        return not any(r.matches(state) for r in self.exclude)
+
+
+@dataclasses.dataclass
+class NetParameter:
+    """The model graph config (reference: caffe.proto:64)."""
+
+    name: str = ""
+    layer: list[LayerParameter] = dataclasses.field(default_factory=list)
+    input: list[str] = dataclasses.field(default_factory=list)
+    input_shape: list[BlobShape] = dataclasses.field(default_factory=list)
+    state: NetState = dataclasses.field(default_factory=NetState)
+    force_backward: bool = False
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage) -> "NetParameter":
+        layers_new = m.get_all("layer")
+        layers_v1 = m.get_all("layers")
+        layer = [LayerParameter.from_pmsg(l) for l in layers_new]
+        layer += [LayerParameter.from_pmsg(l, v1=True) for l in layers_v1]
+        input_shape = [BlobShape.from_pmsg(s) for s in m.get_all("input_shape")]
+        input_dims = [int(d) for d in m.get_all("input_dim")]
+        if input_dims and not input_shape:
+            # legacy input_dim: 4 ints per input blob
+            for i in range(0, len(input_dims), 4):
+                input_shape.append(BlobShape(dim=input_dims[i:i + 4]))
+        return cls(
+            name=str(m.get("name", "")),
+            layer=layer,
+            input=[str(i) for i in m.get_all("input")],
+            input_shape=input_shape,
+            state=NetState.from_pmsg(m.get("state")),
+            force_backward=bool(m.get("force_backward", False)),
+        )
+
+    def filtered(self, state: NetState) -> "NetParameter":
+        """Phase-filtered copy — Net::FilterNet (reference: net.cpp:256)."""
+        out = dataclasses.replace(
+            self, layer=[l for l in self.layer if l.included_in(state)], state=state
+        )
+        return out
+
+
+@dataclasses.dataclass
+class SolverParameter:
+    """Training config (reference: caffe.proto:102).  Field defaults follow
+    the proto defaults used by SGDSolver (reference:
+    caffe/src/caffe/solvers/sgd_solver.cpp, caffe/src/caffe/solver.cpp)."""
+
+    net: str | None = None
+    net_param: NetParameter | None = None
+    train_net: str | None = None
+    test_net: list[str] = dataclasses.field(default_factory=list)
+    train_net_param: NetParameter | None = None
+    test_net_param: list[NetParameter] = dataclasses.field(default_factory=list)
+    train_state: NetState = dataclasses.field(default_factory=lambda: NetState(Phase.TRAIN))
+    test_state: list[NetState] = dataclasses.field(default_factory=list)
+
+    test_iter: list[int] = dataclasses.field(default_factory=list)
+    test_interval: int = 0
+    test_initialization: bool = True
+    base_lr: float = 0.01
+    display: int = 0
+    average_loss: int = 1
+    max_iter: int = 0
+    iter_size: int = 1
+    lr_policy: str = "fixed"
+    gamma: float = 0.0
+    power: float = 0.0
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    regularization_type: str = "L2"
+    stepsize: int = 0
+    stepvalue: list[int] = dataclasses.field(default_factory=list)
+    clip_gradients: float = -1.0
+    snapshot: int = 0
+    snapshot_prefix: str = ""
+    random_seed: int = -1
+    solver_type: str = "SGD"  # SGD|NESTEROV|ADAGRAD|RMSPROP|ADADELTA|ADAM
+    delta: float = 1e-8
+    momentum2: float = 0.999
+    rms_decay: float = 0.99
+    debug_info: bool = False
+
+    @classmethod
+    def from_pmsg(cls, m: PMessage) -> "SolverParameter":
+        def net_of(key: str) -> NetParameter | None:
+            sub = m.get(key)
+            return NetParameter.from_pmsg(sub) if isinstance(sub, PMessage) else None
+
+        solver_type = m.get("type", m.get("solver_type", "SGD"))
+        sp = cls(
+            net=m.get("net"),
+            net_param=net_of("net_param"),
+            train_net=m.get("train_net"),
+            test_net=[str(t) for t in m.get_all("test_net")],
+            train_net_param=net_of("train_net_param"),
+            test_net_param=[NetParameter.from_pmsg(t) for t in m.get_all("test_net_param")],
+            test_iter=[int(t) for t in m.get_all("test_iter")],
+            test_interval=int(m.get("test_interval", 0)),
+            test_initialization=bool(m.get("test_initialization", True)),
+            base_lr=float(m.get("base_lr", 0.01)),
+            display=int(m.get("display", 0)),
+            average_loss=int(m.get("average_loss", 1)),
+            max_iter=int(m.get("max_iter", 0)),
+            iter_size=int(m.get("iter_size", 1)),
+            lr_policy=str(m.get("lr_policy", "fixed")),
+            gamma=float(m.get("gamma", 0.0)),
+            power=float(m.get("power", 0.0)),
+            momentum=float(m.get("momentum", 0.0)),
+            weight_decay=float(m.get("weight_decay", 0.0)),
+            regularization_type=str(m.get("regularization_type", "L2")),
+            stepsize=int(m.get("stepsize", 0)),
+            stepvalue=[int(v) for v in m.get_all("stepvalue")],
+            clip_gradients=float(m.get("clip_gradients", -1.0)),
+            snapshot=int(m.get("snapshot", 0)),
+            snapshot_prefix=str(m.get("snapshot_prefix", "")),
+            random_seed=int(m.get("random_seed", -1)),
+            solver_type=str(solver_type).upper(),
+            delta=float(m.get("delta", 1e-8)),
+            momentum2=float(m.get("momentum2", 0.999)),
+            rms_decay=float(m.get("rms_decay", 0.99)),
+            debug_info=bool(m.get("debug_info", False)),
+        )
+        if m.has("train_state"):
+            sp.train_state = NetState.from_pmsg(m.get("train_state"))
+            sp.train_state.phase = Phase.TRAIN
+        for ts in m.get_all("test_state"):
+            st = NetState.from_pmsg(ts)
+            st.phase = Phase.TEST
+            sp.test_state.append(st)
+        return sp
+
+
+# ---------------------------------------------------------------------------
+# Loading helpers — the ProtoLoader analog
+# (reference: src/main/scala/libs/ProtoLoader.scala:9-57)
+# ---------------------------------------------------------------------------
+
+def load_net_prototxt(path_or_text: str) -> NetParameter:
+    """Parse a net prototxt from a file path or literal text
+    (ProtoLoader.loadNetPrototxt, reference: ProtoLoader.scala:20)."""
+    text = _read(path_or_text)
+    return NetParameter.from_pmsg(parse(text))
+
+
+def load_solver_prototxt(path_or_text: str) -> SolverParameter:
+    """ProtoLoader.loadSolverPrototxt (reference: ProtoLoader.scala:9)."""
+    text = _read(path_or_text)
+    return SolverParameter.from_pmsg(parse(text))
+
+
+def load_solver_prototxt_with_net(
+    solver_path_or_text: str,
+    net: NetParameter,
+    snapshot_prefix: str | None = None,
+) -> SolverParameter:
+    """Embed a net into a solver config, clearing snapshotting unless a
+    prefix is given (ProtoLoader.loadSolverPrototxtWithNet, reference:
+    ProtoLoader.scala:31-43)."""
+    sp = load_solver_prototxt(solver_path_or_text)
+    sp.net = None
+    sp.train_net = None
+    sp.test_net = []
+    sp.net_param = net
+    if snapshot_prefix is None:
+        sp.snapshot = 0
+        sp.snapshot_prefix = ""
+    else:
+        sp.snapshot_prefix = snapshot_prefix
+    return sp
+
+
+def replace_data_layers(
+    net: NetParameter,
+    train_batch_size: int,
+    test_batch_size: int,
+    channels: int,
+    height: int,
+    width: int,
+) -> NetParameter:
+    """Swap the first data layer(s) for host-fed input layers, one per phase
+    (ProtoLoader.replaceDataLayers, reference: ProtoLoader.scala:50-57).
+
+    In the reference this installs ``JavaData`` layers whose forward calls
+    back into the JVM; here the layer type marks a graph input fed by the
+    host pipeline via ``device_put`` — the graph sees a plain input blob.
+    """
+    data_types = {
+        "Data", "ImageData", "WindowData", "MemoryData", "HDF5Data",
+        "DummyData", "JavaData", "Input",
+    }
+    kept = [l for l in net.layer if l.type not in data_types]
+    tops = ["data", "label"]
+    for l in net.layer:
+        if l.type in data_types and l.top:
+            tops = l.top
+            break
+
+    def make(phase: Phase, batch: int) -> LayerParameter:
+        lp = LayerParameter(
+            name=f"{tops[0]}_{phase.name.lower()}",
+            type="JavaData",
+            top=list(tops),
+            phase=phase,
+        )
+        shape = PMessage()
+        for d in (batch, channels, height, width):
+            shape.add("dim", d)
+        jd = PMessage()
+        jd.add("shape", shape)
+        if len(tops) > 1:
+            lshape = PMessage()
+            lshape.add("dim", batch)
+            jd.add("label_shape", lshape)
+        lp.params["java_data_param"] = jd
+        return lp
+
+    out = dataclasses.replace(net)
+    out.layer = [make(Phase.TRAIN, train_batch_size), make(Phase.TEST, test_batch_size)] + kept
+    return out
+
+
+def _read(path_or_text: str) -> str:
+    if "\n" in path_or_text or "{" in path_or_text:
+        return path_or_text
+    with open(path_or_text) as f:
+        return f.read()

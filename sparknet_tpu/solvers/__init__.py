@@ -1,0 +1,3 @@
+from .lr_policies import learning_rate
+from .update_rules import SolverUpdate, make_update_rule
+from .solver import Solver

@@ -1,0 +1,212 @@
+"""Solver update rules — the six Caffe solvers as pure functions.
+
+Mirrors the solver hierarchy (reference:
+caffe/src/caffe/solvers/sgd_solver.cpp ComputeUpdateValue:207,
+nesterov_solver.cpp, adagrad_solver.cpp, rmsprop_solver.cpp,
+adadelta_solver.cpp, adam_solver.cpp; dispatch via solver_factory.hpp).
+``ApplyUpdate`` order is preserved exactly (sgd_solver.cpp:102-143):
+ClipGradients (global L2, on raw accumulated grads) → Normalize (÷iter_size)
+→ Regularize (L2/L1 via weight_decay·decay_mult) → per-rule update with
+local_rate = rate·lr_mult.
+
+State is a pytree mirroring the params pytree (history blobs, reference:
+sgd_solver.cpp history_ / update_ / temp_), so the whole update jits and
+shards with the params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..proto.caffe_pb import SolverParameter
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverUpdate:
+    """A pure (params, grads, state, rate, step) -> (params, state) rule."""
+
+    name: str
+    init: Callable[[Pytree], Pytree]
+    apply: Callable[..., tuple[Pytree, Pytree]]
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _global_l2(tree: Pytree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(x.astype(jnp.float32) ** 2) for x in leaves))
+
+
+def preprocess_grads(sp: SolverParameter, params: Pytree, grads: Pytree,
+                     lr_mults: Pytree | None, decay_mults: Pytree | None
+                     ) -> Pytree:
+    """ClipGradients → Normalize → Regularize (reference:
+    sgd_solver.cpp:81-205).  Returns adjusted grads."""
+    if sp.clip_gradients > 0:
+        norm = _global_l2(grads)
+        scale = jnp.minimum(1.0, sp.clip_gradients / jnp.maximum(norm, 1e-12))
+        grads = _tmap(lambda g: g * scale, grads)
+    if sp.iter_size > 1:
+        grads = _tmap(lambda g: g / sp.iter_size, grads)
+    if sp.weight_decay > 0:
+        dm = decay_mults if decay_mults is not None else _tmap(
+            lambda g: jnp.asarray(1.0), grads)
+        if sp.regularization_type == "L2":
+            grads = _tmap(lambda g, p, d: g + sp.weight_decay * d * p,
+                          grads, params, dm)
+        elif sp.regularization_type == "L1":
+            grads = _tmap(lambda g, p, d: g + sp.weight_decay * d * jnp.sign(p),
+                          grads, params, dm)
+        else:
+            raise ValueError(
+                f"unknown regularization_type {sp.regularization_type!r}")
+    return grads
+
+
+def make_update_rule(sp: SolverParameter) -> SolverUpdate:
+    t = sp.solver_type
+    if t == "SGD":
+        return _sgd(sp)
+    if t == "NESTEROV":
+        return _nesterov(sp)
+    if t == "ADAGRAD":
+        return _adagrad(sp)
+    if t == "RMSPROP":
+        return _rmsprop(sp)
+    if t == "ADADELTA":
+        return _adadelta(sp)
+    if t == "ADAM":
+        return _adam(sp)
+    raise ValueError(f"unknown solver type {t!r}")
+
+
+def _zeros_like_tree(params: Pytree) -> Pytree:
+    return _tmap(jnp.zeros_like, params)
+
+
+def _local_rates(rate, lr_mults, grads):
+    if lr_mults is None:
+        return _tmap(lambda g: rate, grads)
+    return _tmap(lambda m: rate * m, lr_mults)
+
+
+def _sgd(sp: SolverParameter) -> SolverUpdate:
+    """v ← μv + local_rate·g;  p ← p − v (sgd_solver.cpp:207-244)."""
+
+    def init(params):
+        return {"history": _zeros_like_tree(params)}
+
+    def apply(params, grads, state, rate, step, lr_mults=None):
+        lr = _local_rates(rate, lr_mults, grads)
+        hist = _tmap(lambda h, g, r: sp.momentum * h + r * g,
+                     state["history"], grads, lr)
+        new_params = _tmap(lambda p, h: p - h, params, hist)
+        return new_params, {"history": hist}
+
+    return SolverUpdate("SGD", init, apply)
+
+
+def _nesterov(sp: SolverParameter) -> SolverUpdate:
+    """v' ← μv + r·g;  p ← p − ((1+μ)v' − μv) (nesterov_solver.cpp)."""
+
+    def init(params):
+        return {"history": _zeros_like_tree(params)}
+
+    def apply(params, grads, state, rate, step, lr_mults=None):
+        lr = _local_rates(rate, lr_mults, grads)
+        old = state["history"]
+        hist = _tmap(lambda h, g, r: sp.momentum * h + r * g, old, grads, lr)
+        upd = _tmap(lambda hn, ho: (1 + sp.momentum) * hn - sp.momentum * ho,
+                    hist, old)
+        return _tmap(lambda p, u: p - u, params, upd), {"history": hist}
+
+    return SolverUpdate("NESTEROV", init, apply)
+
+
+def _adagrad(sp: SolverParameter) -> SolverUpdate:
+    """h ← h + g²;  p ← p − r·g/(√h + δ) (adagrad_solver.cpp)."""
+
+    def init(params):
+        return {"history": _zeros_like_tree(params)}
+
+    def apply(params, grads, state, rate, step, lr_mults=None):
+        lr = _local_rates(rate, lr_mults, grads)
+        hist = _tmap(lambda h, g: h + g * g, state["history"], grads)
+        upd = _tmap(lambda g, h, r: r * g / (jnp.sqrt(h) + sp.delta),
+                    grads, hist, lr)
+        return _tmap(lambda p, u: p - u, params, upd), {"history": hist}
+
+    return SolverUpdate("ADAGRAD", init, apply)
+
+
+def _rmsprop(sp: SolverParameter) -> SolverUpdate:
+    """h ← ρh + (1−ρ)g²;  p ← p − r·g/(√h + δ) (rmsprop_solver.cpp)."""
+
+    def init(params):
+        return {"history": _zeros_like_tree(params)}
+
+    def apply(params, grads, state, rate, step, lr_mults=None):
+        lr = _local_rates(rate, lr_mults, grads)
+        rd = sp.rms_decay
+        hist = _tmap(lambda h, g: rd * h + (1 - rd) * g * g,
+                     state["history"], grads)
+        upd = _tmap(lambda g, h, r: r * g / (jnp.sqrt(h) + sp.delta),
+                    grads, hist, lr)
+        return _tmap(lambda p, u: p - u, params, upd), {"history": hist}
+
+    return SolverUpdate("RMSPROP", init, apply)
+
+
+def _adadelta(sp: SolverParameter) -> SolverUpdate:
+    """Accumulate g² and Δ² with momentum as decay; update scaled by
+    √((Δ²+δ)/(g²+δ)) × local_rate (adadelta_solver.cpp)."""
+
+    def init(params):
+        return {"sq_grad": _zeros_like_tree(params),
+                "sq_update": _zeros_like_tree(params)}
+
+    def apply(params, grads, state, rate, step, lr_mults=None):
+        lr = _local_rates(rate, lr_mults, grads)
+        mu = sp.momentum
+        sq_g = _tmap(lambda h, g: mu * h + (1 - mu) * g * g,
+                     state["sq_grad"], grads)
+        upd = _tmap(
+            lambda g, hg, hu: g * jnp.sqrt((hu + sp.delta) / (hg + sp.delta)),
+            grads, sq_g, state["sq_update"])
+        sq_u = _tmap(lambda h, u: mu * h + (1 - mu) * u * u,
+                     state["sq_update"], upd)
+        scaled = _tmap(lambda u, r: r * u, upd, lr)
+        return (_tmap(lambda p, u: p - u, params, scaled),
+                {"sq_grad": sq_g, "sq_update": sq_u})
+
+    return SolverUpdate("ADADELTA", init, apply)
+
+
+def _adam(sp: SolverParameter) -> SolverUpdate:
+    """m ← β₁m + (1−β₁)g; v ← β₂v + (1−β₂)g²;
+    p ← p − r·√(1−β₂ᵗ)/(1−β₁ᵗ)·m/(√v + δ) (adam_solver.cpp:74-113 —
+    note Caffe adds δ outside the sqrt and bias-corrects via the rate)."""
+
+    def init(params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
+
+    def apply(params, grads, state, rate, step, lr_mults=None):
+        lr = _local_rates(rate, lr_mults, grads)
+        b1, b2 = sp.momentum, sp.momentum2
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        m = _tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+        correction = jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+        upd = _tmap(lambda m_, v_, r: r * correction * m_ / (jnp.sqrt(v_) + sp.delta),
+                    m, v, lr)
+        return _tmap(lambda p, u: p - u, params, upd), {"m": m, "v": v}
+
+    return SolverUpdate("ADAM", init, apply)

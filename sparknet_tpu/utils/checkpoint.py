@@ -1,0 +1,62 @@
+"""Checkpoint IO.
+
+The reference snapshots model + solver state (momentum history, iter) as
+binaryproto or HDF5 (reference: caffe/src/caffe/solver.cpp:447-459,
+solvers/sgd_solver.cpp:242-296) and restores via ``Solver::Restore``
+(solver.cpp:510).  Here a checkpoint is any pytree, written as an ``.npz``
+of flattened leaves plus a pickled treedef-free key list — no pickle of
+arbitrary objects, so checkpoints are portable and safe to load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str, out: dict[str, np.ndarray],
+             meta: dict[str, Any]) -> None:
+    if isinstance(tree, dict):
+        meta[prefix] = {"kind": "dict", "keys": sorted(tree.keys())}
+        for k in sorted(tree.keys()):
+            _flatten(tree[k], f"{prefix}/{k}", out, meta)
+    elif isinstance(tree, (list, tuple)):
+        meta[prefix] = {"kind": "list", "len": len(tree)}
+        for i, v in enumerate(tree):
+            _flatten(v, f"{prefix}/{i}", out, meta)
+    else:
+        meta[prefix] = {"kind": "leaf"}
+        out[prefix] = np.asarray(tree)
+
+
+def _unflatten(prefix: str, data: dict[str, np.ndarray],
+               meta: dict[str, Any]) -> Any:
+    info = meta[prefix]
+    if info["kind"] == "dict":
+        return {k: _unflatten(f"{prefix}/{k}", data, meta) for k in info["keys"]}
+    if info["kind"] == "list":
+        return [_unflatten(f"{prefix}/{i}", data, meta) for i in range(info["len"])]
+    return data[prefix]
+
+
+def save_checkpoint(path: str, tree: Any) -> None:
+    arrays: dict[str, np.ndarray] = {}
+    meta: dict[str, Any] = {}
+    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    _flatten(host_tree, "root", arrays, meta)
+    tmp = path + ".tmp"
+    np.savez(tmp, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    # np.savez appends .npz to the temp name
+    os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
+
+
+def load_checkpoint(path: str) -> Any:
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        data = {k: z[k] for k in z.files if k != "__meta__"}
+    return _unflatten("root", data, meta)

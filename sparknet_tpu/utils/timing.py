@@ -1,0 +1,65 @@
+"""Wall-clock timing utilities.
+
+The analog of Caffe's ``Timer``/``CPUTimer`` (reference:
+caffe/src/caffe/util/benchmark.cpp:26-145, CUDA events) and the app-level
+phase logging (reference: src/main/scala/apps/CifarApp.scala:41-50 elapsed
+seconds per phase).  Device timing uses ``block_until_ready`` fences instead
+of CUDA events.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+
+
+class Timer:
+    def __init__(self) -> None:
+        self._start = 0.0
+        self._elapsed = 0.0
+        self._running = False
+
+    def start(self) -> "Timer":
+        self._start = time.perf_counter()
+        self._running = True
+        return self
+
+    def stop(self, fence: Any = None) -> float:
+        """Stop; optionally fence on a jax value first so device work is
+        included (the CUDA-event analog)."""
+        if fence is not None:
+            jax.block_until_ready(fence)
+        if self._running:
+            self._elapsed += time.perf_counter() - self._start
+            self._running = False
+        return self._elapsed
+
+    def seconds(self) -> float:
+        if self._running:
+            return self._elapsed + (time.perf_counter() - self._start)
+        return self._elapsed
+
+    def milli_seconds(self) -> float:
+        return self.seconds() * 1e3
+
+    def reset(self) -> None:
+        self._elapsed = 0.0
+        self._running = False
+
+
+class PhaseLogger:
+    """Append-only phase log with elapsed seconds — the
+    ``training_log_<ts>.txt`` analog (reference: CifarApp.scala:41-50)."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.t0 = time.time()
+
+    def log(self, msg: str) -> None:
+        line = f"{time.time() - self.t0:10.3f}s  {msg}"
+        print(line)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")

@@ -1,0 +1,35 @@
+"""Test rig: force the host-CPU backend with 8 virtual devices.
+
+This is the analog of the reference's CPU_ONLY cmake fallback
+(reference: libccaffe/CMakeLists.txt:44-47) — it lets every test, including
+the multi-chip collective paths, run with no TPU attached (SURVEY.md §4.3).
+Must run before jax initializes its backends, hence the env mutation at
+import time of conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"  # the axon plugin ignores JAX_PLATFORMS
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    import jax
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.default_rng(0)

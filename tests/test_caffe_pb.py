@@ -1,0 +1,119 @@
+"""Typed schema layer tests: solver/net parsing, phase filtering, data-layer
+replacement, V1 upgrade (reference parity: ProtoLoader.scala,
+util/upgrade_proto.cpp)."""
+
+from sparknet_tpu.proto import (
+    NetState, Phase,
+    load_net_prototxt, load_solver_prototxt, load_solver_prototxt_with_net,
+    replace_data_layers,
+)
+from sparknet_tpu.proto.caffe_pb import NetParameter, SolverParameter
+from sparknet_tpu.proto.textformat import parse
+
+SOLVER_TXT = """
+net: "train_val.prototxt"
+test_iter: 100
+test_interval: 500
+base_lr: 0.01
+lr_policy: "step"
+gamma: 0.1
+stepsize: 100000
+display: 20
+max_iter: 450000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "model"
+solver_mode: GPU
+"""
+
+NET_TXT = """
+name: "tiny"
+layer {
+  name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } }
+}
+layer {
+  name: "conv" type: "Convolution" bottom: "data" top: "conv"
+  convolution_param { num_output: 4 kernel_size: 3 }
+}
+layer {
+  name: "acc" type: "Accuracy" bottom: "conv" bottom: "label" top: "acc"
+  include { phase: TEST }
+}
+layer {
+  name: "trainonly" type: "ReLU" bottom: "conv" top: "conv"
+  exclude { phase: TEST }
+}
+"""
+
+
+def test_solver_parse():
+    sp = load_solver_prototxt(SOLVER_TXT)
+    assert sp.base_lr == 0.01
+    assert sp.lr_policy == "step"
+    assert sp.gamma == 0.1
+    assert sp.stepsize == 100000
+    assert sp.momentum == 0.9
+    assert sp.weight_decay == 0.0005
+    assert sp.test_iter == [100]
+    assert sp.solver_type == "SGD"
+    assert sp.snapshot == 10000
+
+
+def test_solver_with_net_clears_snapshot():
+    net = load_net_prototxt(NET_TXT)
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, net)
+    assert sp.snapshot == 0 and sp.snapshot_prefix == ""
+    assert sp.net is None and sp.net_param is net
+    sp2 = load_solver_prototxt_with_net(SOLVER_TXT, net, snapshot_prefix="/tmp/x")
+    assert sp2.snapshot_prefix == "/tmp/x"
+
+
+def test_phase_filtering():
+    net = load_net_prototxt(NET_TXT)
+    train = net.filtered(NetState(Phase.TRAIN))
+    test = net.filtered(NetState(Phase.TEST))
+    train_names = [l.name for l in train.layer]
+    test_names = [l.name for l in test.layer]
+    assert "acc" not in train_names and "trainonly" in train_names
+    assert "acc" in test_names and "trainonly" not in test_names
+
+
+def test_replace_data_layers():
+    net_txt = """
+    name: "x"
+    layer { name: "d" type: "Data" top: "data" top: "label" }
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+            inner_product_param { num_output: 10 } }
+    """
+    net = load_net_prototxt(net_txt)
+    out = replace_data_layers(net, 16, 8, 3, 32, 32)
+    assert out.layer[0].type == "JavaData"
+    assert out.layer[0].phase == Phase.TRAIN
+    assert out.layer[1].phase == Phase.TEST
+    shape = out.layer[0].sub("java_data_param").get("shape").get_all("dim")
+    assert shape == [16, 3, 32, 32]
+    assert [l.name for l in out.layer[2:]] == ["ip"]
+
+
+def test_v1_layer_upgrade():
+    txt = """
+    name: "old"
+    layers { name: "c" type: CONVOLUTION bottom: "data" top: "c"
+             blobs_lr: 1 blobs_lr: 2 weight_decay: 1 weight_decay: 0
+             convolution_param { num_output: 2 kernel_size: 1 } }
+    layers { name: "s" type: SOFTMAX_LOSS bottom: "c" bottom: "label" }
+    """
+    net = NetParameter.from_pmsg(parse(txt))
+    assert net.layer[0].type == "Convolution"
+    assert net.layer[1].type == "SoftmaxWithLoss"
+    assert [p.lr_mult for p in net.layer[0].param] == [1.0, 2.0]
+    assert [p.decay_mult for p in net.layer[0].param] == [1.0, 0.0]
+
+
+def test_legacy_input_dim():
+    txt = 'input: "data"\ninput_dim: 1\ninput_dim: 3\ninput_dim: 4\ninput_dim: 4'
+    net = load_net_prototxt(txt)
+    assert net.input == ["data"]
+    assert net.input_shape[0].dim == [1, 3, 4, 4]

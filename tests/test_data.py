@@ -1,0 +1,101 @@
+"""Data-plane tests (loaders, transforms, sampler, prefetch, partitions) —
+the NDArraySpec/MinibatchSamplerSpec analogs (reference:
+src/test/scala/libs/MinibatchSamplerSpec.scala)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import (
+    MinibatchSampler, PartitionedDataset, PrefetchIterator,
+    center_crop, compute_mean_image, load_cifar10_binary, load_mnist_idx,
+    make_minibatches, random_crop_mirror, subtract_mean,
+    write_cifar10_binary, write_mnist_idx,
+)
+from sparknet_tpu.data.minibatch import batch_feed
+
+
+def test_cifar_binary_roundtrip(tmp_path, np_rng):
+    images = np_rng.integers(0, 256, size=(10, 3, 32, 32))
+    labels = np_rng.integers(0, 10, size=10)
+    p = str(tmp_path / "batch.bin")
+    write_cifar10_binary(p, images, labels)
+    x, y = load_cifar10_binary(p)
+    np.testing.assert_array_equal(x, images.astype(np.float32))
+    np.testing.assert_array_equal(y, labels)
+    xs, ys = load_cifar10_binary([p, p], shuffle=True, seed=1)
+    assert len(ys) == 20
+
+
+def test_mnist_idx_roundtrip(tmp_path, np_rng):
+    images = np_rng.integers(0, 256, size=(7, 1, 28, 28))
+    labels = np_rng.integers(0, 10, size=7)
+    ip, lp = str(tmp_path / "im.idx3"), str(tmp_path / "lb.idx1")
+    write_mnist_idx(ip, lp, images, labels)
+    x, y = load_mnist_idx(ip, lp)
+    np.testing.assert_array_equal(x, images.astype(np.float32))
+    np.testing.assert_array_equal(y, labels)
+
+
+def test_make_minibatches_drops_remainder(np_rng):
+    x = np_rng.normal(size=(10, 3, 4, 4)).astype(np.float32)
+    y = np.arange(10)
+    bs = make_minibatches(x, y, 4)
+    assert len(bs) == 2  # 10 // 4, remainder dropped
+    np.testing.assert_array_equal(bs[1][1], [4, 5, 6, 7])
+
+
+def test_minibatch_sampler_contiguous_run(np_rng):
+    batches = [(np.full((2, 1), i), np.full((2,), i)) for i in range(10)]
+    s = MinibatchSampler(batches, num=4, seed=3)
+    got = [int(lab[0]) for _, lab in s]
+    assert len(got) == 4
+    assert got == list(range(got[0], got[0] + 4))  # contiguous
+    with pytest.raises(ValueError):
+        MinibatchSampler(batches, num=11)
+
+
+def test_mean_and_crops(np_rng):
+    imgs = np_rng.integers(0, 256, size=(8, 3, 8, 8)).astype(np.float32)
+    mean = compute_mean_image(imgs)
+    assert mean.shape == (3, 8, 8)
+    np.testing.assert_allclose(subtract_mean(imgs, mean).mean(axis=0),
+                               np.zeros((3, 8, 8)), atol=1e-3)
+    cc = center_crop(imgs, 4)
+    np.testing.assert_array_equal(cc, imgs[:, :, 2:6, 2:6])
+    rng = np.random.default_rng(0)
+    rc = random_crop_mirror(imgs, 4, rng, mean=mean)
+    assert rc.shape == (8, 3, 4, 4)
+
+
+def test_batch_feed_applies_preprocess():
+    batches = [(np.ones((2, 3, 4, 4)), np.zeros(2))]
+    feed = list(batch_feed(iter(batches), preprocess=lambda x: x * 2))
+    np.testing.assert_array_equal(feed[0]["data"],
+                                  2 * np.ones((2, 3, 4, 4), np.float32))
+
+
+def test_prefetch_iterator_order_and_error():
+    out = list(PrefetchIterator(iter(range(100)), depth=4))
+    assert out == list(range(100))
+
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = PrefetchIterator(bad())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+
+def test_partitioned_dataset():
+    ds = PartitionedDataset.from_items(range(10), 3)
+    assert ds.num_partitions == 3
+    assert ds.count() == 10
+    assert sorted(ds.partition_sizes(), reverse=True) == [4, 3, 3]
+    doubled = ds.map(lambda x: 2 * x)
+    assert doubled.reduce(lambda a, b: a + b) == 90
+    co = ds.coalesce(2)
+    assert co.num_partitions == 2 and co.count() == 10

@@ -1,0 +1,145 @@
+"""Graph compiler tests — the analog of the reference's net-construction
+tests (test_net.cpp: graph build/sharing) and LayerSpec (DSL + prototxt nets
+load and run; reference: src/test/scala/libs/LayerSpec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.graph import Net
+from sparknet_tpu.models import lenet, cifar10_quick
+from sparknet_tpu.proto import NetState, Phase, load_net_prototxt
+
+
+def test_lenet_builds_and_runs(rng):
+    net = Net(lenet(train_batch=4, test_batch=4), NetState(Phase.TRAIN))
+    assert net.input_blobs == {"data": (4, 1, 28, 28), "label": (4,)}
+    params = net.init(rng)
+    assert params["conv1"][0].shape == (20, 1, 5, 5)
+    assert params["ip1"][0].shape == (500, 50 * 4 * 4)
+    out = net.apply(params, {
+        "data": jnp.zeros((4, 1, 28, 28)),
+        "label": jnp.zeros((4,)),
+    }, rng=rng)
+    assert out.loss.shape == ()
+    assert float(out.loss) == pytest.approx(np.log(10), rel=0.05)
+
+
+def test_phase_split():
+    train = Net(lenet(4, 8), NetState(Phase.TRAIN))
+    test = Net(lenet(4, 8), NetState(Phase.TEST))
+    assert "accuracy" not in train.layer_names()
+    assert "accuracy" in test.layer_names()
+    # test batch size differs
+    assert test.input_blobs["data"] == (8, 1, 28, 28)
+
+
+def test_test_net_shares_train_params(rng):
+    train = Net(lenet(4, 4), NetState(Phase.TRAIN))
+    test = Net(lenet(4, 4), NetState(Phase.TEST))
+    params = train.init(rng)
+    out = test.apply(params, {
+        "data": jnp.zeros((4, 1, 28, 28)),
+        "label": jnp.zeros((4,)),
+    }, train=False)
+    assert "accuracy" in out.blobs
+
+
+def test_inplace_layers(rng):
+    # relu1 in lenet is in-place on ip1
+    net = Net(lenet(2, 2), NetState(Phase.TRAIN))
+    params = net.init(rng)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 1, 28, 28))
+    blobs = net.apply_all(params, {"data": x, "label": jnp.zeros((2,))},
+                          rng=jax.random.PRNGKey(2))
+    assert np.all(np.asarray(blobs["ip1"]) >= 0)
+
+
+def test_unknown_bottom_raises():
+    txt = """
+    name: "bad"
+    layer { name: "r" type: "ReLU" bottom: "nope" top: "r" }
+    """
+    with pytest.raises(ValueError, match="bottom 'nope' unknown"):
+        Net(load_net_prototxt(txt))
+
+
+def test_prototxt_net_runs(rng):
+    txt = """
+    name: "toy"
+    layer { name: "data" type: "Input" top: "data"
+            input_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+            convolution_param { num_output: 4 kernel_size: 3 pad: 1
+                                weight_filler { type: "xavier" } } }
+    layer { name: "relu" type: "ReLU" bottom: "conv" top: "conv" }
+    layer { name: "pool" type: "Pooling" bottom: "conv" top: "pool"
+            pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+    """
+    net = Net(load_net_prototxt(txt))
+    params = net.init(rng)
+    out = net.apply(params, {"data": jnp.ones((2, 3, 8, 8))}, train=False)
+    assert out.blobs["pool"].shape == (2, 4, 4, 4)
+
+
+def test_param_sharing_siamese(rng):
+    txt = """
+    name: "siamese"
+    layer { name: "d" type: "Input" top: "a" top: "b"
+            input_param { shape { dim: 2 dim: 4 } } }
+    layer { name: "ip_a" type: "InnerProduct" bottom: "a" top: "fa"
+            param { name: "w" } param { name: "bias" }
+            inner_product_param { num_output: 3
+                                  weight_filler { type: "xavier" } } }
+    layer { name: "ip_b" type: "InnerProduct" bottom: "b" top: "fb"
+            param { name: "w" } param { name: "bias" }
+            inner_product_param { num_output: 3
+                                  weight_filler { type: "xavier" } } }
+    """
+    net = Net(load_net_prototxt(txt))
+    params = net.init(rng)
+    assert "ip_a" in params and "ip_b" not in params  # shared -> one owner
+    x = jax.random.normal(rng, (2, 4))
+    out = net.apply(params, {"a": x, "b": x}, train=False)
+    np.testing.assert_allclose(np.asarray(out.blobs["fa"]),
+                               np.asarray(out.blobs["fb"]), rtol=1e-6)
+
+
+def test_jit_apply(rng):
+    net = Net(cifar10_quick(4, 4), NetState(Phase.TRAIN))
+    params = net.init(rng)
+
+    @jax.jit
+    def fwd(params, data, label):
+        return net.apply(params, {"data": data, "label": label},
+                         rng=jax.random.PRNGKey(0)).loss
+
+    loss = fwd(params, jnp.zeros((4, 3, 32, 32)), jnp.zeros((4,)))
+    assert np.isfinite(float(loss))
+
+
+def test_googlenet_builds(rng):
+    from sparknet_tpu.models import googlenet
+    net = Net(googlenet(2, 2, crop=224), NetState(Phase.TRAIN))
+    params = net.init(rng)
+    # 3 losses in train phase
+    losses = [n for n in net.layer_names() if "loss" in n.lower()
+              and "classifier" not in n and "fc" not in n.lower()]
+    out = net.apply(params, {
+        "data": jnp.zeros((2, 3, 224, 224)), "label": jnp.zeros((2,))},
+        rng=rng)
+    # total loss ≈ ln(1000)·(1 + 0.3 + 0.3)
+    assert float(out.loss) == pytest.approx(np.log(1000) * 1.6, rel=0.05)
+
+
+def test_weight_collection_math(rng):
+    from sparknet_tpu.graph.net import weights_add, weights_scalar_divide
+    net = Net(lenet(2, 2), NetState(Phase.TRAIN))
+    a = net.init(rng)
+    b = net.init(jax.random.PRNGKey(7))
+    s = weights_scalar_divide(weights_add(a, b), 2.0)
+    np.testing.assert_allclose(
+        np.asarray(s["conv1"][0]),
+        (np.asarray(a["conv1"][0]) + np.asarray(b["conv1"][0])) / 2,
+        rtol=1e-6)

@@ -1,0 +1,458 @@
+"""Per-op tests: Caffe-exact shape inference, value checks against naive
+numpy references, and gradient checks via jax.test_util.check_grads — the
+GradientChecker analog (reference:
+caffe/include/caffe/test/test_gradient_check_util.hpp:19)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.test_util import check_grads
+
+from sparknet_tpu.models.dsl import layer
+from sparknet_tpu.ops import get_layer_impl
+from sparknet_tpu.ops.vision import pool_output_size
+
+
+def make(type_, **type_params):
+    return layer("t", type_, ["b0"], ["t0"], **type_params)
+
+
+def apply_op(lp, bottoms, params=(), train=True, rng=None):
+    impl = get_layer_impl(lp.type)
+    out = impl.apply(lp, list(params), [jnp.asarray(b) for b in bottoms],
+                     train, rng)
+    if getattr(impl, "has_state", False):
+        out = out[0]
+    return out
+
+
+# -- convolution ------------------------------------------------------------
+
+def test_conv_shapes_caffe_floor(rng):
+    # (in + 2p - k)/s + 1 floor: caffe base_conv_layer.cpp
+    lp = make("Convolution", convolution_param={
+        "num_output": 8, "kernel_size": 3, "stride": 2, "pad": 1})
+    impl = get_layer_impl("Convolution")
+    assert impl.out_shapes(lp, [(2, 3, 11, 11)]) == [(2, 8, 6, 6)]
+    params = impl.init(rng, lp, [(2, 3, 11, 11)])
+    assert params[0].shape == (8, 3, 3, 3)
+    assert params[1].shape == (8,)
+    y = apply_op(lp, [np.ones((2, 3, 11, 11), np.float32)], params)
+    assert y[0].shape == (2, 8, 6, 6)
+
+
+def test_conv_matches_numpy(rng, np_rng):
+    x = np_rng.normal(size=(1, 2, 5, 5)).astype(np.float32)
+    w = np_rng.normal(size=(3, 2, 3, 3)).astype(np.float32)
+    b = np_rng.normal(size=(3,)).astype(np.float32)
+    lp = make("Convolution", convolution_param={
+        "num_output": 3, "kernel_size": 3})
+    y = np.asarray(apply_op(lp, [x], [jnp.asarray(w), jnp.asarray(b)])[0])
+    # naive correlation
+    ref = np.zeros((1, 3, 3, 3), np.float32)
+    for o in range(3):
+        for i in range(3):
+            for j in range(3):
+                patch = x[0, :, i:i + 3, j:j + 3]
+                ref[0, o, i, j] = np.sum(patch * w[o]) + b[o]
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_conv(rng):
+    lp = make("Convolution", convolution_param={
+        "num_output": 4, "kernel_size": 1, "group": 2})
+    impl = get_layer_impl("Convolution")
+    params = impl.init(rng, lp, [(1, 4, 2, 2)])
+    assert params[0].shape == (4, 2, 1, 1)
+    y = apply_op(lp, [np.ones((1, 4, 2, 2), np.float32)], params)
+    assert y[0].shape == (1, 4, 2, 2)
+
+
+def test_conv_gradients(rng, np_rng):
+    lp = make("Convolution", convolution_param={
+        "num_output": 2, "kernel_size": 3, "pad": 1, "stride": 2})
+    impl = get_layer_impl("Convolution")
+    params = impl.init(rng, lp, [(2, 3, 6, 6)])
+    x = jnp.asarray(np_rng.normal(size=(2, 3, 6, 6)).astype(np.float32))
+
+    def f(w, b, x):
+        return impl.apply(lp, [w, b], [x], True, None)[0]
+
+    check_grads(f, (params[0], params[1], x), order=1, modes=["rev"],
+                atol=1e-2, rtol=1e-2)
+
+
+def test_deconv_shape_and_transpose_equivalence(rng, np_rng):
+    # deconv out = s(in-1) + k - 2p (deconv_layer.cpp)
+    lp = make("Deconvolution", convolution_param={
+        "num_output": 3, "kernel_size": 4, "stride": 2, "pad": 1})
+    impl = get_layer_impl("Deconvolution")
+    assert impl.out_shapes(lp, [(1, 2, 5, 5)]) == [(1, 3, 10, 10)]
+    params = impl.init(rng, lp, [(1, 2, 5, 5)])
+    assert params[0].shape == (2, 3, 4, 4)
+    # equivalence: deconv(x, w) == vjp of conv wrt input with same geometry
+    x = jnp.asarray(np_rng.normal(size=(1, 2, 5, 5)).astype(np.float32))
+    w = params[0]
+    y = impl.apply(lp, [w, jnp.zeros(3)], [x], True, None)[0]
+
+    clp = make("Convolution", convolution_param={
+        "num_output": 2, "kernel_size": 4, "stride": 2, "pad": 1,
+        "bias_term": False})
+    cimpl = get_layer_impl("Convolution")
+
+    def conv_fn(inp):
+        # conv maps (1,3,10,10) -> (1,2,5,5) with weight (out=2, in=3, 4, 4),
+        # which is exactly the deconv blob (C_in=2, C_out=3, kh, kw)
+        return cimpl.apply(clp, [w], [inp], True, None)[0]
+
+    _, vjp = jax.vjp(conv_fn, jnp.zeros((1, 3, 10, 10)))
+    ref = vjp(x)[0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+# -- pooling ----------------------------------------------------------------
+
+def test_pool_output_size_ceil():
+    # caffe pooling ceil: e.g. 6->3 with k3 s2: ceil((6-3)/2)+1 = 3
+    assert pool_output_size(6, 6, 3, 3, 2, 2, 0, 0) == (3, 3)
+    # 7 -> ceil((7-3)/2)+1 = 3
+    assert pool_output_size(7, 7, 3, 3, 2, 2, 0, 0) == (3, 3)
+    # 8 -> ceil(5/2)+1 = 4  (torch floor would give 3)
+    assert pool_output_size(8, 8, 3, 3, 2, 2, 0, 0) == (4, 4)
+    # padding clip: start of last window must be < h + p
+    assert pool_output_size(4, 4, 2, 2, 2, 2, 1, 1) == (3, 3)
+
+
+def test_max_pool_values():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    lp = make("Pooling", pooling_param={"pool": "MAX", "kernel_size": 2,
+                                        "stride": 2})
+    y = np.asarray(apply_op(lp, [x])[0])
+    np.testing.assert_array_equal(y[0, 0], [[5, 7], [13, 15]])
+
+
+def test_ave_pool_caffe_denominator():
+    # with padding, caffe divides by the window clipped to [0, dim+pad)
+    x = np.ones((1, 1, 2, 2), np.float32)
+    lp = make("Pooling", pooling_param={"pool": "AVE", "kernel_size": 2,
+                                        "stride": 2, "pad": 1})
+    y = np.asarray(apply_op(lp, [x])[0])
+    # out 2x2; each window covers exactly 1 real pixel but denominator is the
+    # clipped window: corner windows span [−1,1)x[−1,1) -> clipped to
+    # [−1,1)∩[0,3)=2x2... caffe: hstart=-1, hend=min(1, 2+1)=1 -> size 2x2=4?
+    # Actually caffe clips hend to h+pad=3 (no-op here), pool_size=(1-(-1))²=4,
+    # then sums only real pixels (1) -> 0.25.
+    np.testing.assert_allclose(y[0, 0], [[0.25, 0.25], [0.25, 0.25]])
+
+
+def test_global_pooling():
+    x = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2)
+    lp = make("Pooling", pooling_param={"pool": "AVE", "global_pooling": True})
+    y = np.asarray(apply_op(lp, [x])[0])
+    np.testing.assert_allclose(y.reshape(2), [1.5, 5.5])
+
+
+def test_pool_gradients(np_rng):
+    x = jnp.asarray(np_rng.normal(size=(1, 2, 6, 6)).astype(np.float32))
+    for method in ("MAX", "AVE"):
+        lp = make("Pooling", pooling_param={"pool": method, "kernel_size": 3,
+                                            "stride": 2})
+        impl = get_layer_impl("Pooling")
+        f = lambda x: impl.apply(lp, [], [x], True, None)[0]
+        check_grads(f, (x,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
+
+
+# -- LRN --------------------------------------------------------------------
+
+def test_lrn_across_channels_matches_numpy(np_rng):
+    x = np_rng.normal(size=(2, 6, 3, 3)).astype(np.float32)
+    lp = make("LRN", lrn_param={"local_size": 5, "alpha": 1e-4, "beta": 0.75})
+    y = np.asarray(apply_op(lp, [x])[0])
+    ref = np.empty_like(x)
+    C = x.shape[1]
+    for c in range(C):
+        lo, hi = max(0, c - 2), min(C, c + 3)
+        ssum = np.sum(x[:, lo:hi] ** 2, axis=1)
+        scale = 1.0 + (1e-4 / 5) * ssum
+        ref[:, c] = x[:, c] / scale ** 0.75
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_gradient(np_rng):
+    x = jnp.asarray(np_rng.normal(size=(1, 4, 3, 3)).astype(np.float32))
+    lp = make("LRN", lrn_param={"local_size": 3, "alpha": 0.1, "beta": 0.75})
+    impl = get_layer_impl("LRN")
+    f = lambda x: impl.apply(lp, [], [x], True, None)[0]
+    check_grads(f, (x,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
+
+
+# -- inner product ----------------------------------------------------------
+
+def test_inner_product(rng, np_rng):
+    lp = make("InnerProduct", inner_product_param={"num_output": 7})
+    impl = get_layer_impl("InnerProduct")
+    assert impl.out_shapes(lp, [(4, 3, 2, 2)]) == [(4, 7)]
+    params = impl.init(rng, lp, [(4, 3, 2, 2)])
+    assert params[0].shape == (7, 12)
+    x = np_rng.normal(size=(4, 3, 2, 2)).astype(np.float32)
+    y = np.asarray(apply_op(lp, [x], params)[0])
+    ref = x.reshape(4, 12) @ np.asarray(params[0]).T + np.asarray(params[1])
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_inner_product_transpose(rng, np_rng):
+    lp = make("InnerProduct", inner_product_param={"num_output": 5,
+                                                   "transpose": True})
+    impl = get_layer_impl("InnerProduct")
+    params = impl.init(rng, lp, [(2, 6)])
+    assert params[0].shape == (6, 5)
+
+
+# -- neuron layers ----------------------------------------------------------
+
+def test_relu_negative_slope():
+    x = np.array([[-2.0, 3.0]], np.float32)
+    lp = make("ReLU", relu_param={"negative_slope": 0.1})
+    y = np.asarray(apply_op(lp, [x])[0])
+    np.testing.assert_allclose(y, [[-0.2, 3.0]], rtol=1e-6)
+
+
+def test_dropout_train_test(rng):
+    x = np.ones((100, 100), np.float32)
+    lp = make("Dropout", dropout_param={"dropout_ratio": 0.5})
+    y_test = np.asarray(apply_op(lp, [x], train=False)[0])
+    np.testing.assert_array_equal(y_test, x)
+    y_train = np.asarray(apply_op(lp, [x], train=True, rng=rng)[0])
+    # inverted dropout: survivors scaled by 2, mean preserved
+    assert set(np.unique(y_train)) <= {0.0, 2.0}
+    assert abs(y_train.mean() - 1.0) < 0.05
+
+
+def test_power_exp_log_bnll_threshold_absval(np_rng):
+    x = np.abs(np_rng.normal(size=(3, 4)).astype(np.float32)) + 0.5
+    cases = [
+        (make("Power", power_param={"power": 2.0, "scale": 3.0, "shift": 1.0}),
+         (1 + 3 * x) ** 2),
+        (make("Exp"), np.exp(x)),
+        (make("Exp", exp_param={"base": 2.0}), 2.0 ** x),
+        (make("Log"), np.log(x)),
+        (make("AbsVal"), np.abs(x)),
+        (make("BNLL"), np.log1p(np.exp(x))),
+        (make("Threshold", threshold_param={"threshold": 1.0}),
+         (x > 1.0).astype(np.float32)),
+        (make("TanH"), np.tanh(x)),
+        (make("Sigmoid"), 1 / (1 + np.exp(-x))),
+    ]
+    for lp, ref in cases:
+        y = np.asarray(apply_op(lp, [x])[0])
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=lp.type)
+
+
+def test_prelu(rng):
+    lp = make("PReLU")
+    impl = get_layer_impl("PReLU")
+    params = impl.init(rng, lp, [(1, 3, 2, 2)])
+    assert params[0].shape == (3,)
+    np.testing.assert_allclose(np.asarray(params[0]), [0.25] * 3)
+    x = -np.ones((1, 3, 2, 2), np.float32)
+    y = np.asarray(apply_op(lp, [x], params)[0])
+    np.testing.assert_allclose(y, -0.25 * np.ones_like(x))
+
+
+# -- shape/common layers ----------------------------------------------------
+
+def test_concat_slice_roundtrip(np_rng):
+    x = np_rng.normal(size=(2, 6, 2, 2)).astype(np.float32)
+    slp = layer("s", "Slice", ["b"], ["a", "b2", "c"],
+                slice_param={"slice_point": [1, 3]})
+    parts = apply_op(slp, [x])
+    assert [p.shape[1] for p in parts] == [1, 2, 3]
+    clp = layer("c", "Concat", ["a", "b2", "c"], ["out"])
+    y = apply_op(clp, parts)[0]
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_flatten_reshape():
+    x = np.zeros((2, 3, 4, 5), np.float32)
+    f = make("Flatten")
+    assert apply_op(f, [x])[0].shape == (2, 60)
+    r = make("Reshape", reshape_param={"shape": {"dim": [0, -1, 10]}})
+    assert apply_op(r, [x])[0].shape == (2, 6, 10)
+
+
+def test_eltwise(np_rng):
+    a = np_rng.normal(size=(2, 3)).astype(np.float32)
+    b = np_rng.normal(size=(2, 3)).astype(np.float32)
+    lp = layer("e", "Eltwise", ["a", "b"], ["o"],
+               eltwise_param={"operation": "SUM", "coeff": [1.0, -1.0]})
+    np.testing.assert_allclose(np.asarray(apply_op(lp, [a, b])[0]), a - b,
+                               rtol=1e-6)
+    lp2 = layer("e", "Eltwise", ["a", "b"], ["o"],
+                eltwise_param={"operation": "MAX"})
+    np.testing.assert_allclose(np.asarray(apply_op(lp2, [a, b])[0]),
+                               np.maximum(a, b))
+    lp3 = layer("e", "Eltwise", ["a", "b"], ["o"],
+                eltwise_param={"operation": "PROD"})
+    np.testing.assert_allclose(np.asarray(apply_op(lp3, [a, b])[0]), a * b,
+                               rtol=1e-6)
+
+
+def test_softmax_and_argmax(np_rng):
+    x = np_rng.normal(size=(3, 5)).astype(np.float32)
+    y = np.asarray(apply_op(make("Softmax"), [x])[0])
+    e = np.exp(x - x.max(1, keepdims=True))
+    np.testing.assert_allclose(y, e / e.sum(1, keepdims=True), rtol=1e-5,
+                               atol=1e-6)
+    am = np.asarray(apply_op(make("ArgMax"), [x])[0])
+    np.testing.assert_array_equal(am.reshape(3), x.argmax(1))
+
+
+def test_accuracy_topk():
+    scores = np.array([[1, 2, 3], [3, 2, 1], [1, 3, 2]], np.float32)
+    labels = np.array([2, 0, 0], np.float32)
+    lp = layer("a", "Accuracy", ["s", "l"], ["acc"])
+    acc = float(apply_op(lp, [scores, labels])[0])
+    assert acc == pytest.approx(2 / 3)
+    lp5 = layer("a", "Accuracy", ["s", "l"], ["acc"],
+                accuracy_param={"top_k": 2})
+    acc2 = float(apply_op(lp5, [scores, labels])[0])
+    assert acc2 == pytest.approx(2 / 3)  # sample 3: label 0 ranks 3rd
+
+
+def test_batchnorm_train_updates_stats(rng, np_rng):
+    lp = make("BatchNorm")
+    impl = get_layer_impl("BatchNorm")
+    params = impl.init(rng, lp, [(4, 3, 2, 2)])
+    x = jnp.asarray(np_rng.normal(loc=5.0, size=(4, 3, 2, 2)).astype(np.float32))
+    (tops, new_params) = impl.apply(lp, params, [x], True, None)
+    y = np.asarray(tops[0])
+    assert abs(y.mean()) < 1e-5 and abs(y.std() - 1.0) < 1e-2
+    # running stats accumulated
+    assert float(new_params[2][0]) == pytest.approx(1.0)
+    np.testing.assert_allclose(np.asarray(new_params[0]),
+                               np.asarray(x.mean(axis=(0, 2, 3))), rtol=1e-4)
+    # inference path uses the stats
+    (tops2, _) = impl.apply(lp, new_params, [x], False, None)
+    y2 = np.asarray(tops2[0])
+    assert abs(y2.mean()) < 0.2
+
+
+def test_scale_bias(rng, np_rng):
+    x = np_rng.normal(size=(2, 3, 2, 2)).astype(np.float32)
+    slp = make("Scale", scale_param={"bias_term": True})
+    impl = get_layer_impl("Scale")
+    params = impl.init(rng, slp, [x.shape])
+    assert params[0].shape == (3,) and params[1].shape == (3,)
+    y = np.asarray(apply_op(slp, [x], [jnp.full(3, 2.0), jnp.full(3, 1.0)])[0])
+    np.testing.assert_allclose(y, 2 * x + 1, rtol=1e-5)
+
+
+def test_mvn(np_rng):
+    x = np_rng.normal(loc=3.0, scale=2.0, size=(2, 3, 4, 4)).astype(np.float32)
+    y = np.asarray(apply_op(make("MVN"), [x])[0])
+    m = y.mean(axis=(2, 3))
+    s = y.std(axis=(2, 3))
+    np.testing.assert_allclose(m, np.zeros_like(m), atol=1e-5)
+    np.testing.assert_allclose(s, np.ones_like(s), atol=1e-2)
+
+
+def test_embed(rng):
+    lp = make("Embed", embed_param={"num_output": 4, "input_dim": 10})
+    impl = get_layer_impl("Embed")
+    params = impl.init(rng, lp, [(3,)])
+    assert params[0].shape == (10, 4)
+    idx = np.array([1, 5, 9], np.float32)
+    y = apply_op(lp, [idx], params)[0]
+    assert y.shape == (3, 4)
+
+
+def test_tile_reduction_batchreindex(np_rng):
+    x = np_rng.normal(size=(2, 3)).astype(np.float32)
+    t = make("Tile", tile_param={"axis": 1, "tiles": 2})
+    assert apply_op(t, [x])[0].shape == (2, 6)
+    r = make("Reduction", reduction_param={"operation": "MEAN", "axis": 1})
+    np.testing.assert_allclose(np.asarray(apply_op(r, [x])[0]), x.mean(1),
+                               rtol=1e-5)
+    br = layer("br", "BatchReindex", ["x", "i"], ["o"])
+    idx = np.array([1, 1, 0], np.float32)
+    y = np.asarray(apply_op(br, [x, idx])[0])
+    np.testing.assert_array_equal(y, x[[1, 1, 0]])
+
+
+# -- losses -----------------------------------------------------------------
+
+def test_softmax_with_loss_matches_manual(np_rng):
+    x = np_rng.normal(size=(4, 5)).astype(np.float32)
+    labels = np.array([0, 1, 2, 3], np.float32)
+    lp = layer("l", "SoftmaxWithLoss", ["x", "y"], ["loss"])
+    loss = float(apply_op(lp, [x, labels])[0])
+    e = np.exp(x - x.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    ref = -np.mean(np.log(p[np.arange(4), labels.astype(int)]))
+    assert loss == pytest.approx(ref, rel=1e-5)
+
+
+def test_softmax_loss_ignore_label(np_rng):
+    x = np_rng.normal(size=(4, 5)).astype(np.float32)
+    labels = np.array([0, 1, 255, 3], np.float32)
+    # ignore_label must drop sample 2 from both sum and count
+    lp = layer("l", "SoftmaxWithLoss", ["x", "y"], ["loss"],
+               loss_param={"ignore_label": 255})
+    loss = float(apply_op(lp, [x, labels])[0])
+    e = np.exp(x - x.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    keep = [0, 1, 3]
+    ref = -np.mean(np.log(p[keep, labels.astype(int)[keep]]))
+    assert loss == pytest.approx(ref, rel=1e-4)
+
+
+def test_euclidean_loss(np_rng):
+    a = np_rng.normal(size=(3, 4)).astype(np.float32)
+    b = np_rng.normal(size=(3, 4)).astype(np.float32)
+    lp = layer("l", "EuclideanLoss", ["a", "b"], ["loss"])
+    loss = float(apply_op(lp, [a, b])[0])
+    assert loss == pytest.approx(((a - b) ** 2).sum() / 6, rel=1e-5)
+
+
+def test_hinge_loss():
+    s = np.array([[0.5, -0.5], [0.2, 0.3]], np.float32)
+    y = np.array([0, 1], np.float32)
+    lp = layer("l", "HingeLoss", ["s", "y"], ["loss"])
+    # margins: sample0: max(0,1-0.5)+max(0,1-0.5)=1.0; sample1:
+    # max(0,1+0.2)+max(0,1-0.3)=1.9 -> mean 1.45
+    assert float(apply_op(lp, [s, y])[0]) == pytest.approx((1.0 + 1.9) / 2)
+
+
+def test_sigmoid_ce_loss(np_rng):
+    x = np_rng.normal(size=(3, 4)).astype(np.float32)
+    t = (np_rng.uniform(size=(3, 4)) > 0.5).astype(np.float32)
+    lp = layer("l", "SigmoidCrossEntropyLoss", ["x", "t"], ["loss"])
+    loss = float(apply_op(lp, [x, t])[0])
+    p = 1 / (1 + np.exp(-x))
+    ref = -np.sum(t * np.log(p) + (1 - t) * np.log(1 - p)) / 3
+    assert loss == pytest.approx(ref, rel=1e-4)
+
+
+def test_contrastive_loss(np_rng):
+    a = np_rng.normal(size=(4, 3)).astype(np.float32)
+    b = np_rng.normal(size=(4, 3)).astype(np.float32)
+    y = np.array([1, 0, 1, 0], np.float32)
+    lp = layer("l", "ContrastiveLoss", ["a", "b", "y"], ["loss"])
+    loss = float(apply_op(lp, [a, b, y])[0])
+    d2 = ((a - b) ** 2).sum(1)
+    d = np.sqrt(d2)
+    neg = np.maximum(1.0 - d, 0) ** 2
+    ref = np.sum(y * d2 + (1 - y) * neg) / 8
+    assert loss == pytest.approx(ref, rel=1e-3)
+
+
+def test_loss_gradients(np_rng):
+    x = jnp.asarray(np_rng.normal(size=(4, 5)).astype(np.float32))
+    labels = jnp.asarray(np.array([0, 1, 2, 3], np.float32))
+    lp = layer("l", "SoftmaxWithLoss", ["x", "y"], ["loss"])
+    impl = get_layer_impl("SoftmaxWithLoss")
+    f = lambda x: impl.apply(lp, [], [x, labels], True, None)[0]
+    check_grads(f, (x,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)

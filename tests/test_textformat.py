@@ -1,0 +1,76 @@
+"""Prototxt text-format parser tests (front-end parity with the reference's
+native parse service, libccaffe/ccaffe.cpp:213-242)."""
+
+import pytest
+
+from sparknet_tpu.proto.textformat import PMessage, ParseError, parse, serialize
+
+SAMPLE = """
+# a comment
+name: "LeNet"
+force_backward: true
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 32   # trailing comment
+input_dim: 32
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param {
+    num_output: 20
+    kernel_size: 5
+    weight_filler { type: "xavier" }
+  }
+}
+"""
+
+
+def test_scalars_and_nesting():
+    m = parse(SAMPLE)
+    assert m.get("name") == "LeNet"
+    assert m.get("force_backward") is True
+    assert m.get_all("input_dim") == [1, 3, 32, 32]
+    conv = m.get("layer")
+    assert isinstance(conv, PMessage)
+    assert conv.get("type") == "Convolution"
+    cp = conv.get("convolution_param")
+    assert cp.get("num_output") == 20
+    assert cp.get("weight_filler").get("type") == "xavier"
+
+
+def test_enum_float_negative():
+    m = parse('pool: MAX\nlr: -0.5\nmomentum: 0.9\nexp: 1e-4\nn: -3')
+    assert m.get("pool") == "MAX"
+    assert m.get("lr") == -0.5
+    assert m.get("exp") == 1e-4
+    assert m.get("n") == -3
+    assert isinstance(m.get("n"), int)
+
+
+def test_colon_brace_and_list():
+    m = parse('shape: { dim: 1 dim: 2 }\nvals: [1, 2, 3]')
+    assert m.get("shape").get_all("dim") == [1, 2]
+    assert m.get_all("vals") == [1, 2, 3]
+
+
+def test_string_escapes():
+    m = parse(r'path: "a\"b\nc"')
+    assert m.get("path") == 'a"b\nc'
+
+
+def test_roundtrip():
+    m = parse(SAMPLE)
+    m2 = parse(serialize(m))
+    assert m2 == m
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse("layer {")
+    with pytest.raises(ParseError):
+        parse("}")
+    with pytest.raises(ParseError):
+        parse("key value")
