@@ -1,0 +1,71 @@
+"""Headline benchmark: CaffeNet (AlexNet-class) training throughput.
+
+Methodology mirrors the reference's published numbers — 20 training
+iterations at batch 256, full forward+backward+update, data resident on
+device (reference: caffe/docs/performance_hardware.md:19-25, the `caffe
+train` 20-iter protocol; best single-GPU baseline 19.2 s ⇒ ≈267 img/s on
+K40+cuDNN).  Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 267.0  # K40 + cuDNN, performance_hardware.md:24
+BATCH = 256
+ITERS = 20
+WARMUP = 3
+REPS = 5  # tunneled chip shows ~2x run-to-run variance; report the median
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.models import caffenet
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.01\nmomentum: 0.9\nweight_decay: 0.0005\n'
+        'lr_policy: "step"\ngamma: 0.1\nstepsize: 100000\n',
+        caffenet(BATCH, BATCH))
+    solver = Solver(sp, seed=0)
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.normal(size=(1, BATCH, 3, 227, 227)).astype(np.float32))
+    label = jnp.asarray(rng.integers(0, 1000, size=(1, BATCH)).astype(np.float32))
+    batch = {"data": data, "label": label}
+
+    step_rng = jax.random.PRNGKey(0)
+    params, state = solver.params, solver.state
+    for i in range(WARMUP):
+        step_rng, sub = jax.random.split(step_rng)
+        params, state, loss = solver._step(params, state, i, batch, sub)
+    jax.block_until_ready(loss)
+
+    rates = []
+    it = WARMUP
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            step_rng, sub = jax.random.split(step_rng)
+            params, state, loss = solver._step(params, state, it, batch, sub)
+            it += 1
+        jax.block_until_ready(loss)
+        rates.append(BATCH * ITERS / (time.perf_counter() - t0))
+
+    img_s = float(np.median(rates))
+    print(json.dumps({
+        "metric": "caffenet_train_images_per_sec",
+        "value": round(img_s, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, replicated, batch_sharded
+from .trainer import DistributedTrainer, TrainerConfig
+from .cluster import init_cluster, is_multi_host, local_batch_slice

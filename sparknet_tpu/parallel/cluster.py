@@ -1,0 +1,50 @@
+"""Multi-host cluster bring-up.
+
+The reference's control plane is one Spark driver plus N executor JVMs,
+with the model replicated per-JVM via classloading side effects (reference:
+src/main/scala/apps/CifarApp.scala:23-29 — SURVEY.md §7.3 calls this
+"fragile magic") and all cross-machine traffic through Spark TCP.  Here
+multi-host is the JAX distributed runtime: every host calls
+``init_cluster``, gets the same global mesh over all chips (ICI within a
+slice, DCN across), and runs the same SPMD program; per-host model
+construction is explicit same-seed init, not classloader side effects.
+
+On a TPU pod slice, coordinator/process discovery is automatic from the TPU
+metadata environment; off-pod (CPU/GPU test rigs), pass the coordinator
+address and process counts explicitly — the spark-submit launcher keeps
+doing process placement, but carries no tensor traffic.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def init_cluster(coordinator_address: str | None = None,
+                 num_processes: int | None = None,
+                 process_id: int | None = None) -> None:
+    """Join (or bootstrap) the distributed runtime.  No-op for single-host.
+
+    All arguments default to auto-discovery (TPU metadata / env vars), the
+    normal mode on a TPU-VM pod."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """The half-open row range of the global batch this host should feed —
+    the partition-to-worker mapping the reference gets from Spark
+    ``zipPartitions`` (reference: ImageNetApp.scala:145)."""
+    n, i = jax.process_count(), jax.process_index()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{n} hosts")
+    per = global_batch // n
+    return slice(i * per, (i + 1) * per)

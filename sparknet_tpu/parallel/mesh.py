@@ -1,0 +1,50 @@
+"""Device mesh construction and sharding helpers.
+
+The communication tier of the framework.  The reference has two transport
+stacks — Spark TCP broadcast/reduce between nodes (reference:
+src/main/scala/apps/ImageNetApp.scala:102,178) and a CUDA P2P tree within a
+node (reference: caffe/src/caffe/parallel.cpp:271-360) — and no
+NCCL/MPI/Gloo anywhere (SURVEY.md §2.5).  Here both collapse into XLA
+collectives over a ``jax.sharding.Mesh``: ``psum``/``pmean`` ride ICI within
+a slice and DCN across slices, chosen by the compiler from the mesh
+topology.  Multi-host extends the same mesh via the JAX distributed runtime
+(``sparknet_tpu.parallel.cluster.init_cluster``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_devices: int | None = None, *, model_parallel: int = 1,
+              devices=None) -> Mesh:
+    """A (data, model) mesh over the available devices.
+
+    ``model_parallel=1`` (the parity default — the reference has no model
+    parallelism, SURVEY.md §2.4) yields a pure data-parallel mesh; larger
+    values carve an inner model axis for tensor-parallel shardings laid out
+    on adjacent devices so its collectives ride the fastest ICI links.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by mp={model_parallel}")
+    arr = np.asarray(devs).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (batch) axis across the data axis."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS, *([None] * (ndim - 1))))

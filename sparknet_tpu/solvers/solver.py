@@ -72,14 +72,8 @@ class Solver:
         lr_mults = self._lr_mults
         decay_mults = self._decay_mults
 
-        def loss_fn(params, batch, rng):
-            out = net.apply(params, batch, train=True, rng=rng)
-            return out.loss, out.params
-
-        def one_grad(params, batch, rng):
-            (loss, new_params), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch, rng)
-            return loss, new_params, grads
+        from .step import make_step_fns
+        one_grad, _ = make_step_fns(sp, net, rule, lr_mults, decay_mults)
 
         def step(params, state, it, batches, rng):
             if sp.iter_size == 1:

@@ -1,0 +1,44 @@
+"""The single-step update pipeline shared by the host Solver and the
+distributed trainers.
+
+One authoritative implementation of: forward+backward (with BatchNorm
+forward-state aux) → ClipGradients → Normalize → Regularize → rule update —
+the ``Solver::Step`` inner body + ``ApplyUpdate`` sequence (reference:
+caffe/src/caffe/solver.cpp:221-262, solvers/sgd_solver.cpp:102-143).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..graph.net import Net
+from ..proto.caffe_pb import SolverParameter
+from .lr_policies import learning_rate
+from .update_rules import SolverUpdate, preprocess_grads
+
+
+def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
+                  lr_mults, decay_mults):
+    """Returns (loss_and_grads, local_update):
+
+    - ``loss_and_grads(params, batch, rng) -> (loss, params_with_bn, grads)``
+    - ``local_update(params, state, it, batch, rng) -> (params, state, loss)``
+    """
+
+    def loss_and_grads(params, batch, rng):
+        def loss_fn(p):
+            out = net.apply(p, batch, train=True, rng=rng)
+            return out.loss, out.params
+        (loss, new_params), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, new_params, grads
+
+    def local_update(params, state, it, batch, rng):
+        loss, params, grads = loss_and_grads(params, batch, rng)
+        grads = preprocess_grads(sp, params, grads, lr_mults, decay_mults)
+        rate = learning_rate(sp, it)
+        params, state = rule.apply(params, grads, state, rate, it,
+                                   lr_mults=lr_mults)
+        return params, state, loss
+
+    return loss_and_grads, local_update

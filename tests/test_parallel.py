@@ -1,0 +1,188 @@
+"""Distributed-training tests on the virtual 8-device CPU mesh — the
+multi-node coverage the reference never had (SURVEY.md §4.1: "there are no
+distributed tests"; the CPU_ONLY analog per §4.3)."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import make_minibatches
+from sparknet_tpu.models import lenet
+from sparknet_tpu.parallel import DistributedTrainer, TrainerConfig, make_mesh
+from sparknet_tpu.proto import load_solver_prototxt_with_net
+from sparknet_tpu.solvers import Solver
+
+SOLVER_TXT = 'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n'
+
+
+def synth(np_rng, n, shape=(1, 28, 28), num_classes=10):
+    labels = np_rng.integers(0, num_classes, size=n)
+    x = np_rng.normal(scale=0.3, size=(n, *shape)).astype(np.float32)
+    for k in range(num_classes):
+        x[labels == k, :, k % shape[1], :] += 2.0
+    return x, labels.astype(np.float32)
+
+
+def round_batches(np_rng, tau, global_batch):
+    x, y = synth(np_rng, tau * global_batch)
+    return {"data": x.reshape(tau, global_batch, 1, 28, 28),
+            "label": y.reshape(tau, global_batch)}
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(8)
+    assert mesh.shape == {"data": 8, "model": 1}
+    mesh2 = make_mesh(8, model_parallel=2)
+    assert mesh2.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(6, model_parallel=4)
+
+
+@pytest.mark.parametrize("strategy", ["sync", "local_sgd"])
+def test_distributed_loss_decreases(strategy, np_rng):
+    # lr 0.01: local_sgd workers see batch 4 — 0.05 genuinely diverges there
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.01\nmomentum: 0.9\nlr_policy: "fixed"\n', lenet(32, 32))
+    mesh = make_mesh(8)
+    tr = DistributedTrainer(sp, mesh, TrainerConfig(strategy=strategy, tau=5),
+                            seed=0)
+    assert tr.n_workers == 8
+    losses = [tr.train_round(round_batches(np_rng, 5, 32)) for _ in range(6)]
+    assert losses[0] == pytest.approx(np.log(10), rel=0.3)
+    assert losses[-1] < 0.5 * losses[0]
+    assert tr.iter == 30
+
+
+def test_sync_matches_single_process_bigbatch(np_rng):
+    """Gradient-pmean over 4 shards of batch 32 == single-device batch 32
+    (the correctness invariant P2PSync relies on)."""
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(32, 32))
+    x, y = synth(np_rng, 64)
+
+    single = Solver(sp, seed=0)
+    mesh = make_mesh(4)
+    tr = DistributedTrainer(sp, mesh, TrainerConfig(strategy="sync", tau=1),
+                            seed=0)
+    # same seed -> identical initial params
+    np.testing.assert_allclose(np.asarray(single.params["conv1"][0]),
+                               np.asarray(tr.params["conv1"][0]))
+    single.set_train_data(itertools.cycle(
+        [{"data": x[i:i + 32], "label": y[i:i + 32]} for i in range(0, 64, 32)]))
+    single.step(2)
+    for i in range(0, 64, 32):
+        tr.train_round({"data": x[i:i + 32][None], "label": y[i:i + 32][None]})
+
+    for k in single.params:
+        for a, b in zip(single.params[k], tr.params[k]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_local_sgd_weight_averaging_semantics(np_rng):
+    """After one round of τ=3, params must equal the mean of what each
+    worker would have computed alone on its shard (SparkNet's
+    WeightCollection.add / scalarDivide invariant)."""
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8))
+    mesh = make_mesh(2)
+    tr = DistributedTrainer(sp, mesh, TrainerConfig(strategy="local_sgd",
+                                                    tau=3), seed=0)
+    init_params = jax.tree_util.tree_map(np.asarray, tr.params)
+    batches = round_batches(np_rng, 3, 16)
+    tr.train_round(batches)
+
+    # replay each worker locally with a plain Solver starting from the same
+    # params and its own data shard + the same per-worker rng stream
+    rng0 = jax.random.PRNGKey(0)
+    _, run_rng = jax.random.split(rng0)          # trainer's self._rng
+    round_rng, _ = jax.random.split(run_rng)     # rng passed into round 1
+    worker_params = []
+    for w in range(2):
+        s = Solver(sp, seed=0)
+        s.params = jax.tree_util.tree_map(jnp.asarray, init_params)
+        shard = {k: v[:, 8 * w:8 * (w + 1)] for k, v in batches.items()}
+        feed = iter([{k: v[t] for k, v in shard.items()} for t in range(3)])
+        s.set_train_data(feed)
+        # mirror the trainer's rng chain for this worker
+        wrng = jax.random.fold_in(round_rng, w)
+        for _ in range(3):
+            wrng, sub = jax.random.split(wrng)
+            batch = next(s._train_iter)
+            stacked = {k: jnp.asarray(v)[None] for k, v in batch.items()}
+            s.params, s.state, _ = s._step(s.params, s.state, s.iter, stacked, sub)
+            s.iter += 1
+        worker_params.append(s.params)
+
+    for k in worker_params[0]:
+        for i, blob in enumerate(worker_params[0][k]):
+            avg = (np.asarray(blob) + np.asarray(worker_params[1][k][i])) / 2
+            np.testing.assert_allclose(np.asarray(tr.params[k][i]), avg,
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_distributed_test_aggregation(np_rng):
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(32, 32))
+    mesh = make_mesh(8)
+    tr = DistributedTrainer(sp, mesh, TrainerConfig(strategy="sync"), seed=0)
+    x, y = synth(np_rng, 64)
+    feed = itertools.cycle([{"data": x[i:i + 32], "label": y[i:i + 32]}
+                            for i in range(0, 64, 32)])
+    scores = tr.test(feed, num_steps=2)
+    assert "accuracy" in scores
+    assert 0.0 <= scores["accuracy"] / 2 <= 1.0
+
+
+def test_trainer_snapshot_restore(tmp_path, np_rng):
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(16, 16))
+    mesh = make_mesh(4)
+    cfg = TrainerConfig(strategy="local_sgd", tau=2)
+    tr = DistributedTrainer(sp, mesh, cfg, seed=0)
+    tr.train_round(round_batches(np_rng, 2, 16))
+    p = str(tmp_path / "dist.npz")
+    tr.snapshot(p)
+    tr2 = DistributedTrainer(sp, mesh, cfg, seed=5)
+    tr2.restore(p)
+    assert tr2.iter == 2
+    np.testing.assert_allclose(np.asarray(tr2.params["conv1"][0]),
+                               np.asarray(tr.params["conv1"][0]))
+    # momentum state restored per-worker
+    chex_tree = jax.tree_util.tree_leaves(tr2.state)
+    assert all(l.shape[0] == 4 for l in chex_tree)
+
+
+def test_restore_rejects_mismatched_strategy_or_workers(tmp_path, np_rng):
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(16, 16))
+    tr = DistributedTrainer(sp, make_mesh(4),
+                            TrainerConfig(strategy="sync"), seed=0)
+    p = str(tmp_path / "sync.npz")
+    tr.snapshot(p)
+    wrong_strategy = DistributedTrainer(
+        sp, make_mesh(4), TrainerConfig(strategy="local_sgd"), seed=0)
+    with pytest.raises(ValueError, match="strategy"):
+        wrong_strategy.restore(p)
+    wrong_mesh = DistributedTrainer(
+        sp, make_mesh(8), TrainerConfig(strategy="sync"), seed=0)
+    with pytest.raises(ValueError, match="workers"):
+        wrong_mesh.restore(p)
+
+
+def test_eval_batch_divisibility(np_rng):
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8))
+    tr = DistributedTrainer(sp, make_mesh(8), TrainerConfig(), seed=0)
+    feed = iter([{"data": np.zeros((60, 1, 28, 28), np.float32),
+                  "label": np.zeros(60, np.float32)}])
+    with pytest.raises(ValueError, match="not divisible"):
+        tr.test(feed, 1)
+
+
+def test_batch_divisibility_validation(np_rng):
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8))
+    tr = DistributedTrainer(sp, make_mesh(8), TrainerConfig(tau=1), seed=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        tr.train_round({"data": np.zeros((1, 12, 1, 28, 28), np.float32),
+                        "label": np.zeros((1, 12), np.float32)})
+    with pytest.raises(ValueError, match="!= tau"):
+        tr.train_round({"data": np.zeros((2, 16, 1, 28, 28), np.float32),
+                        "label": np.zeros((2, 16), np.float32)})
