@@ -1,0 +1,1 @@
+from .common import RoundFeed, run_training

@@ -1,0 +1,120 @@
+"""Shared app driver: the outer training loop both apps run.
+
+The reference's driver loop per round (reference:
+src/main/scala/apps/ImageNetApp.scala:100-182): broadcast weights → each
+worker trains τ local steps on minibatches sampled from its partition →
+collect & average weights → every 10 rounds, a distributed eval whose
+per-worker scores are summed on the driver (:138-140).  Here broadcast/
+collect/average live inside the trainer's compiled round; the app loop only
+assembles per-round feeds and logs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..data.minibatch import make_minibatches
+from ..data.partition import PartitionedDataset
+from ..parallel.trainer import DistributedTrainer
+from ..utils.timing import PhaseLogger
+
+
+class RoundFeed:
+    """Assembles [τ, global_batch, ...] round feeds from a partitioned
+    dataset — one partition per worker, τ contiguous minibatches per round
+    per partition (MinibatchSampler's contiguous-run semantics, reference:
+    src/main/scala/libs/MinibatchSampler.scala:18-19), with a per-batch
+    preprocessing closure (the setTrainData(preprocess) argument, reference:
+    src/main/scala/libs/Net.scala:79-84)."""
+
+    def __init__(self, dataset: PartitionedDataset, per_worker_batch: int,
+                 tau: int,
+                 preprocess: Callable[[np.ndarray], np.ndarray] | None = None,
+                 seed: int = 0):
+        self.tau = tau
+        self.preprocess = preprocess
+        self._rng = np.random.default_rng(seed)
+        self._parts = []
+        for p in dataset.partitions:
+            images = np.stack([x for x, _ in p])
+            labels = np.asarray([y for _, y in p], np.float32)
+            batches = make_minibatches(images, labels, per_worker_batch)
+            if len(batches) < tau:
+                raise ValueError(
+                    f"partition has {len(batches)} minibatches < tau={tau}")
+            self._parts.append(batches)
+
+    def next_round(self) -> dict[str, np.ndarray]:
+        data_steps, label_steps = [], []
+        starts = [int(self._rng.integers(0, len(b) - self.tau + 1))
+                  for b in self._parts]
+        for t in range(self.tau):
+            imgs, labs = [], []
+            for w, batches in enumerate(self._parts):
+                x, y = batches[starts[w] + t]
+                if self.preprocess is not None:
+                    x = self.preprocess(x)
+                imgs.append(x)
+                labs.append(y)
+            data_steps.append(np.concatenate(imgs))
+            label_steps.append(np.concatenate(labs))
+        return {"data": np.stack(data_steps),
+                "label": np.stack(label_steps)}
+
+
+def eval_feed(dataset: PartitionedDataset, per_worker_batch: int,
+              preprocess: Callable[[np.ndarray], np.ndarray] | None = None):
+    """Global test minibatches spanning all partitions (the zipPartitions
+    test pass, reference: ImageNetApp.scala:108-137)."""
+    n_parts = dataset.num_partitions
+    per_part = [make_minibatches(
+        np.stack([x for x, _ in p]),
+        np.asarray([y for _, y in p], np.float32), per_worker_batch)
+        for p in dataset.partitions]
+    steps = min(len(b) for b in per_part)
+    if steps == 0:
+        sizes = dataset.partition_sizes()
+        raise ValueError(
+            f"eval would run 0 steps: smallest test partition has "
+            f"{min(sizes)} items < per-worker batch {per_worker_batch}")
+
+    def factory():
+        for t in range(steps):
+            imgs, labs = [], []
+            for w in range(n_parts):
+                x, y = per_part[w][t]
+                if preprocess is not None:
+                    x = preprocess(x)
+                imgs.append(x)
+                labs.append(y)
+            yield {"data": np.concatenate(imgs), "label": np.concatenate(labs)}
+
+    return factory, steps
+
+
+def run_training(trainer: DistributedTrainer, feed: RoundFeed,
+                 test_factory, test_steps: int, *, rounds: int,
+                 test_interval: int = 10,
+                 logger: PhaseLogger | None = None) -> dict[str, float]:
+    """The outer while-loop (reference: CifarApp.scala:87-128 — infinite
+    there; bounded by ``rounds`` here).  Returns the last eval scores."""
+    log = logger or PhaseLogger()
+    last_scores: dict[str, float] = {}
+    for r in range(rounds):
+        if test_interval and r % test_interval == 0 and r > 0:
+            log.log("testing")
+            totals = trainer.test(test_factory(), test_steps)
+            last_scores = {k: v / test_steps for k, v in totals.items()}
+            log.log(f"round {r}: eval {last_scores}")
+        t0 = time.perf_counter()
+        batches = feed.next_round()
+        loss = trainer.train_round(batches)
+        log.log(f"round {r}: tau={feed.tau} loss={loss:.4f} "
+                f"({time.perf_counter() - t0:.2f}s)")
+    totals = trainer.test(test_factory(), test_steps)
+    last_scores = {k: v / test_steps for k, v in totals.items()}
+    log.log(f"final eval: {last_scores}")
+    return last_scores

@@ -24,14 +24,20 @@ def load_cifar10_binary(paths: list[str] | str, shuffle: bool = False,
     labels [N] int32)."""
     if isinstance(paths, str):
         paths = [paths]
+    if not paths:
+        raise FileNotFoundError("no CIFAR batch files given")
+    for p in paths:
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"CIFAR batch file not found: {p}")
+    from .. import native
     images, labels = [], []
     for path in paths:
         raw = np.fromfile(path, dtype=np.uint8)
         if raw.size % _REC:
             raise ValueError(f"{path}: size {raw.size} not a multiple of {_REC}")
-        recs = raw.reshape(-1, _REC)
-        labels.append(recs[:, 0].astype(np.int32))
-        images.append(recs[:, 1:].reshape(-1, *CIFAR_SHAPE).astype(np.float32))
+        imgs, labs = native.decode_cifar(raw.reshape(-1, _REC))
+        labels.append(labs)
+        images.append(imgs)
     x = np.concatenate(images)
     y = np.concatenate(labels)
     if shuffle:

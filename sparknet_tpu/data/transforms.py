@@ -34,21 +34,19 @@ def random_crop_mirror(batch: np.ndarray, crop: int,
                        rng: np.random.Generator,
                        mirror: bool = True,
                        mean: np.ndarray | float | None = None) -> np.ndarray:
-    """Random crop to (crop, crop) + horizontal mirror, vectorized
-    (DataTransformer train path; ImageNetApp train preprocessing closure)."""
+    """Random crop to (crop, crop) + horizontal mirror
+    (DataTransformer train path; ImageNetApp train preprocessing closure).
+    Runs through the C++ pipeline when available."""
+    from .. import native
     n, c, h, w = batch.shape
-    out = np.empty((n, c, crop, crop), np.float32)
-    ys = rng.integers(0, h - crop + 1, size=n)
-    xs = rng.integers(0, w - crop + 1, size=n)
-    flips = rng.integers(0, 2, size=n).astype(bool) if mirror else np.zeros(n, bool)
-    for i in range(n):
-        img = batch[i, :, ys[i]:ys[i] + crop, xs[i]:xs[i] + crop]
-        out[i] = img[:, :, ::-1] if flips[i] else img
-    if mean is not None:
-        if isinstance(mean, np.ndarray) and mean.shape[-1] != crop:
-            mean = center_crop_mean(mean, crop)
-        out -= mean
-    return out
+    ys = rng.integers(0, h - crop + 1, size=n).astype(np.int32)
+    xs = rng.integers(0, w - crop + 1, size=n).astype(np.int32)
+    flips = (rng.integers(0, 2, size=n) if mirror
+             else np.zeros(n)).astype(np.int32)
+    if isinstance(mean, np.ndarray) and mean.shape[-1] != crop:
+        mean = center_crop_mean(mean, crop)
+    return native.crop_batch(batch.astype(np.float32, copy=False), crop,
+                             ys, xs, flips, mean)
 
 
 def center_crop(batch: np.ndarray, crop: int,

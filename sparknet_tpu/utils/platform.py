@@ -1,0 +1,20 @@
+"""Platform selection workaround.
+
+The installed axon TPU plugin does not honor ``JAX_PLATFORMS``/
+``JAX_PLATFORM_NAME`` env vars (and hangs backend init when its tunnel is
+unreachable); the ``jax_platforms`` config route is honored.  Call
+``honor_platform_env()`` before first backend use so
+``JAX_PLATFORMS=cpu python -m sparknet_tpu.apps...`` behaves as documented.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    plats = os.environ.get("JAX_PLATFORMS", "") or os.environ.get(
+        "JAX_PLATFORM_NAME", "")
+    if plats and "axon" not in plats.lower():
+        import jax
+        jax.config.update("jax_platforms", plats.lower())

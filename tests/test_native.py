@@ -1,0 +1,82 @@
+"""Native data-pipeline tests: C++ path vs numpy fallback equivalence —
+the CallbackBenchmarkSpec territory (reference:
+src/test/scala/apps/CallbackBenchmarkSpec.scala measured the JNA feed path
+this module replaces)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import native
+
+
+def test_builds():
+    assert native.available(), "native pipeline failed to build"
+
+
+def test_decode_cifar_matches_numpy(np_rng):
+    recs = np_rng.integers(0, 256, size=(5, 3073)).astype(np.uint8)
+    images, labels = native.decode_cifar(recs)
+    np.testing.assert_array_equal(labels, recs[:, 0].astype(np.int32))
+    np.testing.assert_array_equal(
+        images, recs[:, 1:].reshape(5, 3, 32, 32).astype(np.float32))
+
+
+def test_crop_batch_matches_numpy(np_rng):
+    batch = np_rng.normal(size=(6, 3, 12, 12)).astype(np.float32)
+    ys = np_rng.integers(0, 5, size=6)
+    xs = np_rng.integers(0, 5, size=6)
+    flips = np_rng.integers(0, 2, size=6)
+    mean = np_rng.normal(size=(3, 8, 8)).astype(np.float32)
+    out = native.crop_batch(batch, 8, ys, xs, flips, mean)
+    for i in range(6):
+        ref = batch[i, :, ys[i]:ys[i] + 8, xs[i]:xs[i] + 8]
+        if flips[i]:
+            ref = ref[:, :, ::-1]
+        np.testing.assert_allclose(out[i], ref - mean, rtol=1e-6)
+
+
+def test_crop_batch_scalar_mean(np_rng):
+    batch = np.ones((2, 1, 4, 4), np.float32) * 10
+    out = native.crop_batch(batch, 2, np.zeros(2, np.int32),
+                            np.zeros(2, np.int32), np.zeros(2, np.int32),
+                            mean=3.0)
+    np.testing.assert_allclose(out, np.full((2, 1, 2, 2), 7.0))
+
+
+def test_crop_batch_out_of_bounds(np_rng):
+    batch = np.zeros((1, 1, 4, 4), np.float32)
+    with pytest.raises(RuntimeError):
+        native.crop_batch(batch, 3, np.array([2], np.int32),
+                          np.array([0], np.int32), np.array([0], np.int32))
+
+
+def test_accumulate_mean(np_rng):
+    imgs = np_rng.normal(size=(10, 3, 4, 4)).astype(np.float32)
+    acc = np.zeros((3, 4, 4), np.float64)
+    native.accumulate_mean(imgs, acc)
+    np.testing.assert_allclose(acc, imgs.sum(axis=0), rtol=1e-5)
+
+
+def _jpeg_bytes(arr: np.ndarray) -> bytes:
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def test_decode_jpeg_resize(np_rng):
+    src = np.zeros((40, 60, 3), np.uint8)
+    src[:, :30] = [255, 0, 0]
+    src[:, 30:] = [0, 0, 255]
+    out = native.decode_jpeg_resize(_jpeg_bytes(src), 20, 20)
+    assert out is not None and out.shape == (3, 20, 20)
+    # left half red-ish, right half blue-ish
+    assert out[0, :, :8].mean() > 180 and out[2, :, :8].mean() < 80
+    assert out[2, :, 12:].mean() > 180 and out[0, :, 12:].mean() < 80
+
+
+def test_decode_jpeg_garbage_returns_none():
+    assert native.decode_jpeg_resize(b"not a jpeg at all", 8, 8) is None
+    assert native.decode_jpeg_resize(b"\xff\xd8\xff\xe0truncated", 8, 8) is None
