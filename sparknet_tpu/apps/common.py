@@ -98,22 +98,43 @@ def eval_feed(dataset: PartitionedDataset, per_worker_batch: int,
 def run_training(trainer: DistributedTrainer, feed: RoundFeed,
                  test_factory, test_steps: int, *, rounds: int,
                  test_interval: int = 10,
-                 logger: PhaseLogger | None = None) -> dict[str, float]:
+                 logger: PhaseLogger | None = None,
+                 snapshot_path: str | None = None) -> dict[str, float]:
     """The outer while-loop (reference: CifarApp.scala:87-128 — infinite
-    there; bounded by ``rounds`` here).  Returns the last eval scores."""
+    there; bounded by ``rounds`` here).  SIGINT stops cleanly (snapshotting
+    first when a path is given), SIGHUP snapshots and continues — the
+    SignalHandler→Solver::Step contract (reference:
+    caffe/src/caffe/util/signal_handler.cpp, solver.cpp:270-281).
+    Returns the last eval scores."""
+    from ..utils.signals import SignalGuard, SolverAction
+
     log = logger or PhaseLogger()
     last_scores: dict[str, float] = {}
-    for r in range(rounds):
-        if test_interval and r % test_interval == 0 and r > 0:
-            log.log("testing")
-            totals = trainer.test(test_factory(), test_steps)
-            last_scores = {k: v / test_steps for k, v in totals.items()}
-            log.log(f"round {r}: eval {last_scores}")
-        t0 = time.perf_counter()
-        batches = feed.next_round()
-        loss = trainer.train_round(batches)
-        log.log(f"round {r}: tau={feed.tau} loss={loss:.4f} "
-                f"({time.perf_counter() - t0:.2f}s)")
+
+    def maybe_snapshot(reason: str) -> None:
+        if snapshot_path:
+            trainer.snapshot(snapshot_path)
+            log.log(f"snapshot ({reason}) -> {snapshot_path}")
+
+    with SignalGuard() as guard:
+        for r in range(rounds):
+            action = guard.check()
+            if action == SolverAction.SNAPSHOT:
+                maybe_snapshot("SIGHUP")
+            elif action == SolverAction.STOP:
+                log.log("stop requested (SIGINT); halting at round boundary")
+                maybe_snapshot("stop")
+                return last_scores
+            if test_interval and r % test_interval == 0 and r > 0:
+                log.log("testing")
+                totals = trainer.test(test_factory(), test_steps)
+                last_scores = {k: v / test_steps for k, v in totals.items()}
+                log.log(f"round {r}: eval {last_scores}")
+            t0 = time.perf_counter()
+            batches = feed.next_round()
+            loss = trainer.train_round(batches)
+            log.log(f"round {r}: tau={feed.tau} loss={loss:.4f} "
+                    f"({time.perf_counter() - t0:.2f}s)")
     totals = trainer.test(test_factory(), test_steps)
     last_scores = {k: v / test_steps for k, v in totals.items()}
     log.log(f"final eval: {last_scores}")

@@ -1,0 +1,80 @@
+"""HDF5 blob IO — the util/hdf5 + HDF5Data/HDF5Output analog.
+
+The reference reads training data from HDF5 (reference:
+caffe/src/caffe/layers/hdf5_data_layer.cpp — `source` is a text file
+listing .h5 files, each holding one dataset per top blob) and writes blobs
+back out (hdf5_output_layer.cpp); blob<->HDF5 conversion in
+caffe/src/caffe/util/hdf5.cpp.  Here the same file conventions are read
+host-side and fed to the graph as ordinary inputs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+try:
+    import h5py
+except ImportError:  # pragma: no cover
+    h5py = None
+
+
+def _require_h5py():
+    if h5py is None:
+        raise ImportError("h5py is required for HDF5 data support")
+
+
+def read_source_list(source: str) -> list[str]:
+    """The HDF5Data `source` convention: a text file of .h5 paths."""
+    base = os.path.dirname(source)
+    out = []
+    with open(source) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(line if os.path.isabs(line)
+                           else os.path.join(base, line))
+    return out
+
+
+def load_hdf5_blobs(path: str, keys: list[str] | None = None
+                    ) -> dict[str, np.ndarray]:
+    """All (or the named) datasets of one .h5 file as float32 arrays."""
+    _require_h5py()
+    with h5py.File(path, "r") as f:
+        names = keys if keys is not None else sorted(f.keys())
+        return {k: np.asarray(f[k], np.float32) for k in names}
+
+
+def save_hdf5_blobs(path: str, blobs: dict[str, np.ndarray]) -> None:
+    """HDF5Output analog: write named blobs to one .h5 file."""
+    _require_h5py()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with h5py.File(path, "w") as f:
+        for k, v in blobs.items():
+            f.create_dataset(k, data=np.asarray(v))
+
+
+def hdf5_feed(source: str, tops: list[str], batch_size: int,
+              shuffle: bool = False, seed: int = 0,
+              ) -> Iterator[dict[str, np.ndarray]]:
+    """Endless minibatch stream over the concatenated listed files — the
+    HDF5DataLayer feed (file order preserved; rows optionally shuffled per
+    epoch like `hdf5_data_param.shuffle`)."""
+    _require_h5py()
+    files = read_source_list(source)
+    data = {t: [] for t in tops}
+    for path in files:
+        blobs = load_hdf5_blobs(path, tops)
+        for t in tops:
+            data[t].append(blobs[t])
+    cat = {t: np.concatenate(data[t]) for t in tops}
+    n = len(next(iter(cat.values())))
+    rng = np.random.default_rng(seed)
+    while True:
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        for i in range(0, n - batch_size + 1, batch_size):
+            idx = order[i:i + batch_size]
+            yield {t: cat[t][idx] for t in tops}

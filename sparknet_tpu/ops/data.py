@@ -88,6 +88,41 @@ class MemoryDataLayer(InputLikeLayer):
         return [(n, c, h, w), (n,)]
 
 
+@register_layer("HDF5Data")
+class HDF5DataLayer(InputLikeLayer):
+    """Host-fed data layer with shapes discovered from the first listed
+    .h5 file (reference: caffe/src/caffe/layers/hdf5_data_layer.cpp; the
+    host feed itself is sparknet_tpu.data.hdf5.hdf5_feed)."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        from ..data.hdf5 import load_hdf5_blobs, read_source_list
+        p = lp.sub("hdf5_data_param")
+        source = p.get("source")
+        batch = int(p.get("batch_size", 1))
+        if source is None:
+            raise ValueError(f"HDF5Data layer {lp.name!r} missing source")
+        blobs = load_hdf5_blobs(read_source_list(str(source))[0],
+                                list(lp.top))
+        return [(batch,) + blobs[t].shape[1:] for t in lp.top]
+
+
+@register_layer("HDF5Output")
+class HDF5OutputLayer(LayerImpl):
+    """Consumes bottoms; the actual file write is host-side
+    (sparknet_tpu.data.hdf5.save_hdf5_blobs) since a compiled TPU graph
+    cannot do file IO — the executor exposes any blob for fetching, which
+    replaces in-graph writing (reference: hdf5_output_layer.cpp)."""
+
+    def min_bottoms(self) -> int:
+        return 1
+
+    def out_shapes(self, lp, bottom_shapes):
+        return []
+
+    def apply(self, lp, params, bottoms, train, rng):
+        return []
+
+
 @register_layer("DummyData")
 class DummyDataLayer(LayerImpl):
     """Filler-generated synthetic data (reference:

@@ -1,0 +1,42 @@
+"""Structured tracing — the profiling tier the reference lacked.
+
+The reference's tracing is wall-clock logs + CUDA-event timers (reference:
+caffe/src/caffe/util/benchmark.cpp:26-145, app logs CifarApp.scala:41-50,
+Spark event log ImageNetApp.scala:44; SURVEY.md §5 "No structured
+tracing").  Here: ``jax.profiler`` traces viewable in TensorBoard/Perfetto,
+plus annotation helpers that mark app phases inside the trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profiler trace for the enclosed block
+    (open in TensorBoard's profile tab or Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (TraceAnnotation), usable as decorator
+    or context manager."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def server(port: int = 9999) -> Iterator[None]:
+    """Live profiling server for `jax.profiler`-compatible clients."""
+    s = jax.profiler.start_server(port)
+    try:
+        yield
+    finally:
+        del s

@@ -1,0 +1,52 @@
+"""Signal-driven snapshot/stop — the SignalHandler analog.
+
+The reference maps SIGINT/SIGHUP to solver actions (snapshot / stop /
+none) checked between iterations (reference:
+caffe/src/caffe/util/signal_handler.cpp:12-115; acted on inside
+``Solver::Step`` at caffe/src/caffe/solver.cpp:270-281).  Same contract
+here: handlers only set flags; the training loop polls between rounds, so
+a snapshot is always taken at a consistent round boundary.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Callable
+
+
+class SolverAction:
+    NONE = "none"
+    STOP = "stop"
+    SNAPSHOT = "snapshot"
+
+
+class SignalGuard:
+    """Install SIGINT→stop and SIGHUP→snapshot (configurable); restore the
+    previous handlers on exit."""
+
+    def __init__(self, sigint_action: str = SolverAction.STOP,
+                 sighup_action: str = SolverAction.SNAPSHOT):
+        self._actions = {signal.SIGINT: sigint_action,
+                         signal.SIGHUP: sighup_action}
+        self._pending: list[str] = []
+        self._previous: dict[int, object] = {}
+
+    def __enter__(self) -> "SignalGuard":
+        for sig, action in self._actions.items():
+            if action == SolverAction.NONE:
+                continue
+            self._previous[sig] = signal.signal(
+                sig, lambda signum, frame: self._pending.append(
+                    self._actions[signum]))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+
+    def check(self) -> str:
+        """The action requested since last check (Solver::GetRequestedAction
+        analog); consumes one pending request."""
+        if self._pending:
+            return self._pending.pop(0)
+        return SolverAction.NONE
