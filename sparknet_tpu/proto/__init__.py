@@ -1,5 +1,17 @@
 from .textformat import PMessage, parse, serialize, ParseError
+from .wireformat import WireError, decode as decode_wire, encode as encode_wire
+from .caffemodel import (
+    array_to_blob,
+    load_caffemodel,
+    load_mean_binaryproto,
+    load_net_binaryproto,
+    load_solverstate,
+    save_caffemodel,
+    save_mean_binaryproto,
+    save_solverstate,
+)
 from .caffe_pb import (
+    blob_to_array,
     BlobShape,
     FillerParameter,
     LayerParameter,

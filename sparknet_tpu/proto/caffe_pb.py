@@ -15,7 +15,10 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import math
 from typing import Any
+
+import numpy as np
 
 from .textformat import PMessage, parse, serialize
 
@@ -23,6 +26,34 @@ from .textformat import PMessage, parse, serialize
 class Phase(enum.IntEnum):
     TRAIN = 0
     TEST = 1
+
+
+def blob_to_array(m: PMessage) -> "np.ndarray":
+    """BlobProto -> ndarray (Blob::FromProto shape rules, reference:
+    caffe/src/caffe/blob.cpp — ``shape`` if present, else legacy
+    num/channels/height/width).  Data may arrive as packed numpy chunks
+    (binary wire decode) or scalar floats (text parse)."""
+    def flat_of(key: str):
+        chunks = [np.atleast_1d(np.asarray(c)) for c in m.get_all(key)]
+        if not chunks:
+            return None
+        flat = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        return flat.astype(np.float32, copy=False)
+
+    flat = flat_of("data")
+    if flat is None:
+        flat = flat_of("double_data")
+    if flat is None:
+        flat = np.zeros((0,), np.float32)
+    shape_msg = m.get("shape")
+    if isinstance(shape_msg, PMessage):
+        shape = tuple(BlobShape.from_pmsg(shape_msg).dim)
+    else:
+        legacy = [int(m.get(k, 0)) for k in ("num", "channels", "height", "width")]
+        shape = tuple(legacy) if any(legacy) else (flat.size,)
+    if math.prod(shape) != flat.size:
+        raise ValueError(f"BlobProto count {flat.size} != shape {shape} product")
+    return flat.reshape(shape)
 
 
 def _phase_of(v: Any) -> Phase | None:
@@ -41,7 +72,11 @@ class BlobShape:
 
     @classmethod
     def from_pmsg(cls, m: PMessage) -> "BlobShape":
-        return cls(dim=[int(d) for d in m.get_all("dim")])
+        dims: list[int] = []
+        for d in m.get_all("dim"):
+            # binary decode yields packed numpy vectors; text yields scalars
+            dims.extend(int(x) for x in np.atleast_1d(np.asarray(d)))
+        return cls(dim=dims)
 
     def to_pmsg(self) -> PMessage:
         m = PMessage()
@@ -203,6 +238,9 @@ class LayerParameter:
     propagate_down: list[bool] = dataclasses.field(default_factory=list)
     # type-specific sub-configs, kept schema-free:
     params: dict[str, PMessage] = dataclasses.field(default_factory=dict)
+    # trained weight blobs, present when loaded from a .caffemodel
+    # (reference: caffe.proto LayerParameter.blobs=7, V1LayerParameter.blobs=6)
+    blobs: list[Any] = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_pmsg(cls, m: PMessage, v1: bool = False) -> "LayerParameter":
@@ -240,6 +278,8 @@ class LayerParameter:
             sub = m.get(key)
             if isinstance(sub, PMessage):
                 lp.params[key] = sub
+        lp.blobs = [blob_to_array(b) for b in m.get_all("blobs")
+                    if isinstance(b, PMessage)]
         return lp
 
     def sub(self, key: str) -> PMessage:

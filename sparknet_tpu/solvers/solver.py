@@ -124,6 +124,10 @@ class Solver:
             self.iter += 1
             if self.sp.display and self.iter % self.sp.display == 0:
                 print(f"Iteration {self.iter}, loss = {self.smoothed_loss():.6f}")
+            # snapshot-on-schedule (reference: solver.cpp:270-277)
+            if (self.sp.snapshot and self.sp.snapshot_prefix
+                    and self.iter % self.sp.snapshot == 0):
+                self.snapshot_caffe()
         return self.smoothed_loss() if self._smoothed else loss
 
     def _next_batches(self):
@@ -177,10 +181,137 @@ class Solver:
     def load_weights(self, path: str) -> None:
         """Weights-only load (Net::CopyTrainedLayersFrom; reference:
         net.cpp:843-848, Net.scala:195-197): copy blobs for layers whose
-        names match, leave the rest initialized."""
-        from ..utils.checkpoint import load_checkpoint
-        blob = load_checkpoint(path)
-        saved = blob["params"] if "params" in blob else blob
-        for k, v in saved.items():
-            if k in self.params:
-                self.params[k] = [jnp.asarray(b) for b in v]
+        names match, leave the rest initialized.  Accepts the repo's npz
+        checkpoints AND Caffe ``.caffemodel``/binaryproto files (sniffed by
+        magic; net.cpp:805-848), including V1-format zoo models."""
+        with open(path, "rb") as f:
+            magic = f.read(4)
+        if magic[:2] == b"PK":  # npz (zip) — framework-native checkpoint
+            from ..utils.checkpoint import load_checkpoint
+            blob = load_checkpoint(path)
+            saved = blob["params"] if "params" in blob else blob
+            for k, v in saved.items():
+                if k in self.params:
+                    self.params[k] = [jnp.asarray(b) for b in v]
+            return
+        from ..proto.caffemodel import load_caffemodel
+        self.copy_trained_layers_from(load_caffemodel(path))
+
+    @staticmethod
+    def _shape_adapt(src, dst_shape, where: str):
+        """Legacy-shape tolerance, no broader: a saved blob may be reshaped
+        only when it is the same dims modulo size-1 axes (the legacy 4-d
+        spellings like (1,1,N,K) for an (N,K) fc blob — Blob::ShapeEquals,
+        reference: blob.cpp).  Any other mismatch raises, as Caffe's shape
+        CHECKs do (a same-size layout difference, e.g. a transposed ip
+        weight, must not be silently reshaped)."""
+        import numpy as np
+        src = np.asarray(src)
+        if src.shape == tuple(dst_shape):
+            return src
+        squeeze = lambda s: tuple(d for d in s if d != 1)
+        if squeeze(src.shape) != squeeze(dst_shape):
+            raise ValueError(
+                f"{where}: checkpoint shape {src.shape} incompatible with "
+                f"net shape {tuple(dst_shape)}")
+        return src.reshape(dst_shape)
+
+    def copy_trained_layers_from(self, saved: Mapping[str, list]) -> None:
+        """Copy blobs by layer name (Net::CopyTrainedLayersFrom semantics;
+        reference: net.cpp:805-842 — matching names copied with shape
+        CHECKs, everything else left initialized)."""
+        staged: dict[str, list] = {}
+        for name, blobs in saved.items():
+            if name not in self.params:
+                continue
+            target = self.params[name]
+            if len(blobs) != len(target):
+                raise ValueError(
+                    f"layer {name!r}: checkpoint has {len(blobs)} blobs, "
+                    f"net expects {len(target)}")
+            staged[name] = [
+                jnp.asarray(self._shape_adapt(src, dst.shape,
+                                              f"layer {name!r} blob {i}"),
+                            dst.dtype)
+                for i, (src, dst) in enumerate(zip(blobs, target))]
+        # commit only after every layer validated — a partial copy must not
+        # leave the solver with half-replaced weights
+        self.params.update(staged)
+
+    # -- Caffe-format snapshots (Solver::Snapshot/Restore with
+    #    snapshot_format=BINARYPROTO; reference: solver.cpp:447-530,
+    #    sgd_solver.cpp:242-296) -------------------------------------------
+    _HISTORY_SLOTS = {
+        "SGD": ("history",), "NESTEROV": ("history",),
+        "ADAGRAD": ("history",), "RMSPROP": ("history",),
+        "ADADELTA": ("sq_grad", "sq_update"), "ADAM": ("m", "v"),
+    }
+
+    def _history_flat(self) -> list:
+        """Flatten optimizer state into Caffe's history-blob order: one run
+        of learnable-param-order blobs per slot (AdaDelta/Adam push a second
+        run onto ``history_``; reference: adadelta_solver.cpp ctor,
+        adam_solver.cpp AdamPreSolve)."""
+        import numpy as np
+        flat = []
+        for slot in self._HISTORY_SLOTS[self.rule.name]:
+            tree = self.state[slot]
+            for key in self.params:
+                flat.extend(np.asarray(b) for b in tree[key])
+        return flat
+
+    def snapshot_caffe(self, prefix: str | None = None) -> tuple[str, str]:
+        """Write ``<prefix>_iter_N.caffemodel`` + ``.solverstate`` exactly as
+        Solver::Snapshot names them (reference: solver.cpp:461-476)."""
+        from ..proto.caffemodel import save_caffemodel, save_solverstate
+        prefix = prefix if prefix is not None else self.sp.snapshot_prefix
+        base = f"{prefix}_iter_{self.iter}"
+        model_path = base + ".caffemodel"
+        state_path = base + ".solverstate"
+        net_param = self.sp.net_param or self.sp.train_net_param
+        save_caffemodel(model_path, self.params, net_param)
+        save_solverstate(state_path, self.iter, self._history_flat(),
+                         learned_net=model_path)
+        return model_path, state_path
+
+    def restore_caffe(self, state_path: str) -> None:
+        """Restore from a ``.solverstate`` (+ its learned_net caffemodel if
+        present; reference: solver.cpp:510-530, sgd_solver.cpp:280-296)."""
+        import os
+
+        from ..proto.caffemodel import load_solverstate
+        st = load_solverstate(state_path)
+        history = st["history"]
+        slots = self._HISTORY_SLOTS[self.rule.name]
+        n_blobs = sum(len(v) for v in self.params.values())
+        if len(history) != n_blobs * len(slots):
+            raise ValueError(
+                f"solverstate has {len(history)} history blobs, expected "
+                f"{n_blobs * len(slots)} ({len(slots)} slot(s) × {n_blobs})")
+        # validate + stage everything before mutating any solver state
+        idx = 0
+        new_state = dict(self.state)
+        for slot in slots:
+            tree = {}
+            for key in self.params:
+                blobs = []
+                for i, dst in enumerate(self.params[key]):
+                    src = self._shape_adapt(
+                        history[idx], dst.shape,
+                        f"history[{idx}] (layer {key!r} blob {i}, "
+                        f"slot {slot!r})")
+                    idx += 1
+                    blobs.append(jnp.asarray(src, dst.dtype))
+                tree[key] = blobs
+            new_state[slot] = tree
+        if st["learned_net"]:
+            # Caffe dies if the referenced model file is unreadable
+            # (ReadNetParamsFromBinaryFileOrDie); resuming optimizer history
+            # over fresh random weights would silently diverge.
+            if not os.path.exists(st["learned_net"]):
+                raise FileNotFoundError(
+                    f"solverstate references learned_net "
+                    f"{st['learned_net']!r}, which does not exist")
+            self.load_weights(st["learned_net"])
+        self.state = new_state
+        self.iter = st["iter"]
