@@ -4,68 +4,225 @@ Methodology mirrors the reference's published numbers — 20 training
 iterations at batch 256, full forward+backward+update, data resident on
 device (reference: caffe/docs/performance_hardware.md:19-25, the `caffe
 train` 20-iter protocol; best single-GPU baseline 19.2 s ⇒ ≈267 img/s on
-K40+cuDNN).  Prints ONE JSON line.
+K40+cuDNN).  Also reports the eval-pass throughput analog
+(performance_hardware.md:20,25) and model-FLOPs MFU.
+
+Prints ONE JSON line on stdout.  Progress and diagnostics go to stderr.
+
+Robustness: the axon TPU plugin either fails fast (UNAVAILABLE) or *hangs
+forever* during backend init when its tunnel is down.  The parent process
+therefore runs the real benchmark in a child subprocess under a hard
+timeout, retries with backoff, and on exhaustion emits a diagnostic JSON
+line instead of a stack trace.  A persistent XLA compilation cache makes
+retried attempts cheap.
+
+Env knobs (for smoke-testing): BENCH_PLATFORM=cpu, BENCH_MODEL=lenet,
+BENCH_BATCH, BENCH_ITERS, BENCH_REPS, BENCH_TIMEOUT_S, BENCH_ATTEMPTS.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+BASELINE_IMG_S = 267.0     # K40 + cuDNN train, performance_hardware.md:24
+BASELINE_BLOCK_S = 19.2    # seconds per 20 iter × 256, same row
+BASELINE_EVAL_IMG_S = 50000 / 60.7  # K40 + cuDNN test pass, ":25"
 
-BASELINE_IMG_S = 267.0  # K40 + cuDNN, performance_hardware.md:24
-BATCH = 256
-ITERS = 20
+BATCH = int(os.environ.get("BENCH_BATCH", 256))
+ITERS = int(os.environ.get("BENCH_ITERS", 20))
 WARMUP = 3
-REPS = 5  # tunneled chip shows ~2x run-to-run variance; report the median
+REPS = int(os.environ.get("BENCH_REPS", 5))  # tunneled chip: ~2x run-to-run
+MODEL = os.environ.get("BENCH_MODEL", "caffenet")
+
+# bf16 peak by device kind, for the MFU denominator (public spec sheets).
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+    "TPU v5p": 459e12, "TPU v5": 459e12,
+    "TPU v4": 275e12, "TPU v4 lite": 138e12,
+    "TPU v3": 123e12, "TPU v2": 46e12,
+    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
 
 
-def main() -> None:
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual measurement
+# ---------------------------------------------------------------------------
+
+def run_child() -> None:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(__file__), ".jax_cache"))
     import jax
-    import jax.numpy as jnp
 
-    from sparknet_tpu.models import caffenet
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    t0 = time.perf_counter()
+    devices = jax.devices()  # the hang/fail point when the tunnel is down
+    dev = devices[0]
+    _log(f"backend up in {time.perf_counter() - t0:.1f}s: "
+         f"{dev.platform}/{dev.device_kind} ×{len(devices)}")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu.models import caffenet, lenet
     from sparknet_tpu.proto import load_solver_prototxt_with_net
     from sparknet_tpu.solvers import Solver
 
+    if MODEL == "lenet":
+        net, in_shape, classes = lenet(BATCH, BATCH), (1, 28, 28), 10
+    else:
+        net, in_shape, classes = caffenet(BATCH, BATCH), (3, 227, 227), 1000
+
     sp = load_solver_prototxt_with_net(
         'base_lr: 0.01\nmomentum: 0.9\nweight_decay: 0.0005\n'
-        'lr_policy: "step"\ngamma: 0.1\nstepsize: 100000\n',
-        caffenet(BATCH, BATCH))
+        'lr_policy: "step"\ngamma: 0.1\nstepsize: 100000\n', net)
     solver = Solver(sp, seed=0)
 
     rng = np.random.default_rng(0)
-    data = jnp.asarray(rng.normal(size=(1, BATCH, 3, 227, 227)).astype(np.float32))
-    label = jnp.asarray(rng.integers(0, 1000, size=(1, BATCH)).astype(np.float32))
+    data = jnp.asarray(rng.normal(size=(1, BATCH) + in_shape).astype(np.float32))
+    label = jnp.asarray(rng.integers(0, classes, size=(1, BATCH)).astype(np.float32))
     batch = {"data": data, "label": label}
 
+    # train step: compile (cached across attempts), then measure
     step_rng = jax.random.PRNGKey(0)
     params, state = solver.params, solver.state
+    t0 = time.perf_counter()
+    flops_per_step = None
+    try:
+        lowered = solver._step.lower(params, state, 0, batch,
+                                     jax.random.PRNGKey(1))
+        cost = lowered.compile().cost_analysis()
+        if cost:
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception as e:  # cost analysis is best-effort
+        _log(f"cost_analysis unavailable: {e}")
     for i in range(WARMUP):
         step_rng, sub = jax.random.split(step_rng)
         params, state, loss = solver._step(params, state, i, batch, sub)
     jax.block_until_ready(loss)
+    _log(f"train compile+warmup in {time.perf_counter() - t0:.1f}s")
 
-    rates = []
+    rates, blocks = [], []
     it = WARMUP
-    for _ in range(REPS):
+    for rep in range(REPS):
         t0 = time.perf_counter()
         for _ in range(ITERS):
             step_rng, sub = jax.random.split(step_rng)
             params, state, loss = solver._step(params, state, it, batch, sub)
             it += 1
         jax.block_until_ready(loss)
-        rates.append(BATCH * ITERS / (time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        blocks.append(dt * (20 / ITERS))  # normalize to the 20-iter protocol
+        rates.append(BATCH * ITERS / dt)
+        _log(f"train rep {rep + 1}/{REPS}: {rates[-1]:.1f} img/s "
+             f"({dt:.2f}s / {ITERS} iters)")
+
+    # eval pass (test-net forward only; performance_hardware.md:20,25)
+    eval_batch = {"data": data[0], "label": label[0]}
+    t0 = time.perf_counter()
+    out = solver._test_fwd(params, eval_batch)
+    jax.block_until_ready(out)
+    _log(f"eval compile in {time.perf_counter() - t0:.1f}s")
+    eval_rates = []
+    for rep in range(REPS):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = solver._test_fwd(params, eval_batch)
+        jax.block_until_ready(out)
+        eval_rates.append(BATCH * ITERS / (time.perf_counter() - t0))
+        _log(f"eval rep {rep + 1}/{REPS}: {eval_rates[-1]:.1f} img/s")
 
     img_s = float(np.median(rates))
-    print(json.dumps({
-        "metric": "caffenet_train_images_per_sec",
+    block_s = float(np.median(blocks))
+    eval_img_s = float(np.median(eval_rates))
+    step_s = block_s / 20.0
+    peak = _PEAK_FLOPS.get(dev.device_kind)
+    mfu = (flops_per_step / step_s / peak) if (flops_per_step and peak) else None
+
+    result = {
+        "metric": f"{MODEL}_train_images_per_sec",
         "value": round(img_s, 1),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
-    }))
+        "block_20x256_s": round(block_s, 3),
+        "baseline_block_s": BASELINE_BLOCK_S,
+        "eval_images_per_sec": round(eval_img_s, 1),
+        "eval_vs_baseline": round(eval_img_s / BASELINE_EVAL_IMG_S, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_step": flops_per_step,
+        "device": f"{dev.platform}/{dev.device_kind}",
+        "batch": BATCH,
+        "iters_per_block": ITERS,
+        "reps": REPS,
+    }
+    print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent: probe/retry orchestration
+# ---------------------------------------------------------------------------
+
+def _backoff(attempt: int, attempts: int) -> None:
+    if attempt < attempts:  # no pointless sleep after the final attempt
+        time.sleep(min(30 * attempt, 120))
+
+
+def run_parent() -> int:
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", 4))
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", 900))
+    failures: list[str] = []
+    for attempt in range(1, attempts + 1):
+        _log(f"attempt {attempt}/{attempts} (timeout {timeout_s:.0f}s)")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                stdout=subprocess.PIPE, stderr=None,
+                timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            failures.append(f"attempt {attempt}: timed out after "
+                            f"{timeout_s:.0f}s (axon backend hang?)")
+            _log(failures[-1])
+            _backoff(attempt, attempts)
+            continue
+        lines = proc.stdout.decode().strip().splitlines()
+        if proc.returncode == 0 and lines:
+            try:
+                json.loads(lines[-1])
+            except json.JSONDecodeError:
+                failures.append(
+                    f"attempt {attempt}: rc=0 but no JSON tail: {lines[-1]!r}")
+                _log(failures[-1])
+                _backoff(attempt, attempts)
+                continue
+            print(lines[-1], flush=True)
+            return 0
+        tail = "\n".join(lines[-8:]) if lines else "(no stdout)"
+        failures.append(f"attempt {attempt}: rc={proc.returncode}: {tail}")
+        _log(failures[-1])
+        _backoff(attempt, attempts)
+    print(json.dumps({
+        "metric": f"{MODEL}_train_images_per_sec",
+        "value": 0.0,
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "error": f"benchmark failed after {attempts} attempts",
+        "attempts": failures,
+    }), flush=True)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        run_child()
+    else:
+        sys.exit(run_parent())

@@ -105,7 +105,7 @@ def main(argv=None) -> dict[str, float]:
         list(zip(train_x, train_y)), workers)
     test_ds = PartitionedDataset.from_items(
         list(zip(test_x, test_y)), workers)
-    feed = RoundFeed(train_ds, args.batch, args.tau, seed=3)
+    feed = RoundFeed(train_ds, args.batch, trainer.batches_per_round, seed=3)
     test_factory, test_steps = eval_feed(test_ds, args.batch)
 
     scores = run_training(trainer, feed, test_factory, test_steps,

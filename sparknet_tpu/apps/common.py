@@ -7,17 +7,27 @@ collect & average weights → every 10 rounds, a distributed eval whose
 per-worker scores are summed on the driver (:138-140).  Here broadcast/
 collect/average live inside the trainer's compiled round; the app loop only
 assembles per-round feeds and logs.
+
+Feed design: the reference's JavaData path is synchronous — the solver
+blocks on a C→JVM callback per minibatch (reference:
+caffe/src/caffe/layers/java_data_layer.cpp:36-44, the measured hot spot of
+CallbackBenchmarkSpec) and the whole partition is pulled through RDD
+iterators.  Here rounds are assembled *lazily* (only the sampled τ×batch
+slice of each partition is ever stacked — partitions themselves stay as
+record lists, the RDD-iterator analog) and flow through a background
+prefetch + async ``device_put`` (``data/prefetch.py``), so host
+preprocessing of round N+1 overlaps round N's device compute.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, Sequence
+from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
-from ..data.minibatch import make_minibatches
 from ..data.partition import PartitionedDataset
+from ..data.prefetch import device_feed
 from ..parallel.trainer import DistributedTrainer
 from ..utils.timing import PhaseLogger
 
@@ -28,35 +38,50 @@ class RoundFeed:
     per partition (MinibatchSampler's contiguous-run semantics, reference:
     src/main/scala/libs/MinibatchSampler.scala:18-19), with a per-batch
     preprocessing closure (the setTrainData(preprocess) argument, reference:
-    src/main/scala/libs/Net.scala:79-84)."""
+    src/main/scala/libs/Net.scala:79-84).
+
+    Partitions are NOT materialized as stacked arrays: each round stacks
+    only the sampled slice, so resident memory is O(τ·batch), not
+    O(partition) — matching the reference's lazy RDD-iterator feed."""
 
     def __init__(self, dataset: PartitionedDataset, per_worker_batch: int,
-                 tau: int,
+                 batches_per_round: int,
                  preprocess: Callable[[np.ndarray], np.ndarray] | None = None,
                  seed: int = 0):
-        self.tau = tau
+        # τ steps × iter_size micro-batches (DistributedTrainer.
+        # batches_per_round) — the number of minibatches one round consumes
+        self.batches_per_round = batches_per_round
+        self.batch = per_worker_batch
         self.preprocess = preprocess
         self._rng = np.random.default_rng(seed)
-        self._parts = []
-        for p in dataset.partitions:
-            images = np.stack([x for x, _ in p])
-            labels = np.asarray([y for _, y in p], np.float32)
-            batches = make_minibatches(images, labels, per_worker_batch)
-            if len(batches) < tau:
+        self._parts = dataset.partitions
+        # drop-remainder batch counts (ScaleAndConvert.makeMinibatchRDD
+        # semantics, reference: ScaleAndConvert.scala:30-55)
+        self._n_batches = [len(p) // per_worker_batch for p in self._parts]
+        for nb in self._n_batches:
+            if nb < batches_per_round:
                 raise ValueError(
-                    f"partition has {len(batches)} minibatches < tau={tau}")
-            self._parts.append(batches)
+                    f"partition has {nb} minibatches < batches_per_round="
+                    f"{batches_per_round}")
+
+    def _minibatch(self, part, batch_idx: int
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        lo = batch_idx * self.batch
+        recs = part[lo:lo + self.batch]
+        x = np.stack([r[0] for r in recs])
+        y = np.asarray([r[1] for r in recs], np.float32)
+        if self.preprocess is not None:
+            x = self.preprocess(x)
+        return x, y
 
     def next_round(self) -> dict[str, np.ndarray]:
+        starts = [int(self._rng.integers(0, nb - self.batches_per_round + 1))
+                  for nb in self._n_batches]
         data_steps, label_steps = [], []
-        starts = [int(self._rng.integers(0, len(b) - self.tau + 1))
-                  for b in self._parts]
-        for t in range(self.tau):
+        for t in range(self.batches_per_round):
             imgs, labs = [], []
-            for w, batches in enumerate(self._parts):
-                x, y = batches[starts[w] + t]
-                if self.preprocess is not None:
-                    x = self.preprocess(x)
+            for part, start in zip(self._parts, starts):
+                x, y = self._minibatch(part, start + t)
                 imgs.append(x)
                 labs.append(y)
             data_steps.append(np.concatenate(imgs))
@@ -64,17 +89,20 @@ class RoundFeed:
         return {"data": np.stack(data_steps),
                 "label": np.stack(label_steps)}
 
+    def rounds(self) -> Iterator[dict[str, np.ndarray]]:
+        """Endless round stream — feed this to ``device_feed`` for
+        prefetch + async host→HBM transfer."""
+        while True:
+            yield self.next_round()
+
 
 def eval_feed(dataset: PartitionedDataset, per_worker_batch: int,
               preprocess: Callable[[np.ndarray], np.ndarray] | None = None):
     """Global test minibatches spanning all partitions (the zipPartitions
-    test pass, reference: ImageNetApp.scala:108-137)."""
-    n_parts = dataset.num_partitions
-    per_part = [make_minibatches(
-        np.stack([x for x, _ in p]),
-        np.asarray([y for _, y in p], np.float32), per_worker_batch)
-        for p in dataset.partitions]
-    steps = min(len(b) for b in per_part)
+    test pass, reference: ImageNetApp.scala:108-137).  Lazy: each step
+    stacks only its own slice of every partition."""
+    parts = dataset.partitions
+    steps = min(len(p) // per_worker_batch for p in parts)
     if steps == 0:
         sizes = dataset.partition_sizes()
         raise ValueError(
@@ -84,8 +112,10 @@ def eval_feed(dataset: PartitionedDataset, per_worker_batch: int,
     def factory():
         for t in range(steps):
             imgs, labs = [], []
-            for w in range(n_parts):
-                x, y = per_part[w][t]
+            for p in parts:
+                recs = p[t * per_worker_batch:(t + 1) * per_worker_batch]
+                x = np.stack([r[0] for r in recs])
+                y = np.asarray([r[1] for r in recs], np.float32)
                 if preprocess is not None:
                     x = preprocess(x)
                 imgs.append(x)
@@ -99,24 +129,31 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
                  test_factory, test_steps: int, *, rounds: int,
                  test_interval: int = 10,
                  logger: PhaseLogger | None = None,
-                 snapshot_path: str | None = None) -> dict[str, float]:
+                 snapshot_path: str | None = None,
+                 prefetch_depth: int = 1) -> dict[str, float]:
     """The outer while-loop (reference: CifarApp.scala:87-128 — infinite
     there; bounded by ``rounds`` here).  SIGINT stops cleanly (snapshotting
     first when a path is given), SIGHUP snapshots and continues — the
     SignalHandler→Solver::Step contract (reference:
     caffe/src/caffe/util/signal_handler.cpp, solver.cpp:270-281).
-    Returns the last eval scores."""
+
+    Round feeds are prefetched and device_put off-thread (``prefetch_depth``
+    rounds ahead; default 1 — a τ×global_batch round is large in HBM), so
+    the host never serializes with the compiled round — the fix for the
+    reference's synchronous JavaData feed.  Returns the last eval scores."""
     from ..utils.signals import SignalGuard, SolverAction
 
     log = logger or PhaseLogger()
     last_scores: dict[str, float] = {}
+    round_iter = device_feed(feed.rounds(), depth=prefetch_depth,
+                             sharding=trainer.input_sharding)
 
     def maybe_snapshot(reason: str) -> None:
         if snapshot_path:
             trainer.snapshot(snapshot_path)
             log.log(f"snapshot ({reason}) -> {snapshot_path}")
 
-    with SignalGuard() as guard:
+    with round_iter, SignalGuard() as guard:
         for r in range(rounds):
             action = guard.check()
             if action == SolverAction.SNAPSHOT:
@@ -131,10 +168,10 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
                 last_scores = {k: v / test_steps for k, v in totals.items()}
                 log.log(f"round {r}: eval {last_scores}")
             t0 = time.perf_counter()
-            batches = feed.next_round()
+            batches = next(round_iter)
             loss = trainer.train_round(batches)
-            log.log(f"round {r}: tau={feed.tau} loss={loss:.4f} "
-                    f"({time.perf_counter() - t0:.2f}s)")
+            log.log(f"round {r}: tau={trainer.config.tau} "
+                    f"loss={loss:.4f} ({time.perf_counter() - t0:.2f}s)")
     totals = trainer.test(test_factory(), test_steps)
     last_scores = {k: v / test_steps for k, v in totals.items()}
     log.log(f"final eval: {last_scores}")

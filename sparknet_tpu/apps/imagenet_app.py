@@ -139,7 +139,7 @@ def main(argv=None) -> dict[str, float]:
     log.log(f"built {args.model} on {workers}-worker mesh "
             f"({args.strategy}, tau={args.tau}, crop={crop})")
 
-    feed = RoundFeed(train_ds, args.batch, args.tau,
+    feed = RoundFeed(train_ds, args.batch, trainer.batches_per_round,
                      preprocess=lambda x: train_pre(x), seed=3)
     test_factory, test_steps = eval_feed(test_ds, args.batch,
                                          preprocess=lambda x: test_pre(x))

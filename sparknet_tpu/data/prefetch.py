@@ -23,7 +23,14 @@ import jax
 
 
 class PrefetchIterator:
-    """Wrap an iterator; a background thread keeps `depth` items ready."""
+    """Wrap an iterator; a background thread keeps `depth` items ready.
+
+    ``close()`` stops the producer thread and drops staged items — required
+    for endless sources (``RoundFeed.rounds()``), where the producer would
+    otherwise stay blocked on the full queue holding device memory for the
+    rest of the process (the explicit lifecycle Caffe's InternalThread
+    gives its prefetch thread; reference: internal_thread.hpp:29-42).
+    Usable as a context manager."""
 
     _SENTINEL = object()
 
@@ -31,15 +38,29 @@ class PrefetchIterator:
                  transform: Callable[[Any], Any] | None = None):
         self._q: queue.Queue[Any] = queue.Queue(maxsize=depth)
         self._err: BaseException | None = None
+        self._stop = threading.Event()
+        self._done = False
+
+        def put(item: Any) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def run() -> None:
             try:
                 for item in it:
-                    self._q.put(transform(item) if transform else item)
+                    if self._stop.is_set():
+                        return
+                    if not put(transform(item) if transform else item):
+                        return
             except BaseException as e:  # surfaced on next()
                 self._err = e
             finally:
-                self._q.put(self._SENTINEL)
+                put(self._SENTINEL)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
@@ -48,7 +69,7 @@ class PrefetchIterator:
         return self
 
     def __next__(self) -> Any:
-        if getattr(self, "_done", False):
+        if self._done:
             if self._err is not None:
                 raise self._err
             raise StopIteration
@@ -59,6 +80,23 @@ class PrefetchIterator:
                 raise self._err
             raise StopIteration
         return item
+
+    def close(self) -> None:
+        """Stop the producer and release staged items."""
+        self._stop.set()
+        self._done = True
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def device_feed(batches: Iterator[Mapping[str, Any]], depth: int = 2,

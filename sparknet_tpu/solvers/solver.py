@@ -22,8 +22,7 @@ import jax.numpy as jnp
 
 from ..graph.net import Net, WeightCollection
 from ..proto.caffe_pb import NetParameter, NetState, Phase, SolverParameter
-from .lr_policies import learning_rate
-from .update_rules import make_update_rule, preprocess_grads
+from .update_rules import make_update_rule
 
 
 class Solver:
@@ -65,39 +64,14 @@ class Solver:
     # -- pure step construction ------------------------------------------
     def make_train_step(self):
         """Build the pure (params, state, it, batches, rng) -> (params,
-        state, loss) function.  ``batches`` has a leading iter_size axis."""
-        sp = self.sp
-        net = self.train_net
-        rule = self.rule
-        lr_mults = self._lr_mults
-        decay_mults = self._decay_mults
-
+        state, loss) function.  ``batches`` has a leading iter_size axis.
+        The body — iter_size accumulation → preprocess → rule update — is
+        the shared ``local_update`` of ``step.make_step_fns``."""
         from .step import make_step_fns
-        one_grad, _ = make_step_fns(sp, net, rule, lr_mults, decay_mults)
-
-        def step(params, state, it, batches, rng):
-            if sp.iter_size == 1:
-                batch = jax.tree_util.tree_map(lambda x: x[0], batches)
-                loss, params, grads = one_grad(params, batch, rng)
-            else:
-                def body(carry, batch):
-                    params, acc, rng = carry
-                    rng, sub = jax.random.split(rng)
-                    loss, params, g = one_grad(params, batch, sub)
-                    acc = jax.tree_util.tree_map(jnp.add, acc, g)
-                    return (params, acc, rng), loss
-
-                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
-                (params, grads, _), losses = jax.lax.scan(
-                    body, (params, zero, rng), batches)
-                loss = jnp.mean(losses)
-            grads = preprocess_grads(sp, params, grads, lr_mults, decay_mults)
-            rate = learning_rate(sp, it)
-            new_params, new_state = rule.apply(
-                params, grads, state, rate, it, lr_mults=lr_mults)
-            return new_params, new_state, loss
-
-        return step
+        _, local_update, _ = make_step_fns(
+            self.sp, self.train_net, self.rule, self._lr_mults,
+            self._decay_mults)
+        return local_update
 
     # -- data feeding (CaffeNet.setTrainData/setTestData analog;
     #    reference: src/main/scala/libs/Net.scala:79-92) ------------------

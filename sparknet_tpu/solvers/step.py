@@ -10,6 +10,7 @@ caffe/src/caffe/solver.cpp:221-262, solvers/sgd_solver.cpp:102-143).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from ..graph.net import Net
 from ..proto.caffe_pb import SolverParameter
@@ -19,10 +20,15 @@ from .update_rules import SolverUpdate, preprocess_grads
 
 def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
                   lr_mults, decay_mults):
-    """Returns (loss_and_grads, local_update):
+    """Returns (loss_and_grads, local_update, accum_loss_and_grads):
 
     - ``loss_and_grads(params, batch, rng) -> (loss, params_with_bn, grads)``
-    - ``local_update(params, state, it, batch, rng) -> (params, state, loss)``
+    - ``local_update(params, state, it, batches, rng) -> (params, state,
+      loss)`` — one full solver step over [iter_size, batch, ...] feeds
+    - ``accum_loss_and_grads(params, batches, rng) -> (loss, params, grads)``
+      — the ``iter_size`` micro-batch accumulation of ``Solver::Step``
+      (reference: solver.cpp:221-224), raw summed grads (normalization by
+      iter_size happens in ``preprocess_grads``)
     """
 
     def loss_and_grads(params, batch, rng):
@@ -33,12 +39,30 @@ def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
             loss_fn, has_aux=True)(params)
         return loss, new_params, grads
 
-    def local_update(params, state, it, batch, rng):
-        loss, params, grads = loss_and_grads(params, batch, rng)
+    def accum_loss_and_grads(params, batches, rng):
+        """``batches`` leaves carry a leading iter_size axis."""
+        if sp.iter_size == 1:
+            batch = jax.tree_util.tree_map(lambda x: x[0], batches)
+            return loss_and_grads(params, batch, rng)
+
+        def body(carry, batch):
+            params, acc, rng = carry
+            rng, sub = jax.random.split(rng)
+            loss, params, g = loss_and_grads(params, batch, sub)
+            acc = jax.tree_util.tree_map(jnp.add, acc, g)
+            return (params, acc, rng), loss
+
+        zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (params, grads, _), losses = jax.lax.scan(
+            body, (params, zero, rng), batches)
+        return jnp.mean(losses), params, grads
+
+    def local_update(params, state, it, batches, rng):
+        loss, params, grads = accum_loss_and_grads(params, batches, rng)
         grads = preprocess_grads(sp, params, grads, lr_mults, decay_mults)
         rate = learning_rate(sp, it)
         params, state = rule.apply(params, grads, state, rate, it,
                                    lr_mults=lr_mults)
         return params, state, loss
 
-    return loss_and_grads, local_update
+    return loss_and_grads, local_update, accum_loss_and_grads

@@ -84,7 +84,7 @@ def test_load_imagenet_partitions(imagenet_fixture):
 def test_round_feed_shapes_and_preprocess(np_rng):
     items = [(np.full((3, 8, 8), i, np.float32), i % 5) for i in range(40)]
     ds = PartitionedDataset.from_items(items, 2)
-    feed = RoundFeed(ds, per_worker_batch=4, tau=3,
+    feed = RoundFeed(ds, per_worker_batch=4, batches_per_round=3,
                      preprocess=lambda x: x * 2.0, seed=0)
     round_ = feed.next_round()
     assert round_["data"].shape == (3, 8, 3, 8, 8)
@@ -92,8 +92,64 @@ def test_round_feed_shapes_and_preprocess(np_rng):
     # preprocess applied (values doubled)
     assert round_["data"].max() >= 2.0
 
-    with pytest.raises(ValueError, match="< tau"):
-        RoundFeed(ds, per_worker_batch=4, tau=99)
+    with pytest.raises(ValueError, match="< batches_per_round"):
+        RoundFeed(ds, per_worker_batch=4, batches_per_round=99)
+
+
+def test_round_feed_prefetch_overlap():
+    """The feed thread must run ahead of the consumer: after one round is
+    consumed, the NEXT round's preprocessing happens in the background with
+    no further pull — the double-buffering the reference's JavaData path
+    lacked (reference: java_data_layer.cpp:36-44, SURVEY.md §7.2(5))."""
+    import time
+
+    from sparknet_tpu.data.prefetch import device_feed
+
+    calls: list[float] = []
+
+    def preproc(x):
+        calls.append(time.monotonic())
+        return x
+
+    items = [(np.zeros((1, 4, 4), np.float32), i % 5) for i in range(32)]
+    ds = PartitionedDataset.from_items(items, 2)
+    feed = RoundFeed(ds, per_worker_batch=2, batches_per_round=2, preprocess=preproc)
+    per_round = 2 * 2  # tau × partitions preprocess calls per round
+    it = device_feed(feed.rounds(), depth=1)
+    first = next(it)
+    assert first["data"].shape == (2, 4, 1, 4, 4)
+    # consumer holds round 1 and never pulls again; the background thread
+    # must still assemble (preprocess) round 2 on its own
+    deadline = time.monotonic() + 10.0
+    while len(calls) < 2 * per_round and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(calls) >= 2 * per_round, (
+        f"prefetch thread idle: only {len(calls)} preprocess calls")
+
+
+def test_round_feed_streaming_slices_only():
+    """Rounds must stack only the sampled slice, never whole partitions:
+    records are probed through a counting __getitem__ proxy."""
+    class CountingList(list):
+        def __init__(self, items):
+            super().__init__(items)
+            self.slices: list[slice] = []
+
+        def __getitem__(self, key):
+            if isinstance(key, slice):
+                self.slices.append(key)
+            return super().__getitem__(key)
+
+    items = [(np.zeros((1, 4, 4), np.float32), i % 5) for i in range(100)]
+    part = CountingList(items)
+    ds = PartitionedDataset.__new__(PartitionedDataset)
+    ds.partitions = [part]
+    feed = RoundFeed(ds, per_worker_batch=4, batches_per_round=2, seed=0)
+    feed.next_round()
+    # exactly tau slices of batch size, no whole-partition reads
+    assert len(part.slices) == 2
+    for s in part.slices:
+        assert s.stop - s.start == 4
 
 
 def test_eval_feed_covers_partitions(np_rng):
