@@ -108,6 +108,7 @@ def device_feed(batches: Iterator[Mapping[str, Any]], depth: int = 2,
     def put(batch: Mapping[str, Any]) -> dict[str, jax.Array]:
         if sharding is None:
             return {k: jax.device_put(v) for k, v in batch.items()}
-        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+        from ..parallel.mesh import stage_local
+        return {k: stage_local(v, sharding) for k, v in batch.items()}
 
     return PrefetchIterator(batches, depth=depth, transform=put)

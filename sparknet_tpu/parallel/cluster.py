@@ -17,6 +17,8 @@ doing process placement, but carries no tensor traffic.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 
@@ -32,6 +34,26 @@ def init_cluster(coordinator_address: str | None = None,
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def init_cluster_from_env() -> bool:
+    """Join the cluster described by SPARKNET_COORDINATOR /
+    SPARKNET_NUM_PROCS / SPARKNET_PROC_ID — the env contract the launcher
+    (``sparknet_tpu.tools.launch``) sets on every spawned process, playing
+    the role of spark-submit's executor placement (reference: SETUP.md,
+    ImageNetApp.scala:97).  Returns False (and does nothing) when the env
+    is absent, i.e. single-process runs."""
+    addr = os.environ.get("SPARKNET_COORDINATOR")
+    if not addr:
+        return False
+    init_cluster(addr,
+                 int(os.environ["SPARKNET_NUM_PROCS"]),
+                 int(os.environ["SPARKNET_PROC_ID"]))
+    return True
+
+
+def shutdown_cluster() -> None:
+    jax.distributed.shutdown()
 
 
 def is_multi_host() -> bool:

@@ -42,7 +42,10 @@ from ..proto.caffe_pb import NetState, Phase, SolverParameter
 from ..solvers.lr_policies import learning_rate
 from ..solvers.step import make_step_fns
 from ..solvers.update_rules import make_update_rule, preprocess_grads
-from .mesh import DATA_AXIS, batch_sharded, make_mesh, replicated
+from .mesh import (
+    DATA_AXIS, batch_sharded, make_mesh, put_global_tree, replicated,
+    stage_local,
+)
 
 try:  # jax >= 0.6 exposes shard_map at top level
     shard_map = jax.shard_map
@@ -80,7 +83,9 @@ class DistributedTrainer:
         rng = jax.random.PRNGKey(seed if seed >= 0 else 0)
         self._rng, init_rng = jax.random.split(rng)
         rep = replicated(self.mesh)
-        self.params: WeightCollection = jax.device_put(
+        # same-seed host-side init staged onto the (possibly multi-host)
+        # mesh — explicit per-host replication (SURVEY.md §7.3)
+        self.params: WeightCollection = put_global_tree(
             self.train_net.init(init_rng), rep)
         state0 = self.rule.init(self.params)
         if self.config.strategy == "local_sgd":
@@ -88,13 +93,13 @@ class DistributedTrainer:
             stacked = jax.tree_util.tree_map(
                 lambda x: jnp.broadcast_to(x[None], (self.n_workers,) + x.shape),
                 state0)
-            self.state = jax.device_put(
+            self.state = put_global_tree(
                 stacked, NamedSharding(self.mesh, P(DATA_AXIS)))
         else:
-            self.state = jax.device_put(state0, rep)
-        self._lr_mults = jax.device_put(
+            self.state = put_global_tree(state0, rep)
+        self._lr_mults = put_global_tree(
             self.train_net.lr_mult_tree(self.params), rep)
-        self._decay_mults = jax.device_put(
+        self._decay_mults = put_global_tree(
             self.train_net.decay_mult_tree(self.params), rep)
 
         self._round = self._build_round()
@@ -206,22 +211,28 @@ class DistributedTrainer:
     def train_round(self, batches: Mapping[str, Any]) -> float:
         """Run one round (τ steps, each accumulating iter_size
         micro-batches).  ``batches`` maps input blob names to arrays with a
-        leading τ·iter_size axis and a global batch axis:
-        [tau * iter_size, global_batch, ...]."""
+        leading τ·iter_size axis and a batch axis:
+        [tau * iter_size, batch, ...].  Single-host, the batch axis is the
+        global batch; multi-host, each process passes only ITS rows of the
+        global batch (its partitions — the zipPartitions placement,
+        reference: ImageNetApp.scala:145) and the global array is assembled
+        without any host seeing the whole batch."""
         expect = self.batches_per_round
+        procs = jax.process_count()
+        local_workers = max(self.n_workers // procs, 1)
         for k, v in batches.items():
             if v.shape[0] != expect:
                 raise ValueError(
                     f"{k}: leading dim {v.shape[0]} != tau*iter_size "
                     f"{expect}")
-            if v.shape[1] % self.n_workers:
+            if v.shape[1] % local_workers:
                 raise ValueError(
                     f"{k}: batch {v.shape[1]} not divisible by "
-                    f"{self.n_workers} workers")
+                    f"{local_workers} local workers")
         # pre-shard the feed so each device receives only its slice — no
         # single-device staging (the reference's driver bottleneck); a no-op
         # for feeds already staged via device_feed(input_sharding)
-        batches = {k: jax.device_put(jnp.asarray(v), self.input_sharding)
+        batches = {k: stage_local(v, self.input_sharding)
                    for k, v in batches.items()}
         self._rng, rng = jax.random.split(self._rng)
         self.params, self.state, loss = self._round(
@@ -250,16 +261,16 @@ class DistributedTrainer:
 
             self._test_fwd = jax.jit(fwd)
         sharding = batch_sharded(self.mesh)
+        local_workers = max(self.n_workers // jax.process_count(), 1)
         totals: dict[str, float] = {}
         for _ in range(num_steps):
             batch = {}
             for k, v in next(feed).items():
-                v = jnp.asarray(v)
-                if v.shape[0] % self.n_workers:
+                if v.shape[0] % local_workers:
                     raise ValueError(
                         f"{k}: eval batch {v.shape[0]} not divisible by "
-                        f"{self.n_workers} workers")
-                batch[k] = jax.device_put(v, sharding)
+                        f"{local_workers} local workers")
+                batch[k] = stage_local(v, sharding)
             scores = self._test_fwd(self.params, batch)
             for k, v in scores.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
@@ -292,12 +303,10 @@ class DistributedTrainer:
                 f"checkpoint has {saved_workers} workers, mesh has "
                 f"{self.n_workers}")
         rep = replicated(self.mesh)
-        self.params = jax.device_put(
-            jax.tree_util.tree_map(jnp.asarray, blob["params"]), rep)
-        state = jax.tree_util.tree_map(jnp.asarray, blob["state"])
+        self.params = put_global_tree(blob["params"], rep)
         if self.config.strategy == "local_sgd":
-            self.state = jax.device_put(
-                state, NamedSharding(self.mesh, P(DATA_AXIS)))
+            self.state = put_global_tree(
+                blob["state"], NamedSharding(self.mesh, P(DATA_AXIS)))
         else:
-            self.state = jax.device_put(state, rep)
+            self.state = put_global_tree(blob["state"], rep)
         self.iter = int(blob["iter"])

@@ -1,0 +1,174 @@
+"""Multi-process launcher — the spark-submit analog.
+
+The reference launches one driver + N executor JVMs via spark-submit
+(reference: SETUP.md:45, README.md:60; worker-handle RDD at
+ImageNetApp.scala:97).  Here the launcher only does *process placement* —
+it carries no tensor traffic (that rides ICI/DCN via the JAX distributed
+runtime).  Every spawned process gets the SPARKNET_COORDINATOR /
+SPARKNET_NUM_PROCS / SPARKNET_PROC_ID env contract consumed by
+``parallel.cluster.init_cluster_from_env``.
+
+Modes:
+  local  — spawn N processes on this machine (the CPU multi-process test
+           rig; the analog of Spark local mode).  ``--devices-per-proc``
+           carves virtual CPU devices per process.
+  ssh    — run the command on each host of ``--hosts`` via ssh, process i
+           on host i (plain SSH pod bring-up for TPU-VM workers, where
+           each host sees its local chips natively).
+
+Usage:
+  python -m sparknet_tpu.tools.launch --nprocs 2 --devices-per-proc 2 \
+      --platform cpu -- python -m sparknet_tpu.apps.cifar_app --synthetic ...
+  python -m sparknet_tpu.tools.launch --hosts tpu-w0,tpu-w1 -- \
+      python -m sparknet_tpu.apps.imagenet_app ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _proc_env(base: dict, coordinator: str, nprocs: int, pid: int,
+              platform: str | None, devices_per_proc: int | None) -> dict:
+    env = dict(base)
+    env["SPARKNET_COORDINATOR"] = coordinator
+    env["SPARKNET_NUM_PROCS"] = str(nprocs)
+    env["SPARKNET_PROC_ID"] = str(pid)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+        env["JAX_PLATFORM_NAME"] = platform
+    if devices_per_proc:
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{devices_per_proc}").strip()
+    return env
+
+
+def _stream(prefix: str, pipe) -> None:
+    for line in iter(pipe.readline, b""):
+        sys.stderr.write(f"[{prefix}] {line.decode(errors='replace')}")
+        sys.stderr.flush()
+
+
+def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
+                 devices_per_proc: int | None = None,
+                 coordinator: str | None = None,
+                 timeout: float | None = None) -> int:
+    """Spawn ``nprocs`` copies of ``cmd`` locally; returns the first
+    non-zero exit code, else 0.  Output is streamed with [p<i>] prefixes."""
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    procs = []
+    threads = []
+    for pid in range(nprocs):
+        env = _proc_env(os.environ, coordinator, nprocs, pid, platform,
+                        devices_per_proc)
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        t = threading.Thread(target=_stream, args=(f"p{pid}", p.stdout),
+                             daemon=True)
+        t.start()
+        procs.append(p)
+        threads.append(t)
+    rc = 0
+    try:
+        for p in procs:
+            p.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        rc = 124
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for t in threads:
+        t.join(timeout=5)
+    for p in procs:
+        if p.returncode not in (0, None) and rc == 0:
+            rc = p.returncode
+    return rc
+
+
+def launch_ssh(cmd: list[str], hosts: list[str], *,
+               coordinator_port: int | None = None,
+               cwd: str | None = None,
+               timeout: float | None = None) -> int:
+    """Run ``cmd`` on every host via ssh; host 0 doubles as coordinator."""
+    port = coordinator_port or 9876
+    coordinator = f"{hosts[0]}:{port}"
+    cwd = cwd or os.getcwd()
+    procs = []
+    threads = []
+    for pid, host in enumerate(hosts):
+        envs = " ".join(
+            f"{k}={v!r}" for k, v in (
+                ("SPARKNET_COORDINATOR", coordinator),
+                ("SPARKNET_NUM_PROCS", str(len(hosts))),
+                ("SPARKNET_PROC_ID", str(pid)),
+            ))
+        remote = f"cd {cwd} && env {envs} " + " ".join(cmd)
+        p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host, remote],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        t = threading.Thread(target=_stream, args=(host, p.stdout),
+                             daemon=True)
+        t.start()
+        procs.append(p)
+        threads.append(t)
+    rc = 0
+    try:
+        for p in procs:
+            p.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        rc = 124
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for t in threads:
+        t.join(timeout=5)
+    for p in procs:
+        if p.returncode not in (0, None) and rc == 0:
+            rc = p.returncode
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="spark-submit analog: place N framework processes")
+    ap.add_argument("--nprocs", type=int, default=None,
+                    help="local mode: number of processes")
+    ap.add_argument("--hosts", default=None,
+                    help="ssh mode: comma-separated host list")
+    ap.add_argument("--platform", default=None,
+                    help="force JAX platform in children (e.g. cpu)")
+    ap.add_argument("--devices-per-proc", type=int, default=None,
+                    help="virtual CPU devices per process (test rigs)")
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to run (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        ap.error("no command given")
+    if args.hosts:
+        return launch_ssh(cmd, args.hosts.split(","), timeout=args.timeout)
+    if not args.nprocs:
+        ap.error("--nprocs or --hosts required")
+    return launch_local(cmd, args.nprocs, platform=args.platform,
+                        devices_per_proc=args.devices_per_proc,
+                        timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

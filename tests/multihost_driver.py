@@ -1,0 +1,92 @@
+"""Driver run by the two-process jax.distributed test (and reusable by
+hand): trains a fixed lenet workload over the global mesh and dumps final
+params.  Each process feeds only ITS rows of the deterministic global batch
+(the per-host partition placement of ImageNetApp.scala:145).
+
+Invoked by sparknet_tpu.tools.launch (env contract) or standalone
+single-process with --local-devices N.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--strategy", default="sync")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--local-devices", type=int, default=None,
+                    help="single-process mode: virtual CPU device count")
+    args = ap.parse_args()
+
+    if args.local_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.local_devices}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.parallel import DistributedTrainer, TrainerConfig, make_mesh
+    from sparknet_tpu.parallel.cluster import (
+        init_cluster_from_env, local_batch_slice,
+    )
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+
+    distributed = init_cluster_from_env()
+    mesh = make_mesh()
+    n_devices = mesh.shape["data"]
+    assert n_devices == 4, f"expected 4 global devices, got {n_devices}"
+
+    GLOBAL_BATCH, TAU, ROUNDS = 16, 2, 2
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n',
+        lenet(GLOBAL_BATCH, GLOBAL_BATCH))
+    tr = DistributedTrainer(sp, mesh,
+                            TrainerConfig(strategy=args.strategy, tau=TAU),
+                            seed=0)
+    rows = local_batch_slice(GLOBAL_BATCH)
+
+    rng = np.random.default_rng(0)  # identical stream on every process
+    losses = []
+    for _ in range(ROUNDS):
+        y = rng.integers(0, 10, size=(TAU, GLOBAL_BATCH))
+        x = rng.normal(scale=0.3, size=(TAU, GLOBAL_BATCH, 1, 28, 28)
+                       ).astype(np.float32)
+        for t in range(TAU):
+            for i, k in enumerate(y[t]):
+                x[t, i, :, int(k) % 28, :] += 2.0
+        losses.append(tr.train_round(
+            {"data": x[:, rows], "label": y[:, rows].astype(np.float32)}))
+
+    eval_y = rng.integers(0, 10, size=(GLOBAL_BATCH,))
+    eval_x = rng.normal(scale=0.3, size=(GLOBAL_BATCH, 1, 28, 28)
+                        ).astype(np.float32)
+    feed = iter([{"data": eval_x[rows],
+                  "label": eval_y[rows].astype(np.float32)}] * 2)
+    scores = tr.test(feed, num_steps=2)
+
+    if jax.process_index() == 0:
+        flat = {}
+        for lname, blobs in tr.params.items():
+            for i, b in enumerate(blobs):
+                flat[f"{lname}/{i}"] = np.asarray(b)
+        flat["__losses__"] = np.asarray(losses)
+        flat["__scores__"] = np.asarray(
+            [scores.get("loss", 0.0), scores.get("accuracy", 0.0)])
+        np.savez(args.out, **flat)
+        print(f"driver ok: distributed={distributed} "
+              f"procs={jax.process_count()} losses={losses}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Two-process jax.distributed exercise on the CPU rig — real multi-host
+coverage the reference never had (its only multi-worker exercise was the
+live Spark apps; SURVEY.md §4.1).  Two coordinated processes × 2 virtual
+CPU devices each form a 4-device global mesh; each process feeds only its
+rows of the batch; the result must equal a single-process 4-device run of
+the identical workload."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+DRIVER = os.path.join(os.path.dirname(__file__), "multihost_driver.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # the conftest's 8-device flags must not leak into subprocesses
+    env.pop("XLA_FLAGS", None)
+    for k in list(env):
+        if k.startswith("SPARKNET_"):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    return env
+
+
+def _run_single(out, strategy):
+    subprocess.run(
+        [sys.executable, DRIVER, "--strategy", strategy, "--out", out,
+         "--local-devices", "4"],
+        check=True, env=_clean_env(), cwd=REPO, timeout=420,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+@pytest.mark.parametrize("strategy", ["sync", "local_sgd"])
+def test_two_process_matches_single_process(tmp_path, strategy):
+    from sparknet_tpu.tools.launch import launch_local
+
+    single = str(tmp_path / f"single_{strategy}.npz")
+    multi = str(tmp_path / f"multi_{strategy}.npz")
+    _run_single(single, strategy)
+
+    # two coordinated processes via the launcher (spark-submit analog)
+    old_env = dict(os.environ)
+    os.environ.pop("XLA_FLAGS", None)
+    try:
+        rc = launch_local(
+            [sys.executable, DRIVER, "--strategy", strategy, "--out", multi],
+            nprocs=2, platform="cpu", devices_per_proc=2, timeout=420)
+    finally:
+        os.environ.clear()
+        os.environ.update(old_env)
+    assert rc == 0, f"distributed run failed rc={rc}"
+    assert os.path.exists(multi), "process 0 wrote no output"
+
+    a = np.load(single)
+    b = np.load(multi)
+    assert set(a.files) == set(b.files)
+    np.testing.assert_allclose(a["__losses__"], b["__losses__"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(a["__scores__"], b["__scores__"],
+                               rtol=1e-5, atol=1e-5)
+    for k in a.files:
+        if k.startswith("__"):
+            continue
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=f"param {k} diverged")
