@@ -487,11 +487,17 @@ def replace_data_layers(
         "DummyData", "JavaData", "Input",
     }
     kept = [l for l in net.layer if l.type not in data_types]
-    tops = ["data", "label"]
+    # Collect tops across ALL stripped data layers — the reference's
+    # JavaData nets use two single-top layers (data + label), e.g.
+    # examples/cifar10/cifar10_full_java_train_test.prototxt.
+    tops: list[str] = []
     for l in net.layer:
-        if l.type in data_types and l.top:
-            tops = l.top
-            break
+        if l.type in data_types:
+            for t in l.top:
+                if t not in tops:
+                    tops.append(t)
+    if not tops:
+        tops = ["data", "label"]
 
     def make(phase: Phase, batch: int) -> LayerParameter:
         lp = LayerParameter(
