@@ -77,4 +77,93 @@ try:
 except WireError as e:
     print("truncated decode rejected:", e)
 
+# per-blob param sharing: train a weight-shared stack, round-trip caffemodel
+SHARED = """
+name: "shared"
+layer { name: "d" type: "JavaData" top: "a" top: "label"
+        java_data_param { shape { dim: 8 dim: 6 } shape { dim: 8 } } }
+layer { name: "ip_a" type: "InnerProduct" bottom: "a" top: "fa"
+        param { name: "w" lr_mult: 1 }
+        inner_product_param { num_output: 6
+                              weight_filler { type: "xavier" }
+                              bias_filler { type: "constant" value: 1 } } }
+layer { name: "ip_b" type: "InnerProduct" bottom: "fa" top: "fb"
+        param { name: "w" }
+        inner_product_param { num_output: 6
+                              weight_filler { type: "xavier" }
+                              bias_filler { type: "constant" value: 2 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fb" bottom: "a" top: "loss" }
+"""
+sp = load_solver_prototxt_with_net('base_lr: 0.01\n', load_net_prototxt(SHARED))
+ss = Solver(sp, seed=0)
+assert len(ss.params["ip_a"]) == 2 and len(ss.params["ip_b"]) == 1
+
+
+def shared_feed():
+    while True:
+        yield {"a": rng.normal(size=(8, 6)).astype(np.float32),
+               "label": np.zeros(8, np.float32)}
+
+
+ss.set_train_data(shared_feed())
+sl0 = ss.step(1)
+sl1 = ss.step(30)
+print(f"shared-net loss {sl0:.3f} -> {sl1:.3f}")
+assert sl1 < sl0
+smodel, sstate = ss.snapshot_caffe("/tmp/drive_shared")
+from sparknet_tpu.proto.caffemodel import load_net_binaryproto
+saved = {lp.name: lp.blobs for lp in load_net_binaryproto(smodel).layer
+         if lp.blobs}
+assert len(saved["ip_a"]) == 2 and len(saved["ip_b"]) == 2  # full lists
+np.testing.assert_allclose(saved["ip_a"][0], saved["ip_b"][0])
+fresh = Solver(sp, seed=3)
+fresh.load_weights(smodel)
+fresh.restore_caffe(sstate)
+for k in ss.params:
+    for a, b in zip(ss.params[k], fresh.params[k]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+print("shared caffemodel round-trip ok")
+
+# sharing error paths: shape mismatch + lr_mult conflict + Filter taint
+from sparknet_tpu.graph import Net
+try:
+    Net(load_net_prototxt(SHARED.replace(
+        'name: "ip_b" type: "InnerProduct" bottom: "fa" top: "fb"\n'
+        '        param { name: "w" }',
+        'name: "ip_b" type: "InnerProduct" bottom: "fa" top: "fb"\n'
+        '        param { name: "w" lr_mult: 5 }')))
+    raise AssertionError("expected lr_mult mismatch")
+except ValueError as e:
+    assert "lr_mult mismatch" in str(e), e
+try:
+    Net(load_net_prototxt("""
+    layer { name: "d" type: "Input" top: "x" top: "s"
+            input_param { shape { dim: 4 dim: 3 } shape { dim: 4 } } }
+    layer { name: "f" type: "Filter" bottom: "x" bottom: "s" top: "fx" }
+    layer { name: "ip" type: "InnerProduct" bottom: "fx" top: "y"
+            inner_product_param { num_output: 2 axis: 0
+                                  weight_filler { type: "xavier" } } }
+    """))
+    raise AssertionError("expected taint rejection")
+except ValueError as e:
+    assert "data-dependent" in str(e), e
+print("sharing error paths ok")
+
+# full-size-mean random crop: Caffe subtracts the mean at the crop window
+from sparknet_tpu.data.transforms import random_crop_mirror
+imgs = rng.normal(size=(4, 3, 12, 10)).astype(np.float32)
+mean_img = rng.normal(size=(3, 12, 10)).astype(np.float32)
+out = random_crop_mirror(imgs, 8, np.random.default_rng(0), mean=mean_img)
+r2 = np.random.default_rng(0)
+ys = r2.integers(0, 5, size=4)
+xs = r2.integers(0, 3, size=4)
+flips = r2.integers(0, 2, size=4)
+sub = imgs - mean_img
+for i in range(4):
+    w = sub[i, :, ys[i]:ys[i] + 8, xs[i]:xs[i] + 8]
+    if flips[i]:
+        w = w[:, :, ::-1]
+    np.testing.assert_allclose(out[i], w, rtol=1e-5)
+print("mean-window crop ok")
+
 print("DRIVE OK")

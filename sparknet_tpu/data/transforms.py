@@ -43,8 +43,12 @@ def random_crop_mirror(batch: np.ndarray, crop: int,
     xs = rng.integers(0, w - crop + 1, size=n).astype(np.int32)
     flips = (rng.integers(0, 2, size=n) if mirror
              else np.zeros(n)).astype(np.int32)
-    if isinstance(mean, np.ndarray) and mean.shape[-1] != crop:
-        mean = center_crop_mean(mean, crop)
+    if isinstance(mean, np.ndarray) and mean.shape[-2:] != (crop, crop):
+        # Full-size mean: Caffe's DataTransformer indexes the mean at each
+        # sample's crop window (data_transformer.cpp Transform, data_index
+        # uses h_off/w_off), i.e. crop(img - mean) — subtract before crop.
+        batch = batch.astype(np.float32) - np.asarray(mean, np.float32)
+        mean = None
     return native.crop_batch(batch.astype(np.float32, copy=False), crop,
                              ys, xs, flips, mean)
 
@@ -57,7 +61,7 @@ def center_crop(batch: np.ndarray, crop: int,
     x = (w - crop) // 2
     out = batch[:, :, y:y + crop, x:x + crop].astype(np.float32)
     if mean is not None:
-        if isinstance(mean, np.ndarray) and mean.shape[-1] != crop:
+        if isinstance(mean, np.ndarray) and mean.shape[-2:] != (crop, crop):
             mean = center_crop_mean(mean, crop)
         out = out - mean
     return out

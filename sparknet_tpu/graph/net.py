@@ -57,9 +57,24 @@ class _LayerNode:
     impl: LayerImpl
     bottoms: list[str]
     tops: list[str]
-    param_key: str            # owner layer name holding this layer's blobs
+    param_key: str            # this layer's own storage key (== lp.name)
     lr_mults: list[float]
     decay_mults: list[float]
+    # per-blob sharing (reference: net.cpp AppendParam — each ParamSpec with
+    # a name shares that one blob with the first layer that declared it):
+    # blob index -> (owner layer name, owner *stored* position)
+    shared_refs: dict[int, tuple[str, int]] = dataclasses.field(
+        default_factory=dict)
+    # blob index -> position in params[lp.name] for non-shared blobs
+    own_map: dict[int, int] = dataclasses.field(default_factory=dict)
+    n_blobs: int | None = None     # total blobs (known when probed)
+
+    def owner_keys(self) -> set[str]:
+        """Storage keys holding any of this node's blobs."""
+        keys = {o for o, _ in self.shared_refs.values()}
+        if self.own_map or not self.shared_refs:
+            keys.add(self.param_key)
+        return keys
 
 
 class Net:
@@ -85,6 +100,13 @@ class Net:
 
         shared_owner: dict[str, tuple[str, int]] = {}  # ParamSpec.name -> (layer, idx)
         consumed: set[str] = set()
+        self._probe_cache: dict[str, list] = {}
+        self._node_by_name: dict[str, _LayerNode] = {}
+        # blobs whose batch dim is data-dependent (downstream of Filter):
+        # their declared shapes are placeholders — building params from them
+        # would silently mis-size blobs (reference: filter_layer.cpp Reshape
+        # runs per batch; our shapes are static)
+        tainted: set[str] = set()
 
         for lp in self.param.layer:
             impl = get_layer_impl(lp.type)
@@ -97,54 +119,203 @@ class Net:
                         f"(known: {sorted(self.blob_shapes)})")
                 consumed.add(b)
             bshapes = [self.blob_shapes[b] for b in bottoms]
+            if any(b in tainted for b in bottoms):
+                self._check_batch_insensitive(lp, impl, bottoms, bshapes,
+                                              tainted)
             oshapes = impl.out_shapes(lp, bshapes)
+            taints = (getattr(impl, "dynamic_batch", False)
+                      or any(b in tainted for b in bottoms))
             if not tops:
                 tops = [lp.name] if oshapes else []
             while len(tops) < len(oshapes):
                 tops.append(f"{lp.name}_top{len(tops)}")
             for t, s in zip(tops, oshapes):
                 self.blob_shapes[t] = tuple(int(d) for d in s)
+            if taints:
+                tainted.update(tops)
             if getattr(impl, "is_input", lambda: False)():
                 for t, s in zip(tops, oshapes):
                     self.input_blobs[t] = tuple(int(d) for d in s)
 
-            # param sharing resolution
-            param_key = lp.name
+            # param sharing resolution — per ParamSpec entry, as in
+            # net.cpp AppendParam (each named spec shares exactly one blob
+            # with the first declarer of that name)
             specs = lp.param
             lr_mults = [ps.lr_mult for ps in specs]
             decay_mults = [ps.decay_mult for ps in specs]
-            if specs and specs[0].name:
-                owner = shared_owner.get(specs[0].name)
+            raw_refs: dict[int, tuple[str, int]] = {}
+            for i, ps in enumerate(specs):
+                if not ps.name:
+                    continue
+                owner = shared_owner.get(ps.name)
                 if owner is None:
-                    shared_owner[specs[0].name] = (lp.name, 0)
+                    shared_owner[ps.name] = (lp.name, i)
                 else:
-                    param_key = owner[0]
+                    raw_refs[i] = owner
             if lp.type == "BatchNorm":
                 lr_mults = [0.0, 0.0, 0.0]
                 decay_mults = [0.0, 0.0, 0.0]
-            self.nodes.append(_LayerNode(
+            node = _LayerNode(
                 lp=lp, impl=impl, bottoms=bottoms, tops=tops,
-                param_key=param_key, lr_mults=lr_mults, decay_mults=decay_mults,
-            ))
+                param_key=lp.name, lr_mults=lr_mults, decay_mults=decay_mults,
+            )
+            if raw_refs:
+                self._resolve_sharing(node, raw_refs)
+            self.nodes.append(node)
+            self._node_by_name[lp.name] = node
 
         produced = [t for n in self.nodes for t in n.tops]
         self.output_blobs = [t for t in dict.fromkeys(produced)
                              if t not in consumed and t not in self.input_blobs]
 
+    @staticmethod
+    def _check_batch_insensitive(lp, impl, bottoms, bshapes, tainted) -> None:
+        """A consumer of Filter output sees a placeholder batch dim (the
+        real one is data-dependent, filter_layer.cpp Reshape).  Reject only
+        layers whose *parameter* shapes would change with that dim —
+        standard layers (InnerProduct axis=1, Convolution, ...) size params
+        off non-batch dims and stay valid eager."""
+        def probe(shapes):
+            return jax.eval_shape(lambda r: impl.init(r, lp, shapes),
+                                  jax.ShapeDtypeStruct((2,), jnp.uint32))
+        bumped = [tuple([s[0] + 1] + list(s[1:])) if b in tainted and s
+                  else s for b, s in zip(bottoms, bshapes)]
+        try:
+            a, c = probe(bshapes), probe(bumped)
+            sensitive = [x.shape for x in a] != [x.shape for x in c]
+        except Exception:
+            sensitive = bool(probe(bshapes))  # bump broke init: be strict
+        if sensitive:
+            raise ValueError(
+                f"layer {lp.name!r} ({lp.type}) builds parameters from "
+                f"blobs with a data-dependent batch dim (downstream of a "
+                f"Filter layer) — its declared shapes are unreliable")
+
+    def _probe_blob_shapes(self, node: _LayerNode) -> list[tuple[Shape, Any]]:
+        """(shape, dtype) of each learnable blob without allocating them.
+        Cached per layer — sharing-heavy graphs probe owners repeatedly."""
+        cached = self._probe_cache.get(node.lp.name)
+        if cached is not None:
+            return cached
+        bshapes = [self.blob_shapes[b] for b in node.bottoms]
+        structs = jax.eval_shape(
+            lambda r: node.impl.init(r, node.lp, bshapes),
+            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        out = [(tuple(s.shape), s.dtype) for s in structs]
+        self._probe_cache[node.lp.name] = out
+        return out
+
+    @staticmethod
+    def _merge_shared_mult(node: _LayerNode, owner: _LayerNode,
+                           i: int, oidx: int, attr: str, label: str) -> None:
+        """net.cpp AppendParam lr_mult/decay_mult semantics for a shared
+        blob: the sharer's explicit value propagates to the owner when the
+        owner left it unset; both explicit and different is an error."""
+        raw = f"raw_{label}"
+        specs, ospecs = node.lp.param, owner.lp.param
+        mine = getattr(specs[i], raw, None) if i < len(specs) else None
+        if mine is None:
+            return
+        owners = getattr(ospecs[oidx], raw, None) if oidx < len(ospecs) else None
+        if owners is None:
+            mults = getattr(owner, attr)
+            while len(mults) <= oidx:
+                mults.append(1.0)
+            mults[oidx] = mine
+        elif owners != mine:
+            raise ValueError(
+                f"shared param {label} mismatch: layer {node.lp.name!r} "
+                f"blob {i} sets {mine}, owner {owner.lp.name!r} blob {oidx} "
+                f"sets {owners} (reference: net.cpp AppendParam CHECK)")
+
+    def _resolve_sharing(self, node: _LayerNode,
+                         raw_refs: dict[int, tuple[str, int]]) -> None:
+        """Map each shared blob index to (owner key, owner stored position),
+        validating shapes against the owner (net.cpp AppendParam CHECKs)."""
+        mine = self._probe_blob_shapes(node)
+        node.n_blobs = len(mine)
+        for i, (oname, oidx) in raw_refs.items():
+            if i >= len(mine):
+                continue  # named spec beyond the layer's blob count
+            owner = self._node_by_name.get(oname)
+            if owner is None:
+                raise ValueError(
+                    f"layer {node.lp.name!r} shares param {i} with unknown "
+                    f"layer {oname!r}")
+            oshapes = self._probe_blob_shapes(owner)
+            if oidx >= len(oshapes):
+                raise ValueError(
+                    f"layer {node.lp.name!r} param {i} shares blob {oidx} of "
+                    f"{oname!r}, which has only {len(oshapes)} blobs")
+            if oshapes[oidx][0] != mine[i][0]:
+                raise ValueError(
+                    f"shared param shape mismatch: {node.lp.name!r} blob {i} "
+                    f"{mine[i][0]} vs owner {oname!r} blob {oidx} "
+                    f"{oshapes[oidx][0]} (reference: net.cpp AppendParam)")
+            self._merge_shared_mult(node, owner, i, oidx, "lr_mults", "lr_mult")
+            self._merge_shared_mult(node, owner, i, oidx,
+                                    "decay_mults", "decay_mult")
+            # owner stored position: identity unless the owner itself shares
+            opos = owner.own_map.get(oidx, oidx) if owner.shared_refs else oidx
+            node.shared_refs[i] = (oname, opos)
+        node.own_map = {
+            i: pos for pos, i in enumerate(
+                j for j in range(len(mine)) if j not in node.shared_refs)
+        }
+
     # -- construction -----------------------------------------------------
     def init(self, rng: jax.Array) -> WeightCollection:
         """Create all learnable blobs with Caffe-filler init (the SetUp pass
-        of reference net.cpp:73-133)."""
+        of reference net.cpp:73-133).  Shared blobs are created only by
+        their owner layer."""
         params: WeightCollection = {}
         for node in self.nodes:
-            if node.param_key != node.lp.name:
-                continue  # shared; owner creates
             rng, sub = jax.random.split(rng)
             bshapes = [self.blob_shapes[b] for b in node.bottoms]
             blobs = node.impl.init(sub, node.lp, bshapes)
-            if blobs:
+            if not blobs:
+                continue
+            if node.shared_refs:
+                own = [b for i, b in enumerate(blobs)
+                       if i not in node.shared_refs]
+                if own:
+                    params[node.lp.name] = own
+            else:
                 params[node.lp.name] = list(blobs)
         return params
+
+    def node_params(self, params: WeightCollection,
+                    node: _LayerNode) -> list[jax.Array]:
+        """Assemble the blob list a node sees, following shared refs."""
+        if not node.shared_refs:
+            return params.get(node.param_key, [])
+        out = []
+        for i in range(node.n_blobs or 0):
+            ref = node.shared_refs.get(i)
+            if ref is None:
+                out.append(params[node.param_key][node.own_map[i]])
+            else:
+                out.append(params[ref[0]][ref[1]])
+        return out
+
+    def _scatter_node_params(self, params: dict, node: _LayerNode,
+                             updated: Sequence[jax.Array]) -> None:
+        """Write a node's (possibly shared) updated blobs back to owners."""
+        if not node.shared_refs:
+            params[node.param_key] = list(updated)
+            return
+        own = list(params.get(node.param_key, []))
+        for i, b in enumerate(updated):
+            ref = node.shared_refs.get(i)
+            if ref is None:
+                own[node.own_map[i]] = b
+            else:
+                oname, opos = ref
+                oblobs = list(params[oname])
+                oblobs[opos] = b
+                params[oname] = oblobs
+        if own:
+            params[node.param_key] = own
 
     def lr_mult_tree(self, params: WeightCollection) -> WeightCollection:
         """Per-blob lr multipliers, same pytree structure as params
@@ -158,10 +329,18 @@ class Net:
         out: WeightCollection = {}
         by_name = {n.lp.name: n for n in self.nodes}
         for key, blobs in params.items():
-            mults = getattr(by_name[key], attr, []) if key in by_name else []
+            node = by_name.get(key)
+            mults = getattr(node, attr, []) if node is not None else []
+            if node is not None and node.shared_refs:
+                # stored position -> original blob index (storage compacts
+                # away shared blobs)
+                orig = {pos: i for i, pos in node.own_map.items()}
+                idxs = [orig.get(p, p) for p in range(len(blobs))]
+            else:
+                idxs = list(range(len(blobs)))
             out[key] = [
                 jnp.asarray(mults[i] if i < len(mults) else default)
-                for i in range(len(blobs))
+                for i in idxs
             ]
         return out
 
@@ -204,12 +383,12 @@ class Net:
             layer_rng = None
             if rng is not None and node.impl.needs_rng(node.lp, train):
                 rng, layer_rng = jax.random.split(rng)
-            p = new_params.get(node.param_key, [])
+            p = self.node_params(new_params, node)
             bots = [blobs[b] for b in node.bottoms]
             result = node.impl.apply(node.lp, p, bots, train, layer_rng)
             if getattr(node.impl, "has_state", False):
                 tops, updated = result
-                new_params[node.param_key] = list(updated)
+                self._scatter_node_params(new_params, node, updated)
             else:
                 tops = result
             for t, v in zip(node.tops, tops):

@@ -42,7 +42,11 @@ def layer(name: str, type: str, bottoms: Sequence[str] = (),
         name=name, type=type, bottom=list(bottoms), top=list(tops), phase=phase)
     if param:
         from ..proto.caffe_pb import ParamSpec
-        lp.param = [ParamSpec(**p) for p in param]
+        lp.param = [
+            ParamSpec(**p,
+                      raw_lr_mult=p.get("lr_mult"),
+                      raw_decay_mult=p.get("decay_mult"))
+            for p in param]
     for key, sub in type_params.items():
         lp.params[key] = sub if isinstance(sub, PMessage) else msg(**sub)
     return lp

@@ -471,13 +471,18 @@ class FilterLayer(LayerImpl):
     """Select batch items where the last bottom (selector) is nonzero
     (filter_layer.cpp).  The output batch size is data-dependent, which XLA
     cannot compile; this layer therefore only works outside `jit` (eager),
-    matching its rarity — no zoo model uses it."""
+    matching its rarity — no zoo model uses it.  ``dynamic_batch`` marks the
+    tops so the graph compiler rejects shape-sensitive consumers (their
+    declared batch dim would be wrong)."""
+
+    dynamic_batch = True
 
     def min_bottoms(self) -> int:
         return 2
 
     def out_shapes(self, lp, bottom_shapes):
-        # batch dim unknown until runtime; report input shape
+        # batch dim unknown until runtime; report input shape — consumers
+        # that build params from these shapes are rejected in Net.__init__
         return [tuple(s) for s in bottom_shapes[:-1]]
 
     def apply(self, lp, params, bottoms, train, rng):

@@ -10,6 +10,8 @@ parity (SURVEY.md §7.3).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -58,7 +60,11 @@ class SoftmaxWithLossLayer(LossLayer):
         else:
             count = float(nll.size)
         total = jnp.sum(nll)
-        return [total / count if normalize else total / n]
+        # normalize=false divides by outer_num_ = prod(shape[:softmax_axis])
+        # (softmax_loss_layer.cpp Forward), not the batch dim — they differ
+        # when softmax_param.axis != 1
+        outer = math.prod(scores.shape[:axis])
+        return [total / count if normalize else total / outer]
 
 
 @register_layer("MultinomialLogisticLoss")

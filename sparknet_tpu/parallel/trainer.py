@@ -124,8 +124,8 @@ class DistributedTrainer:
         # re-averaging — pmean'ing the full weight set every step would be
         # a needless full-model collective (VERDICT r1 weak #7)
         state_keys = frozenset(
-            n.param_key for n in net.nodes
-            if getattr(n.impl, "has_state", False))
+            key for n in net.nodes if getattr(n.impl, "has_state", False)
+            for key in n.owner_keys())
 
         def split_micro(batches):
             """[tau*iter_size, local_batch, ...] -> [tau, iter_size, ...]

@@ -170,18 +170,26 @@ class NetState:
 
 @dataclasses.dataclass
 class ParamSpec:
-    """Per-learnable-blob training config (lr_mult/decay_mult)."""
+    """Per-learnable-blob training config (lr_mult/decay_mult).  The raw_*
+    fields preserve proto2 presence (has_lr_mult) — param sharing needs to
+    distinguish "explicitly 1.0" from "unset" (net.cpp AppendParam)."""
 
     name: str | None = None
     lr_mult: float = 1.0
     decay_mult: float = 1.0
+    raw_lr_mult: float | None = None
+    raw_decay_mult: float | None = None
 
     @classmethod
     def from_pmsg(cls, m: PMessage) -> "ParamSpec":
+        raw_lr = m.get("lr_mult")
+        raw_decay = m.get("decay_mult")
         return cls(
             name=m.get("name"),
-            lr_mult=float(m.get("lr_mult", 1.0)),
-            decay_mult=float(m.get("decay_mult", 1.0)),
+            lr_mult=float(raw_lr) if raw_lr is not None else 1.0,
+            decay_mult=float(raw_decay) if raw_decay is not None else 1.0,
+            raw_lr_mult=float(raw_lr) if raw_lr is not None else None,
+            raw_decay_mult=float(raw_decay) if raw_decay is not None else None,
         )
 
 

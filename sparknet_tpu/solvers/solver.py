@@ -193,24 +193,41 @@ class Solver:
     def copy_trained_layers_from(self, saved: Mapping[str, list]) -> None:
         """Copy blobs by layer name (Net::CopyTrainedLayersFrom semantics;
         reference: net.cpp:805-842 — matching names copied with shape
-        CHECKs, everything else left initialized)."""
-        staged: dict[str, list] = {}
+        CHECKs, everything else left initialized).  Caffe serializes every
+        layer with its FULL blob list (sharer layers carry shared blobs in
+        Net::ToProto), so copies route through the sharing map — writing a
+        shared blob via a sharer updates the owner's copy, last write wins,
+        exactly as Caffe copies through the shared pointer."""
+        by_name = {n.lp.name: n for n in self.train_net.nodes}
+        # staged[(storage key, position)] = new array
+        staged: dict[tuple[str, int], jnp.ndarray] = {}
         for name, blobs in saved.items():
-            if name not in self.params:
+            node = by_name.get(name)
+            if node is None:
                 continue
-            target = self.params[name]
+            target = self.train_net.node_params(self.params, node)
+            if not target and not blobs:
+                continue
             if len(blobs) != len(target):
                 raise ValueError(
                     f"layer {name!r}: checkpoint has {len(blobs)} blobs, "
                     f"net expects {len(target)}")
-            staged[name] = [
-                jnp.asarray(self._shape_adapt(src, dst.shape,
-                                              f"layer {name!r} blob {i}"),
-                            dst.dtype)
-                for i, (src, dst) in enumerate(zip(blobs, target))]
+            for i, (src, dst) in enumerate(zip(blobs, target)):
+                arr = jnp.asarray(
+                    self._shape_adapt(src, dst.shape,
+                                      f"layer {name!r} blob {i}"), dst.dtype)
+                ref = node.shared_refs.get(i) if node.shared_refs else None
+                if ref is None:
+                    pos = node.own_map[i] if node.shared_refs else i
+                    staged[(name, pos)] = arr
+                else:
+                    staged[ref] = arr
         # commit only after every layer validated — a partial copy must not
         # leave the solver with half-replaced weights
-        self.params.update(staged)
+        for (key, pos), arr in staged.items():
+            blobs = list(self.params[key])
+            blobs[pos] = arr
+            self.params[key] = blobs
 
     # -- Caffe-format snapshots (Solver::Snapshot/Restore with
     #    snapshot_format=BINARYPROTO; reference: solver.cpp:447-530,
@@ -243,7 +260,16 @@ class Solver:
         model_path = base + ".caffemodel"
         state_path = base + ".solverstate"
         net_param = self.sp.net_param or self.sp.train_net_param
-        save_caffemodel(model_path, self.params, net_param)
+        # Net::ToProto writes every layer with its FULL blob list (sharer
+        # layers repeat shared blobs), so Caffe's CopyTrainedLayersFrom
+        # CHECK_EQ(blobs_size) accepts the file — assemble through the
+        # sharing map rather than dumping compacted storage
+        full = {}
+        for node in self.train_net.nodes:
+            blobs = self.train_net.node_params(self.params, node)
+            if blobs:
+                full[node.lp.name] = blobs
+        save_caffemodel(model_path, full, net_param)
         save_solverstate(state_path, self.iter, self._history_flat(),
                          learned_net=model_path)
         return model_path, state_path

@@ -94,7 +94,7 @@ def main(argv=None) -> None:
         for node in net.nodes:
             if getattr(node.impl, "is_input", lambda: False)():
                 continue
-            p = params.get(node.param_key, [])
+            p = net.node_params(params, node)
             bots = [blobs[b] for b in node.bottoms]
             lrng = jax.random.PRNGKey(2)
 

@@ -106,6 +106,53 @@ def test_param_sharing_siamese(rng):
                                np.asarray(out.blobs["fb"]), rtol=1e-6)
 
 
+def test_param_sharing_per_blob(rng):
+    """Only the weight is shared; biases stay independent (per-ParamSpec
+    sharing granularity of net.cpp AppendParam)."""
+    txt = """
+    name: "partial"
+    layer { name: "d" type: "Input" top: "a" top: "b"
+            input_param { shape { dim: 2 dim: 4 } } }
+    layer { name: "ip_a" type: "InnerProduct" bottom: "a" top: "fa"
+            param { name: "w" }
+            inner_product_param { num_output: 3
+                                  weight_filler { type: "xavier" }
+                                  bias_filler { type: "constant" value: 1 } } }
+    layer { name: "ip_b" type: "InnerProduct" bottom: "b" top: "fb"
+            param { name: "w" }
+            inner_product_param { num_output: 3
+                                  weight_filler { type: "xavier" }
+                                  bias_filler { type: "constant" value: 2 } } }
+    """
+    net = Net(load_net_prototxt(txt))
+    params = net.init(rng)
+    assert len(params["ip_a"]) == 2        # owns weight + bias
+    assert len(params["ip_b"]) == 1        # owns only its bias
+    x = jax.random.normal(rng, (2, 4))
+    out = net.apply(params, {"a": x, "b": x}, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out.blobs["fb"]) - np.asarray(out.blobs["fa"]),
+        np.ones((2, 3)), rtol=1e-5)        # same weight, bias differs by 1
+
+
+def test_param_sharing_shape_mismatch_raises():
+    txt = """
+    name: "bad"
+    layer { name: "d" type: "Input" top: "a" top: "b"
+            input_param { shape { dim: 2 dim: 4 } shape { dim: 2 dim: 5 } } }
+    layer { name: "ip_a" type: "InnerProduct" bottom: "a" top: "fa"
+            param { name: "w" }
+            inner_product_param { num_output: 3
+                                  weight_filler { type: "xavier" } } }
+    layer { name: "ip_b" type: "InnerProduct" bottom: "b" top: "fb"
+            param { name: "w" }
+            inner_product_param { num_output: 3
+                                  weight_filler { type: "xavier" } } }
+    """
+    with pytest.raises(ValueError, match="shape mismatch"):
+        Net(load_net_prototxt(txt))
+
+
 def test_jit_apply(rng):
     net = Net(cifar10_quick(4, 4), NetState(Phase.TRAIN))
     params = net.init(rng)

@@ -449,6 +449,53 @@ def test_contrastive_loss(np_rng):
     assert loss == pytest.approx(ref, rel=1e-3)
 
 
+def test_softmax_loss_normalize_false_axis(np_rng):
+    """normalize=false divides by outer_num_ = prod(shape[:axis]), not the
+    batch dim (softmax_loss_layer.cpp Forward) — differs when axis != 1."""
+    x = np_rng.normal(size=(2, 3, 5)).astype(np.float32)  # axis=2: C=5
+    labels = np_rng.integers(0, 5, size=(2, 3)).astype(np.float32)
+    lp = layer("l", "SoftmaxWithLoss", ["x", "y"], ["loss"],
+               softmax_param={"axis": 2}, loss_param={"normalize": False})
+    loss = float(apply_op(lp, [x, labels])[0])
+    logp = np.log(np.exp(x) / np.exp(x).sum(-1, keepdims=True))
+    nll = -np.take_along_axis(
+        logp, labels.astype(np.int64)[..., None], axis=-1)
+    ref = nll.sum() / (2 * 3)  # outer_num_ = 6, not batch 2
+    assert loss == pytest.approx(ref, rel=1e-4)
+
+
+def test_filter_layer_eager_and_taint(np_rng):
+    x = np_rng.normal(size=(4, 3)).astype(np.float32)
+    sel = np.array([1, 0, 1, 0], np.float32)
+    lp = layer("f", "Filter", ["x", "sel"], ["out"])
+    out = apply_op(lp, [x, sel])[0]
+    np.testing.assert_allclose(np.asarray(out), x[[0, 2]])
+
+    # downstream of Filter: a consumer whose params ignore the batch dim
+    # (InnerProduct axis=1) still builds — it runs fine eager — but one
+    # whose param shapes depend on the batch dim (axis=0) is rejected
+    from sparknet_tpu.graph import Net
+    from sparknet_tpu.proto import load_net_prototxt
+    ok_txt = """
+    layer { name: "d" type: "Input" top: "x" top: "sel"
+            input_param { shape { dim: 4 dim: 3 } shape { dim: 4 } } }
+    layer { name: "f" type: "Filter" bottom: "x" bottom: "sel" top: "fx" }
+    layer { name: "ip" type: "InnerProduct" bottom: "fx" top: "y"
+            inner_product_param { num_output: 2
+                                  weight_filler { type: "xavier" } } }
+    """
+    net = Net(load_net_prototxt(ok_txt))
+    params = net.init(jax.random.PRNGKey(0))
+    out = net.apply(params, {"x": jnp.asarray(x), "sel": jnp.asarray(sel)},
+                    train=False)
+    assert out.blobs["y"].shape == (2, 2)  # eager: real filtered batch
+
+    bad_txt = ok_txt.replace("num_output: 2",
+                             "num_output: 2 axis: 0")
+    with pytest.raises(ValueError, match="data-dependent batch"):
+        Net(load_net_prototxt(bad_txt))
+
+
 def test_loss_gradients(np_rng):
     x = jnp.asarray(np_rng.normal(size=(4, 5)).astype(np.float32))
     labels = jnp.asarray(np.array([0, 1, 2, 3], np.float32))

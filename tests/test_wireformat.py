@@ -223,6 +223,57 @@ def test_solver_caffe_snapshot_restore_equivalence(tmp_path):
                                        rtol=2e-4, atol=2e-5)
 
 
+SIAMESE_SOLVER_NET = """
+name: "siamese"
+layer { name: "d" type: "JavaData" top: "a" top: "label"
+        java_data_param { shape { dim: 4 dim: 8 } shape { dim: 4 } } }
+layer { name: "ip_a" type: "InnerProduct" bottom: "a" top: "fa"
+        param { name: "w" }
+        inner_product_param { num_output: 8
+                              weight_filler { type: "xavier" }
+                              bias_filler { type: "constant" value: 1 } } }
+layer { name: "ip_b" type: "InnerProduct" bottom: "fa" top: "fb"
+        param { name: "w" }
+        inner_product_param { num_output: 8
+                              weight_filler { type: "xavier" }
+                              bias_filler { type: "constant" value: 2 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "fb" bottom: "a"
+        top: "loss" }
+"""
+
+
+def test_caffemodel_interop_with_shared_params(tmp_path):
+    """A partially-shared net saves caffemodels with FULL per-layer blob
+    lists (Net::ToProto convention — Caffe CHECK_EQs blob counts on load)
+    and loads them back through the sharing map."""
+    from sparknet_tpu.proto import load_net_prototxt
+    from sparknet_tpu.proto.caffemodel import load_net_binaryproto
+
+    def make():
+        sp = load_solver_prototxt_with_net(
+            SOLVER_TXT, load_net_prototxt(SIAMESE_SOLVER_NET))
+        return Solver(sp, seed=0)
+
+    a = make()
+    assert len(a.params["ip_a"]) == 2 and len(a.params["ip_b"]) == 1
+    model, _ = a.snapshot_caffe(str(tmp_path / "shared"))
+
+    # the file carries 2 blobs for BOTH ip layers (sharer repeats the weight)
+    net = load_net_binaryproto(model)
+    by_name = {lp.name: lp for lp in net.layer}
+    assert len(by_name["ip_a"].blobs) == 2
+    assert len(by_name["ip_b"].blobs) == 2
+    np.testing.assert_allclose(by_name["ip_a"].blobs[0],
+                               by_name["ip_b"].blobs[0])  # same shared w
+
+    b = make()
+    b.load_weights(model)
+    for k in a.params:
+        for x, y in zip(a.params[k], b.params[k]):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-6)
+
+
 def test_load_weights_sniffs_caffemodel(tmp_path):
     a = _solver()
     path = str(tmp_path / "w.caffemodel")
