@@ -166,4 +166,57 @@ for i in range(4):
     np.testing.assert_allclose(out[i], w, rtol=1e-5)
 print("mean-window crop ok")
 
+# standalone DB-backed training through the CLI tool chain:
+# convert_imageset -> compute_image_mean -> caffe train -> caffe test
+import tempfile
+from PIL import Image
+
+from sparknet_tpu.tools import caffe_cli, compute_image_mean, convert_imageset
+
+tooldir = tempfile.mkdtemp()
+for i in range(8):
+    arr = rng.integers(0, 256, size=(10, 10, 3)).astype(np.uint8)
+    Image.fromarray(arr).save(f"{tooldir}/im{i}.png")
+with open(f"{tooldir}/list.txt", "w") as f:
+    f.write("".join(f"im{i}.png {i % 2}\n" for i in range(8)))
+assert convert_imageset.main(
+    [tooldir, f"{tooldir}/list.txt", f"{tooldir}/db",
+     "--resize_height", "8", "--resize_width", "8"]) == 0
+assert compute_image_mean.main(
+    [f"{tooldir}/db", f"{tooldir}/mean.binaryproto"]) == 0
+with open(f"{tooldir}/net.prototxt", "w") as f:
+    f.write(f"""
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+        transform_param {{ mean_file: "{tooldir}/mean.binaryproto" }}
+        data_param {{ source: "{tooldir}/db" batch_size: 4 backend: LMDB }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param {{ num_output: 2
+                              weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+        top: "loss" include {{ phase: TRAIN }} }}
+layer {{ name: "acc" type: "Accuracy" bottom: "ip" bottom: "label"
+        top: "acc" include {{ phase: TEST }} }}
+""")
+with open(f"{tooldir}/solver.prototxt", "w") as f:
+    f.write(f'net: "{tooldir}/net.prototxt"\nbase_lr: 0.01\n'
+            f'lr_policy: "fixed"\nmax_iter: 4\ntest_iter: 2\n'
+            f'test_interval: 2\nsnapshot_prefix: "{tooldir}/s"\nsnapshot: 1\n')
+assert caffe_cli.main(["train", "--solver", f"{tooldir}/solver.prototxt"]) == 0
+assert caffe_cli.main(["test", "--model", f"{tooldir}/net.prototxt",
+                       "--weights", f"{tooldir}/s_iter_4.caffemodel",
+                       "--iterations", "2"]) == 0
+print("CLI tool chain ok")
+
+# V0-format net upgrade (padding folding + nested V0LayerParameter)
+v0 = load_net_prototxt("""
+input: "data"
+input_dim: 1 input_dim: 1 input_dim: 8 input_dim: 8
+layers { layer { name: "pad" type: "padding" pad: 1 } bottom: "data" top: "p" }
+layers { layer { name: "c" type: "conv" num_output: 2 kernelsize: 3
+                 weight_filler { type: "xavier" } } bottom: "p" top: "c" }
+""")
+net_v0 = Net(v0)
+assert net_v0.blob_shapes["c"] == (1, 2, 8, 8)  # pad folded into conv
+print("V0 upgrade ok")
+
 print("DRIVE OK")

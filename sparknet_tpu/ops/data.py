@@ -88,6 +88,93 @@ class MemoryDataLayer(InputLikeLayer):
         return [(n, c, h, w), (n,)]
 
 
+@register_layer("Data")
+class DataLayer(InputLikeLayer):
+    """LMDB/LevelDB-backed data layer (reference:
+    caffe/src/caffe/layers/data_layer.cpp + data_reader.cpp:62-109 +
+    util/db_lmdb.cpp/db_leveldb.cpp).  Shape inference peeks the first
+    Datum, as DataLayer::DataLayerSetUp does; the host feed is
+    sparknet_tpu.data.db.db_feed (LMDB/LevelDB parsed natively — no
+    liblmdb/libleveldb dependency)."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        from ..data.db import datum_to_array, open_db, _backend_name
+        p = lp.sub("data_param")
+        source = p.get("source")
+        if source is None:
+            raise ValueError(f"Data layer {lp.name!r} missing source")
+        batch = int(p.get("batch_size", 1))
+        reader = open_db(str(source),
+                         _backend_name(p.get("backend", "LEVELDB")))
+        try:
+            _key, val = reader.first()
+            img, _label = datum_to_array(val)
+        finally:
+            reader.close()
+        c, h, w = img.shape
+        crop = int(lp.sub("transform_param").get("crop_size", 0))
+        if crop:
+            h = w = crop
+        shapes: list[Shape] = [(batch, c, h, w)]
+        if len(lp.top) > 1:
+            shapes.append((batch,))
+        return shapes
+
+
+@register_layer("ImageData")
+class ImageDataLayer(InputLikeLayer):
+    """File-list image data layer (reference:
+    caffe/src/caffe/layers/image_data_layer.cpp): `source` is a text file
+    of "path label" lines; host feed sparknet_tpu.data.db.image_data_feed."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        from ..data.db import load_image, read_image_list
+        p = lp.sub("image_data_param")
+        source = p.get("source")
+        if source is None:
+            raise ValueError(f"ImageData layer {lp.name!r} missing source")
+        batch = int(p.get("batch_size", 1))
+        new_h = int(p.get("new_height", 0))
+        new_w = int(p.get("new_width", 0))
+        color = bool(p.get("is_color", True))
+        c = 3 if color else 1
+        if new_h and new_w:
+            h, w = new_h, new_w
+        else:
+            # ImageDataLayer reads the first image for its shape
+            path, _ = read_image_list(str(source),
+                                      str(p.get("root_folder", "")))[0]
+            img = load_image(path, 0, 0, color)
+            _c, h, w = img.shape
+        crop = int(lp.sub("transform_param").get("crop_size", 0))
+        if crop:
+            h = w = crop
+        shapes: list[Shape] = [(batch, c, h, w)]
+        if len(lp.top) > 1:
+            shapes.append((batch,))
+        return shapes
+
+
+@register_layer("WindowData")
+class WindowDataLayer(InputLikeLayer):
+    """R-CNN window sampling data layer (reference:
+    caffe/src/caffe/layers/window_data_layer.cpp): fg/bg windows cropped,
+    context-padded and warped to crop_size; host feed
+    sparknet_tpu.data.db.window_data_feed."""
+
+    def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
+        p = lp.sub("window_data_param")
+        if p.get("source") is None:
+            raise ValueError(f"WindowData layer {lp.name!r} missing source")
+        batch = int(p.get("batch_size", 1))
+        crop = int(lp.sub("transform_param").get("crop_size", 0)) or 227
+        channels = 3
+        shapes: list[Shape] = [(batch, channels, crop, crop)]
+        if len(lp.top) > 1:
+            shapes.append((batch,))
+        return shapes
+
+
 @register_layer("HDF5Data")
 class HDF5DataLayer(InputLikeLayer):
     """Host-fed data layer with shapes discovered from the first listed

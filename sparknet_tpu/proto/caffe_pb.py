@@ -193,6 +193,179 @@ class ParamSpec:
         )
 
 
+# V0 lowercase type names -> V1 enum names
+# (reference: caffe/src/caffe/util/upgrade_proto.cpp UpgradeV0LayerType)
+_V0_TYPE_MAP = {
+    "accuracy": "ACCURACY", "bnll": "BNLL", "concat": "CONCAT",
+    "conv": "CONVOLUTION", "data": "DATA", "dropout": "DROPOUT",
+    "euclidean_loss": "EUCLIDEAN_LOSS", "flatten": "FLATTEN",
+    "hdf5_data": "HDF5_DATA", "hdf5_output": "HDF5_OUTPUT",
+    "im2col": "IM2COL", "images": "IMAGE_DATA",
+    "infogain_loss": "INFOGAIN_LOSS", "innerproduct": "INNER_PRODUCT",
+    "lrn": "LRN", "multinomial_logistic_loss": "MULTINOMIAL_LOGISTIC_LOSS",
+    "pool": "POOLING", "relu": "RELU", "sigmoid": "SIGMOID",
+    "softmax": "SOFTMAX", "softmax_loss": "SOFTMAX_LOSS", "split": "SPLIT",
+    "tanh": "TANH", "window_data": "WINDOW_DATA", "padding": "PADDING",
+}
+
+
+def _net_needs_v0_upgrade(m: PMessage) -> bool:
+    """V0 nets nest a V0LayerParameter inside each layers entry
+    (upgrade_proto.cpp NetNeedsV0ToV1Upgrade)."""
+    return any(isinstance(l, PMessage) and l.has("layer")
+               for l in m.get_all("layers"))
+
+
+def _upgrade_v0_padding(entries: list[PMessage]) -> list[PMessage]:
+    """Fold explicit ``padding`` layers into the conv/pool layer that
+    consumes them (upgrade_proto.cpp UpgradeV0PaddingLayers)."""
+    top_src: dict[str, PMessage] = {}
+    out: list[PMessage] = []
+    for entry in entries:
+        v0 = entry.get("layer")
+        if v0 is not None and str(v0.get("type", "")) == "padding":
+            for t in entry.get_all("top"):
+                top_src[str(t)] = entry
+            continue
+        for i, b in enumerate(entry.get_all("bottom")):
+            pad_entry = top_src.get(str(b))
+            if pad_entry is None:
+                continue
+            pv0 = pad_entry.get("layer")
+            if v0 is None or str(v0.get("type", "")) not in ("conv", "pool"):
+                who = str(v0.get("name", "?")) if v0 is not None else "?"
+                raise ValueError(
+                    f"padding layer feeds non-conv/pool layer {who!r} "
+                    "(undefined in Caffe; upgrade_proto.cpp CHECK)")
+            v0.set("pad", pv0.get("pad", 0))
+            bots = entry.get_all("bottom")
+            bots[i] = pad_entry.get("bottom")
+            entry.clear("bottom")
+            for b2 in bots:
+                entry.add("bottom", b2)
+        for t in entry.get_all("top"):
+            top_src.pop(str(t), None)
+        out.append(entry)
+    return out
+
+
+def _upgrade_v0_layer(entry: PMessage) -> PMessage:
+    """One V0 layers entry -> V1-style flat PMessage
+    (upgrade_proto.cpp UpgradeV0LayerParameter)."""
+    v0 = entry.get("layer")
+    out = PMessage()
+    for b in entry.get_all("bottom"):
+        out.add("bottom", b)
+    for t in entry.get_all("top"):
+        out.add("top", t)
+    if v0 is None:
+        return out
+    type_ = str(v0.get("type", ""))
+    if v0.has("name"):
+        out.add("name", v0.get("name"))
+    out.add("type", _V0_TYPE_MAP.get(type_, type_))
+    for key in ("blobs", "blobs_lr", "weight_decay"):
+        for val in v0.get_all(key):
+            out.add(key, val)
+
+    subs: dict[str, PMessage] = {}
+
+    def sub(name: str) -> PMessage:
+        if name not in subs:
+            subs[name] = PMessage()
+        return subs[name]
+
+    def move(v0_key: str, sub_name: str, new_key: str | None = None) -> None:
+        if v0.has(v0_key):
+            sub(sub_name).add(new_key or v0_key, v0.get(v0_key))
+
+    if type_ == "conv":
+        move("num_output", "convolution_param")
+        move("biasterm", "convolution_param", "bias_term")
+        move("weight_filler", "convolution_param")
+        move("bias_filler", "convolution_param")
+        move("pad", "convolution_param")
+        move("kernelsize", "convolution_param", "kernel_size")
+        move("group", "convolution_param")
+        move("stride", "convolution_param")
+    elif type_ == "innerproduct":
+        move("num_output", "inner_product_param")
+        move("biasterm", "inner_product_param", "bias_term")
+        move("weight_filler", "inner_product_param")
+        move("bias_filler", "inner_product_param")
+    elif type_ == "pool":
+        move("pad", "pooling_param")
+        move("kernelsize", "pooling_param", "kernel_size")
+        move("stride", "pooling_param")
+        move("pool", "pooling_param")
+    elif type_ == "dropout":
+        move("dropout_ratio", "dropout_param")
+    elif type_ == "lrn":
+        move("local_size", "lrn_param")
+        move("alpha", "lrn_param")
+        move("beta", "lrn_param")
+        move("k", "lrn_param")
+    elif type_ == "data":
+        move("source", "data_param")
+        move("batchsize", "data_param", "batch_size")
+        move("rand_skip", "data_param")
+    elif type_ == "hdf5_data":
+        move("source", "hdf5_data_param")
+        move("batchsize", "hdf5_data_param", "batch_size")
+    elif type_ == "images":
+        move("source", "image_data_param")
+        move("batchsize", "image_data_param", "batch_size")
+        move("rand_skip", "image_data_param")
+        move("shuffle_images", "image_data_param", "shuffle")
+        move("new_height", "image_data_param")
+        move("new_width", "image_data_param")
+    elif type_ == "window_data":
+        move("source", "window_data_param")
+        move("batchsize", "window_data_param", "batch_size")
+        move("det_fg_threshold", "window_data_param", "fg_threshold")
+        move("det_bg_threshold", "window_data_param", "bg_threshold")
+        move("det_fg_fraction", "window_data_param", "fg_fraction")
+        move("det_context_pad", "window_data_param", "context_pad")
+        move("det_crop_mode", "window_data_param", "crop_mode")
+    elif type_ == "infogain_loss":
+        move("source", "infogain_loss_param")
+    elif type_ == "concat":
+        move("concat_dim", "concat_param")
+    # old-style transformation fields -> transform_param
+    # (UpgradeNetDataTransformation)
+    if type_ in ("data", "images", "window_data"):
+        move("scale", "transform_param")
+        move("meanfile", "transform_param", "mean_file")
+        move("cropsize", "transform_param", "crop_size")
+        move("mirror", "transform_param")
+    for name, msg_ in subs.items():
+        out.add(name, msg_)
+    return out
+
+
+_DATA_PARAM_OF = {"Data": "data_param", "ImageData": "image_data_param",
+                  "WindowData": "window_data_param"}
+
+
+def _upgrade_data_transform(lp: "LayerParameter") -> None:
+    """Move old-style scale/mean_file/crop_size/mirror fields out of
+    data_param and friends into transform_param (upgrade_proto.cpp
+    UpgradeNetDataTransformation)."""
+    pkey = _DATA_PARAM_OF.get(lp.type)
+    if pkey is None or pkey not in lp.params:
+        return
+    p = lp.params[pkey]
+    moved = {k: p.get(k) for k in ("scale", "mean_file", "crop_size",
+                                   "mirror") if p.has(k)}
+    if not moved:
+        return
+    tp = lp.params.setdefault("transform_param", PMessage())
+    for k, v in moved.items():
+        if not tp.has(k):
+            tp.add(k, v)
+        p.clear(k)
+
+
 # V1LayerParameter enum type names -> V2 string type names
 # (reference: caffe/src/caffe/util/upgrade_proto.cpp UpgradeV1LayerType)
 _V1_TYPE_MAP = {
@@ -281,6 +454,10 @@ class LayerParameter:
                     name=shared_names[i] if i < len(shared_names) else None,
                     lr_mult=lrs[i] if i < len(lrs) else 1.0,
                     decay_mult=wds[i] if i < len(wds) else 1.0,
+                    # V1 blobs_lr/weight_decay are explicit settings — keep
+                    # presence so shared-param merge semantics see them
+                    raw_lr_mult=lrs[i] if i < len(lrs) else None,
+                    raw_decay_mult=wds[i] if i < len(wds) else None,
                 ))
         for key in _PARAM_SUBMSG_KEYS:
             sub = m.get(key)
@@ -320,8 +497,15 @@ class NetParameter:
     def from_pmsg(cls, m: PMessage) -> "NetParameter":
         layers_new = m.get_all("layer")
         layers_v1 = m.get_all("layers")
+        if _net_needs_v0_upgrade(m):
+            # V0 -> V1 at the message level (padding folding + nested
+            # V0LayerParameter flattening), then the V1 path below
+            layers_v1 = [_upgrade_v0_layer(e)
+                         for e in _upgrade_v0_padding(list(layers_v1))]
         layer = [LayerParameter.from_pmsg(l) for l in layers_new]
         layer += [LayerParameter.from_pmsg(l, v1=True) for l in layers_v1]
+        for lp in layer:
+            _upgrade_data_transform(lp)
         input_shape = [BlobShape.from_pmsg(s) for s in m.get_all("input_shape")]
         input_dims = [int(d) for d in m.get_all("input_dim")]
         if input_dims and not input_shape:

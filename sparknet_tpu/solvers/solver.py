@@ -91,11 +91,18 @@ class Solver:
         for _ in range(n):
             stacked = self._next_batches()
             self._rng, rng = jax.random.split(self._rng)
+            debug = self.sp.debug_info and (
+                not self.sp.display or (self.iter + 1) % self.sp.display == 0)
+            # copy: the jitted step donates param buffers
+            params_before = jax.tree_util.tree_map(
+                jnp.copy, self.params) if debug else None
             self.params, self.state, loss_dev = self._step(
                 self.params, self.state, self.iter, stacked, rng)
             loss = float(loss_dev)
             self._smoothed.append(loss)
             self.iter += 1
+            if debug:
+                self._log_debug_info(stacked, params_before, rng)
             if self.sp.display and self.iter % self.sp.display == 0:
                 print(f"Iteration {self.iter}, loss = {self.smoothed_loss():.6f}")
             # snapshot-on-schedule (reference: solver.cpp:270-277)
@@ -103,6 +110,28 @@ class Solver:
                     and self.iter % self.sp.snapshot == 0):
                 self.snapshot_caffe()
         return self.smoothed_loss() if self._smoothed else loss
+
+    def _log_debug_info(self, stacked, params_before, rng) -> None:
+        """Per-blob/param mean-|x| dumps behind ``sp.debug_info`` — the
+        ForwardDebugInfo / UpdateDebugInfo logging of the reference
+        (net.cpp:711-735, sgd_solver.cpp via Solver::Step).  The forward
+        re-runs eagerly on the first micro-batch; update magnitudes come
+        from the params delta (the jitted step exposes no grads)."""
+        def asum(v) -> float:
+            return float(jnp.mean(jnp.abs(v)))
+
+        first = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        blobs = self.train_net.apply_all(self.params, first, train=True,
+                                         rng=rng)
+        for node in self.train_net.nodes:
+            for t in node.tops:
+                if t in blobs:
+                    print(f"    [Forward] Layer {node.lp.name}, "
+                          f"top blob {t} data: {asum(blobs[t]):.6g}")
+        for key, before in params_before.items():
+            for i, (b, a) in enumerate(zip(before, self.params[key])):
+                print(f"    [Update] Layer {key}, param {i} "
+                      f"data: {asum(a):.6g}; diff: {asum(a - b):.6g}")
 
     def _next_batches(self):
         batches = [dict(next(self._train_iter)) for _ in range(self.sp.iter_size)]

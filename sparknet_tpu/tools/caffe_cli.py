@@ -1,0 +1,179 @@
+"""The `caffe` command-line tool analog: train / test / time / device_query
+(reference: caffe/tools/caffe.cpp — brew-function registry at :55, train at
+:153, test at :222, time at :290, device_query at :110).
+
+Usage:
+  python -m sparknet_tpu.tools.caffe_cli train --solver S.prototxt \
+      [--snapshot X.solverstate | --weights W.caffemodel]
+  python -m sparknet_tpu.tools.caffe_cli test --model M.prototxt \
+      --weights W.caffemodel [--iterations 50]
+  python -m sparknet_tpu.tools.caffe_cli time --model M.prototxt \
+      [--iterations 50]
+  python -m sparknet_tpu.tools.caffe_cli device_query
+
+Self-sourcing data layers (Data/ImageData/WindowData/HDF5Data) feed
+themselves from their configured sources — zoo train_val.prototxts run
+standalone once their DBs exist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _train(args) -> int:
+    from ..data.db import feed_for_net
+    from ..data.prefetch import device_feed
+    from ..proto import Phase, load_solver_prototxt
+    from ..solvers import Solver
+
+    sp = load_solver_prototxt(args.solver)
+    _resolve_solver_net(sp, args.solver)
+    solver = Solver(sp, seed=0)
+    if args.weights:
+        solver.load_weights(args.weights)
+        print(f"Finetuning from {args.weights}")
+    if args.snapshot:
+        solver.restore_caffe(args.snapshot)
+        print(f"Resuming from {args.snapshot} (iter {solver.iter})")
+
+    net_param = sp.net_param or sp.train_net_param
+    solver.set_train_data(device_feed(feed_for_net(net_param, Phase.TRAIN)))
+    try:
+        test_feed_factory = lambda: feed_for_net(net_param, Phase.TEST)
+        test_feed_factory()  # probe
+        solver.set_test_data(test_feed_factory)
+    except ValueError:
+        test_feed_factory = None
+
+    max_iter = sp.max_iter or 100
+    interval = sp.test_interval if (sp.test_interval and test_feed_factory) \
+        else 0
+    test_iter = sp.test_iter[0] if sp.test_iter else 50
+    it = 0
+    while it < max_iter:
+        n = min(interval, max_iter - it) if interval else max_iter - it
+        loss = solver.step(n)
+        it += n
+        print(f"Iteration {it}, loss = {loss:.6f}")
+        if interval and it < max_iter:
+            scores = solver.test(test_iter)
+            for k, v in scores.items():
+                print(f"    Test net output: {k} = {v / test_iter:.6f}")
+    if sp.snapshot_prefix:
+        model, state = solver.snapshot_caffe()
+        print(f"Snapshotting to {model}")
+    print("Optimization Done.")
+    return 0
+
+
+def _test(args) -> int:
+    import collections
+
+    import jax
+    import numpy as np
+
+    from ..data.db import feed_for_net
+    from ..graph import Net
+    from ..proto import NetState, Phase, load_net_prototxt
+    from ..solvers.solver import Solver
+
+    net_param = load_net_prototxt(args.model)
+    net = Net(net_param, NetState(Phase.TEST))
+    params = net.init(jax.random.PRNGKey(0))
+    if args.weights:
+        loader = Solver.__new__(Solver)
+        loader.params = params
+        loader.train_net = net
+        loader.load_weights(args.weights)
+        params = loader.params
+    feed = feed_for_net(net_param, Phase.TEST)
+    fwd = jax.jit(lambda p, b: net.apply(p, b, train=False).blobs)
+    totals: dict[str, float] = collections.defaultdict(float)
+    for i in range(args.iterations):
+        batch = {k: np.asarray(v) for k, v in next(feed).items()}
+        out = fwd(params, batch)
+        parts = []
+        for k, v in out.items():
+            val = float(np.mean(np.asarray(v)))
+            totals[k] += val
+            parts.append(f"{k} = {val:.4f}")
+        print(f"Batch {i}, " + ", ".join(parts))
+    for k, v in totals.items():
+        print(f"{k} = {v / args.iterations:.6f}")
+    return 0
+
+
+def _time(args) -> int:
+    from .time_net import main as time_main
+    argv = ["--model", args.model, "--iterations", str(args.iterations)]
+    if args.per_layer:
+        argv.append("--per-layer")
+    return time_main(argv) or 0
+
+
+def _device_query(args) -> int:
+    import jax
+    for d in jax.devices():
+        print(f"Device id:                     {d.id}")
+        print(f"Platform:                      {d.platform}")
+        print(f"Device kind:                   {d.device_kind}")
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            for k in ("bytes_in_use", "bytes_limit"):
+                if k in stats:
+                    print(f"{k + ':':<30} {stats[k]}")
+    return 0
+
+
+def _resolve_solver_net(sp, solver_path: str) -> None:
+    """Load the solver's net:/train_net: reference into net_param, resolving
+    the path like the reference does (relative to the caffe root / cwd)."""
+    import os
+
+    from ..proto import load_net_prototxt
+    if sp.net_param or sp.train_net_param:
+        return
+    ref = sp.net or sp.train_net
+    if ref is None:
+        raise SystemExit("solver has no net")
+    for base in ("", os.path.dirname(os.path.abspath(solver_path))):
+        cand = os.path.join(base, ref) if base else ref
+        if os.path.exists(cand):
+            sp.net_param = load_net_prototxt(cand)
+            return
+        cand = os.path.join(base, os.path.basename(ref)) if base else ref
+        if os.path.exists(cand):
+            sp.net_param = load_net_prototxt(cand)
+            return
+    raise SystemExit(f"cannot resolve net path {ref!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="caffe",
+                                 description="caffe.cpp CLI analog")
+    sub = ap.add_subparsers(dest="action", required=True)
+    p = sub.add_parser("train")
+    p.add_argument("--solver", required=True)
+    p.add_argument("--snapshot", default=None)
+    p.add_argument("--weights", default=None)
+    p.set_defaults(fn=_train)
+    p = sub.add_parser("test")
+    p.add_argument("--model", required=True)
+    p.add_argument("--weights", default=None)
+    p.add_argument("--iterations", type=int, default=50)
+    p.set_defaults(fn=_test)
+    p = sub.add_parser("time")
+    p.add_argument("--model", required=True)
+    p.add_argument("--iterations", type=int, default=50)
+    p.add_argument("--per-layer", action="store_true")
+    p.set_defaults(fn=_time)
+    p = sub.add_parser("device_query")
+    p.set_defaults(fn=_device_query)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""compute_image_mean — mean image of a Datum DB -> mean.binaryproto
+(reference: caffe/tools/compute_image_mean.cpp).
+
+Usage:
+  python -m sparknet_tpu.tools.compute_image_mean INPUT_DB OUTPUT_FILE \
+      [--backend lmdb|leveldb]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("input_db")
+    ap.add_argument("output_file", nargs="?", default=None)
+    ap.add_argument("--backend", choices=["lmdb", "leveldb"], default="lmdb")
+    args = ap.parse_args(argv)
+
+    from ..data.db import datum_to_array, open_db
+
+    acc: np.ndarray | None = None
+    n = 0
+    with open_db(args.input_db, args.backend.upper()) as db:
+        for _key, val in db.items():
+            img, _label = datum_to_array(val)
+            if acc is None:
+                acc = np.zeros(img.shape, np.float64)
+            elif acc.shape != img.shape:
+                raise SystemExit(
+                    f"shape mismatch: {img.shape} vs {acc.shape} "
+                    "(all datums must agree, compute_image_mean.cpp CHECK)")
+            acc += img
+            n += 1
+            if n % 10000 == 0:
+                print(f"processed {n} files")
+    if not n:
+        raise SystemExit("empty database")
+    mean = (acc / n).astype(np.float32)
+    print(f"processed {n} files")
+    if args.output_file:
+        from ..proto.caffemodel import save_mean_binaryproto
+        save_mean_binaryproto(args.output_file, mean)
+        print(f"wrote {args.output_file}")
+    # the reference logs per-channel means
+    for c, v in enumerate(mean.reshape(mean.shape[0], -1).mean(axis=1)):
+        print(f"mean_value channel [{c}]: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,86 @@
+"""extract_features — run a trained net forward and dump named blobs to a
+Datum DB (reference: caffe/tools/extract_features.cpp).
+
+Usage:
+  python -m sparknet_tpu.tools.extract_features WEIGHTS MODEL_PROTOTXT \
+      BLOB_NAMES DB_NAMES NUM_BATCHES [--backend lmdb|leveldb]
+
+BLOB_NAMES / DB_NAMES are comma-separated and pair up one-to-one.  The
+model prototxt must contain a self-sourcing data layer (Data / ImageData /
+HDF5Data), exactly like the reference tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("weights")
+    ap.add_argument("model")
+    ap.add_argument("blob_names")
+    ap.add_argument("db_names")
+    ap.add_argument("num_batches", type=int)
+    ap.add_argument("--backend", choices=["lmdb", "leveldb"], default="lmdb")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from ..data.db import array_to_datum, feed_for_net
+    from ..graph import Net
+    from ..proto import NetState, Phase, load_net_prototxt
+
+    blob_names = args.blob_names.split(",")
+    db_names = args.db_names.split(",")
+    if len(blob_names) != len(db_names):
+        raise SystemExit("blob_names and db_names must pair up")
+
+    net_param = load_net_prototxt(args.model)
+    net = Net(net_param, NetState(Phase.TEST))
+    for b in blob_names:
+        if b not in net.blob_shapes:
+            raise SystemExit(f"unknown blob {b!r} "
+                             f"(extract_features.cpp CHECK has_blob)")
+    params = net.init(jax.random.PRNGKey(0))
+
+    # weights: npz checkpoint or .caffemodel, matching by layer name
+    from ..solvers.solver import Solver
+    loader = Solver.__new__(Solver)  # reuse the loading logic statically
+    loader.params = params
+    loader.train_net = net
+    loader.load_weights(args.weights)
+    params = loader.params
+
+    feed = feed_for_net(net_param, Phase.TEST)
+
+    fwd = jax.jit(lambda p, inputs: net.apply_all(p, inputs))
+
+    outputs: dict[str, list[tuple[bytes, bytes]]] = {b: [] for b in blob_names}
+    idx = 0
+    for _ in range(args.num_batches):
+        batch = {k: np.asarray(v) for k, v in next(feed).items()}
+        blobs = fwd(params, batch)
+        n = next(iter(batch.values())).shape[0]
+        for i in range(n):
+            key = b"%010d" % idx
+            idx += 1
+            for b in blob_names:
+                feat = np.asarray(blobs[b][i], np.float32)
+                outputs[b].append(
+                    (key, array_to_datum(feat.reshape(-1, 1, 1))))
+    for b, db in zip(blob_names, db_names):
+        if args.backend == "lmdb":
+            from ..data.lmdb_io import write_lmdb
+            write_lmdb(db, outputs[b])
+        else:
+            from ..data.leveldb_io import write_leveldb
+            write_leveldb(db, outputs[b])
+        print(f"extracted {idx} features for blob {b!r} -> {db}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

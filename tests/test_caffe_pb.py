@@ -2,6 +2,8 @@
 replacement, V1 upgrade (reference parity: ProtoLoader.scala,
 util/upgrade_proto.cpp)."""
 
+import pytest
+
 from sparknet_tpu.proto import (
     NetState, Phase,
     load_net_prototxt, load_solver_prototxt, load_solver_prototxt_with_net,
@@ -117,3 +119,71 @@ def test_legacy_input_dim():
     net = load_net_prototxt(txt)
     assert net.input == ["data"]
     assert net.input_shape[0].dim == [1, 3, 4, 4]
+
+
+def test_v0_net_upgrade_with_padding():
+    """V0 nets (nested V0LayerParameter + explicit padding layers) upgrade
+    through the full chain: padding folded into the consuming conv, fields
+    flattened into typed sub-params, types mapped V0 -> V1 -> V2
+    (upgrade_proto.cpp:15-50, UpgradeV0PaddingLayers, UpgradeV0LayerParameter)."""
+    txt = """
+    name: "v0net"
+    input: "data"
+    input_dim: 2 input_dim: 1 input_dim: 12 input_dim: 12
+    layers { layer { name: "pad1" type: "padding" pad: 2 }
+             bottom: "data" top: "pad1" }
+    layers { layer { name: "conv1" type: "conv" num_output: 4 kernelsize: 5
+                     stride: 1 weight_filler { type: "xavier" } }
+             bottom: "pad1" top: "conv1" }
+    layers { layer { name: "relu1" type: "relu" } bottom: "conv1" top: "conv1" }
+    layers { layer { name: "pool1" type: "pool" pool: MAX kernelsize: 2
+                     stride: 2 } bottom: "conv1" top: "pool1" }
+    layers { layer { name: "drop" type: "dropout" dropout_ratio: 0.4 }
+             bottom: "pool1" top: "pool1" }
+    layers { layer { name: "ip" type: "innerproduct" num_output: 3
+                     weight_filler { type: "xavier" } blobs_lr: 1 blobs_lr: 2 }
+             bottom: "pool1" top: "ip" }
+    layers { layer { name: "prob" type: "softmax" } bottom: "ip" top: "prob" }
+    """
+    net = load_net_prototxt(txt)
+    by_name = {l.name: l for l in net.layer}
+    assert "pad1" not in by_name            # folded away
+    conv = by_name["conv1"]
+    assert conv.type == "Convolution"
+    assert conv.bottom == ["data"]          # rewired past the padding layer
+    assert int(conv.sub("convolution_param").get("pad")) == 2
+    assert int(conv.sub("convolution_param").get("kernel_size")) == 5
+    assert by_name["pool1"].type == "Pooling"
+    assert str(by_name["pool1"].sub("pooling_param").get("pool")) == "MAX"
+    assert float(by_name["drop"].sub("dropout_param").get("dropout_ratio")) \
+        == pytest.approx(0.4)
+    assert [p.lr_mult for p in by_name["ip"].param] == [1.0, 2.0]
+
+    # the upgraded net builds and runs
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.graph import Net
+    net_obj = Net(net)
+    params = net_obj.init(jax.random.PRNGKey(0))
+    out = net_obj.apply(params, {"data": jnp.ones((2, 1, 12, 12))},
+                        train=False)
+    assert out.blobs["prob"].shape == (2, 3)
+
+
+def test_v0_data_transform_field_upgrade():
+    """Old-style scale/cropsize/mirror on V0 data layers land in
+    transform_param (UpgradeNetDataTransformation)."""
+    txt = """
+    layers { layer { name: "d" type: "data" source: "/nonexistent"
+                     batchsize: 4 scale: 0.0039 cropsize: 8 mirror: true }
+             top: "data" top: "label" }
+    """
+    net = load_net_prototxt(txt)
+    d = net.layer[0]
+    assert d.type == "Data"
+    assert int(d.sub("data_param").get("batch_size")) == 4
+    tp = d.sub("transform_param")
+    assert float(tp.get("scale")) == pytest.approx(0.0039)
+    assert int(tp.get("crop_size")) == 8
+    assert bool(tp.get("mirror")) is True

@@ -1,0 +1,264 @@
+"""DB-backed data path tests: native LMDB/LevelDB readers-writers, Datum
+interchange, DataTransformer, and standalone Data/ImageData/WindowData
+layers (the analog of the reference's test_db.cpp + test_data_layer.cpp +
+test_image_data_layer.cpp)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.db import (
+    DataTransformer,
+    array_to_datum,
+    datum_to_array,
+    db_feed,
+    image_data_feed,
+    open_db,
+    window_data_feed,
+)
+from sparknet_tpu.data.leveldb_io import (
+    LeveldbReader,
+    snappy_decompress,
+    write_leveldb,
+)
+from sparknet_tpu.data.lmdb_io import LmdbReader, write_lmdb
+from sparknet_tpu.models.dsl import layer
+from sparknet_tpu.proto.caffe_pb import Phase
+
+
+def _items(n=300, size=2000):
+    return [(b"%08d" % i, bytes([i % 251]) * (size + i % 5))
+            for i in range(n)]
+
+
+def test_lmdb_roundtrip(tmp_path):
+    items = _items()
+    path = str(tmp_path / "lmdb")
+    assert write_lmdb(path, items) == len(items)
+    with LmdbReader(path) as r:
+        assert len(r) == len(items)
+        assert list(r.items()) == sorted(items)
+
+
+def test_lmdb_multilevel_tree(tmp_path):
+    # enough entries to force branch depth >= 2
+    items = [(b"%010d" % i, b"v" * 100) for i in range(5000)]
+    path = str(tmp_path / "lmdb")
+    write_lmdb(path, items)
+    with LmdbReader(path) as r:
+        assert r.depth >= 2
+        got = list(r.items())
+    assert got == sorted(items)
+
+
+def test_leveldb_roundtrip(tmp_path):
+    items = _items(200)
+    path = str(tmp_path / "ldb")
+    assert write_leveldb(path, items) == len(items)
+    with LeveldbReader(path) as r:
+        assert len(r) == len(items)
+        assert list(r.items()) == sorted(items)
+
+
+def test_snappy_decoder():
+    # literal + 1-byte-offset copy (overlapping run)
+    enc = bytes([10, (5 - 1) << 2]) + b"abcde" + bytes([((5 - 4) << 2) | 1, 5])
+    assert snappy_decompress(enc) == b"abcdeabcde"
+    enc2 = bytes([8, 0]) + b"x" + bytes([((7 - 4) << 2) | 1, 1])
+    assert snappy_decompress(enc2) == b"x" * 8
+
+
+def test_datum_roundtrip():
+    img = (np.arange(3 * 4 * 5) % 256).reshape(3, 4, 5).astype(np.uint8)
+    raw = array_to_datum(img, label=7)
+    out, label = datum_to_array(raw)
+    assert label == 7
+    np.testing.assert_array_equal(out, img.astype(np.float32))
+
+    fimg = np.random.default_rng(0).normal(size=(2, 3, 3)).astype(np.float32)
+    out2, label2 = datum_to_array(array_to_datum(fimg, label=1))
+    assert label2 == 1
+    np.testing.assert_allclose(out2, fimg, rtol=1e-6)
+
+
+def _write_datum_db(tmp_path, backend, n=40, c=3, h=8, w=8):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(n, c, h, w)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=n)
+    items = [(b"%08d" % i, array_to_datum(imgs[i], int(labels[i])))
+             for i in range(n)]
+    path = str(tmp_path / backend.lower())
+    if backend == "LMDB":
+        write_lmdb(path, items)
+    else:
+        write_leveldb(path, items)
+    return path, imgs, labels
+
+
+@pytest.mark.parametrize("backend", ["LMDB", "LEVELDB"])
+def test_db_feed(tmp_path, backend):
+    path, imgs, labels = _write_datum_db(tmp_path, backend)
+    lp = layer("d", "Data", [], ["data", "label"],
+               data_param={"source": path, "batch_size": 8,
+                           "backend": backend})
+    feed = db_feed(lp, Phase.TEST)
+    b = next(feed)
+    assert b["data"].shape == (8, 3, 8, 8)
+    np.testing.assert_array_equal(b["data"][0], imgs[0].astype(np.float32))
+    np.testing.assert_array_equal(b["label"], labels[:8].astype(np.float32))
+    # advance to the last batch, then one more: cursor rewinds at end
+    # (data_reader.cpp:100-106)
+    for _ in range(40 // 8 - 1):
+        b = next(feed)
+    np.testing.assert_array_equal(b["data"][0], imgs[32].astype(np.float32))
+    b = next(feed)
+    np.testing.assert_array_equal(b["data"][0], imgs[0].astype(np.float32))
+
+
+def test_data_layer_standalone_net(tmp_path):
+    """A prototxt with a real Data layer builds (shape peeked from the DB)
+    and trains standalone — the `caffe train` path zoo train_vals need."""
+    import jax
+
+    from sparknet_tpu.graph import Net
+    from sparknet_tpu.proto import (
+        NetState,
+        load_net_prototxt,
+        load_solver_prototxt_with_net,
+    )
+    from sparknet_tpu.solvers import Solver
+
+    path, _imgs, _labels = _write_datum_db(tmp_path, "LMDB")
+    txt = f"""
+    name: "dbnet"
+    layer {{ name: "cifar" type: "Data" top: "data" top: "label"
+            transform_param {{ crop_size: 6 }}
+            data_param {{ source: "{path}" batch_size: 4 backend: LMDB }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+            inner_product_param {{ num_output: 10
+                                  weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+            bottom: "label" top: "loss" }}
+    """
+    np_ = load_net_prototxt(txt)
+    net = Net(np_, NetState(Phase.TRAIN))
+    assert net.blob_shapes["data"] == (4, 3, 6, 6)  # crop applied
+
+    sp = load_solver_prototxt_with_net("base_lr: 0.01\n", np_)
+    solver = Solver(sp, seed=0)
+    lp = np_.layer[0]
+    solver.set_train_data(db_feed(lp, Phase.TRAIN))
+    l0 = solver.step(3)
+    assert np.isfinite(l0)
+
+
+def test_transformer_mean_values_and_scale():
+    lp = layer("d", "Data", [], ["data"], transform_param={
+        "mean_value": [10.0, 20.0, 30.0], "scale": 0.5})
+    tf = DataTransformer(lp.sub("transform_param"), Phase.TEST)
+    img = np.full((3, 4, 4), 40.0, np.float32)
+    out = tf(img)
+    np.testing.assert_allclose(out[0], 15.0)
+    np.testing.assert_allclose(out[1], 10.0)
+    np.testing.assert_allclose(out[2], 5.0)
+
+
+def _png(path, arr):
+    from PIL import Image
+    Image.fromarray(arr.transpose(1, 2, 0).astype(np.uint8)).save(path)
+
+
+def test_image_data_layer(tmp_path):
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(6):
+        arr = rng.integers(0, 256, size=(3, 10, 12)).astype(np.uint8)
+        p = tmp_path / f"im{i}.png"
+        _png(str(p), arr)
+        paths.append((str(p), i % 3))
+    src = tmp_path / "list.txt"
+    src.write_text("".join(f"{p} {l}\n" for p, l in paths))
+
+    lp = layer("d", "ImageData", [], ["data", "label"],
+               image_data_param={"source": str(src), "batch_size": 3,
+                                 "new_height": 8, "new_width": 8})
+    from sparknet_tpu.ops import get_layer_impl
+    shapes = get_layer_impl("ImageData").out_shapes(lp, [])
+    assert shapes == [(3, 3, 8, 8), (3,)]
+    b = next(image_data_feed(lp, Phase.TEST))
+    assert b["data"].shape == (3, 3, 8, 8)
+    np.testing.assert_array_equal(b["label"], [0.0, 1.0, 2.0])
+
+
+def test_window_data_layer(tmp_path):
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, size=(3, 40, 40)).astype(np.uint8)
+    img_path = tmp_path / "w.png"
+    _png(str(img_path), arr)
+    win = tmp_path / "windows.txt"
+    win.write_text(f"""# 0
+{img_path}
+3 40 40
+3
+1 0.9 5 5 20 20
+2 0.7 10 10 30 30
+0 0.1 0 0 8 8
+""")
+    lp = layer("d", "WindowData", [], ["data", "label"],
+               window_data_param={"source": str(win), "batch_size": 4,
+                                  "fg_fraction": 0.5},
+               transform_param={"crop_size": 12})
+    from sparknet_tpu.ops import get_layer_impl
+    assert get_layer_impl("WindowData").out_shapes(lp, []) == [
+        (4, 3, 12, 12), (4,)]
+    b = next(window_data_feed(lp, Phase.TRAIN))
+    assert b["data"].shape == (4, 3, 12, 12)
+    # fg_fraction=0.5: first 2 samples are foreground (label > 0)
+    assert all(l > 0 for l in b["label"][:2])
+    assert all(l == 0 for l in b["label"][2:])
+
+
+def test_open_db_unknown_backend():
+    with pytest.raises(ValueError, match="unknown DB backend"):
+        open_db("/nonexistent", "ROCKSDB")
+
+
+def test_image_list_tabs_and_wraparound(tmp_path):
+    """Tab-separated list files parse (Caffe reads with >> extraction) and
+    a batch larger than the list wraps mid-batch instead of hanging
+    (image_data_layer.cpp lines_id_ wrap)."""
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(3):
+        arr = rng.integers(0, 256, size=(3, 6, 6)).astype(np.uint8)
+        p = tmp_path / f"t{i}.png"
+        _png(str(p), arr)
+        paths.append((str(p), i))
+    src = tmp_path / "list.txt"
+    src.write_text("".join(f"{p}\t{l}\n" for p, l in paths))
+    lp = layer("d", "ImageData", [], ["data", "label"],
+               image_data_param={"source": str(src), "batch_size": 5})
+    b = next(image_data_feed(lp, Phase.TEST))
+    assert b["data"].shape == (5, 3, 6, 6)
+    np.testing.assert_array_equal(b["label"], [0, 1, 2, 0, 1])
+
+
+def test_window_context_scale(tmp_path):
+    """context_pad expands multiplicatively by crop/(crop-2*pad) and pastes
+    the warped clip at the pad offset into a zeroed buffer
+    (window_data_layer.cpp:300-420)."""
+    from sparknet_tpu.data.db import _crop_warp_window
+    img = np.ones((3, 100, 100), np.float32) * 50
+    # interior window, no clipping: output fully covered, border = context
+    out = _crop_warp_window(img, 40, 40, 59, 59, crop=20, context_pad=2,
+                            use_square=False, do_mirror=False, mean=None,
+                            scale=1.0)
+    assert out.shape == (3, 20, 20)
+    np.testing.assert_allclose(out, 50.0)  # all from the image
+
+    # window at the very corner: expansion clips, padding stays zero
+    out2 = _crop_warp_window(img, 0, 0, 19, 19, crop=20, context_pad=4,
+                             use_square=False, do_mirror=False, mean=None,
+                             scale=1.0)
+    assert out2.shape == (3, 20, 20)
+    assert np.all(out2[:, 0, 0] == 0.0)      # out-of-image context zeroed
+    assert np.all(out2[:, 19, 19] == 50.0)   # in-image part present

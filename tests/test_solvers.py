@@ -113,3 +113,30 @@ def test_lr_mult_freezes_param():
     p1, _ = rule.apply(params, grads, state, 0.1, 0, lr_mults=lr_mults)
     np.testing.assert_allclose(np.asarray(p1["a"][0]), [1.0, 1.0])
     np.testing.assert_allclose(np.asarray(p1["b"][0]), [0.8, 0.8], rtol=1e-6)
+
+
+def test_debug_info_logging(capsys):
+    """sp.debug_info produces per-blob forward asums and per-param update
+    dumps (net.cpp:711-735 ForwardDebugInfo/UpdateDebugInfo analog)."""
+    import numpy as np
+
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+
+    sp = load_solver_prototxt_with_net(
+        "base_lr: 0.01\ndebug_info: true\n", lenet(2, 2))
+    solver = Solver(sp, seed=0)
+    rng = np.random.default_rng(0)
+
+    def feed():
+        while True:
+            yield {"data": rng.normal(size=(2, 1, 28, 28)).astype(np.float32),
+                   "label": rng.integers(0, 10, size=(2,)).astype(np.float32)}
+
+    solver.set_train_data(feed())
+    solver.step(1)
+    out = capsys.readouterr().out
+    assert "[Forward] Layer conv1, top blob conv1 data:" in out
+    assert "[Update] Layer conv1, param 0 data:" in out
+    assert "diff:" in out

@@ -1,0 +1,145 @@
+"""Dataset/CLI tool tests: convert_imageset -> compute_image_mean ->
+caffe_cli train/test -> extract_features over a tiny generated dataset —
+the analog of exercising caffe/tools/*.cpp end to end."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.db import datum_to_array, open_db
+from sparknet_tpu.proto.caffemodel import load_mean_binaryproto
+from sparknet_tpu.tools import (
+    caffe_cli,
+    compute_image_mean,
+    convert_imageset,
+    extract_features,
+)
+
+
+@pytest.fixture(scope="module")
+def image_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imgs")
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(12):
+        arr = rng.integers(0, 256, size=(10, 10, 3)).astype(np.uint8)
+        name = f"im{i}.png"
+        Image.fromarray(arr).save(str(root / name))
+        lines.append(f"{name} {i % 3}")
+    listfile = root / "list.txt"
+    listfile.write_text("".join(l + "\n" for l in lines))
+    return root, listfile
+
+
+def test_convert_imageset_and_mean(image_dataset, tmp_path):
+    root, listfile = image_dataset
+    db = str(tmp_path / "db_lmdb")
+    rc = convert_imageset.main([str(root), str(listfile), db,
+                                "--resize_height", "8",
+                                "--resize_width", "8"])
+    assert rc == 0
+    with open_db(db, "LMDB") as r:
+        assert len(r) == 12
+        _k, v = r.first()
+        img, label = datum_to_array(v)
+        assert img.shape == (3, 8, 8)
+        assert label == 0
+
+    mean_file = str(tmp_path / "mean.binaryproto")
+    assert compute_image_mean.main([db, mean_file]) == 0
+    mean = load_mean_binaryproto(mean_file)
+    assert mean.shape == (3, 8, 8)
+    assert 64 < mean.mean() < 192  # uniform-random pixels
+
+
+def test_convert_imageset_leveldb(image_dataset, tmp_path):
+    root, listfile = image_dataset
+    db = str(tmp_path / "db_ldb")
+    rc = convert_imageset.main([str(root), str(listfile), db,
+                                "--backend", "leveldb",
+                                "--resize_height", "8",
+                                "--resize_width", "8", "--gray"])
+    assert rc == 0
+    with open_db(db, "LEVELDB") as r:
+        assert len(r) == 12
+        img, _ = datum_to_array(r.first()[1])
+        assert img.shape == (1, 8, 8)
+
+
+@pytest.fixture()
+def db_net(image_dataset, tmp_path):
+    root, listfile = image_dataset
+    db = str(tmp_path / "train_lmdb")
+    convert_imageset.main([str(root), str(listfile), db,
+                           "--resize_height", "8", "--resize_width", "8"])
+    model = tmp_path / "net.prototxt"
+    model.write_text(f"""
+name: "toolnet"
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+        data_param {{ source: "{db}" batch_size: 4 backend: LMDB }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param {{ num_output: 3
+                              weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+        top: "loss" include {{ phase: TRAIN }} }}
+layer {{ name: "acc" type: "Accuracy" bottom: "ip" bottom: "label"
+        top: "acc" include {{ phase: TEST }} }}
+""")
+    return tmp_path, model
+
+
+def test_caffe_cli_train_and_test(db_net, capsys):
+    tmp_path, model = db_net
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{model}"
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 6
+test_iter: 2
+test_interval: 3
+snapshot_prefix: "{tmp_path / 'snap'}"
+snapshot: 1
+""")
+    assert caffe_cli.main(["train", "--solver", str(solver)]) == 0
+    out = capsys.readouterr().out
+    assert "Iteration 6" in out and "Optimization Done." in out
+    model_file = str(tmp_path / "snap_iter_6.caffemodel")
+    assert os.path.exists(model_file)
+
+    assert caffe_cli.main(["test", "--model", str(model),
+                           "--weights", model_file,
+                           "--iterations", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "acc =" in out
+
+
+def test_extract_features(db_net, tmp_path, capsys):
+    tpath, model = db_net
+    solver = tpath / "solver.prototxt"
+    solver.write_text(f"""
+net: "{model}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 2
+snapshot_prefix: "{tpath / 'ef'}"
+snapshot: 1
+""")
+    caffe_cli.main(["train", "--solver", str(solver)])
+    weights = str(tpath / "ef_iter_2.caffemodel")
+    feat_db = str(tmp_path / "feat_lmdb")
+    rc = extract_features.main([weights, str(model), "ip", feat_db, "2"])
+    assert rc == 0
+    with open_db(feat_db, "LMDB") as r:
+        assert len(r) == 8  # 2 batches x 4
+        img, _ = datum_to_array(r.first()[1])
+        assert img.shape == (3, 1, 1)
+
+
+def test_device_query(capsys):
+    assert caffe_cli.main(["device_query"]) == 0
+    assert "Device kind" in capsys.readouterr().out
