@@ -166,7 +166,7 @@ def db_feed(lp, phase: Phase, tops: list[str] | None = None,
     source = str(p.get("source"))
     batch = int(p.get("batch_size", 1))
     backend = p.get("backend", "LEVELDB")
-    reader = open_db(source, _backend_name(backend))
+    reader = open_db(source, str(backend))
     tf = DataTransformer(lp.sub("transform_param"), phase, seed)
     tops = tops or list(lp.top) or ["data", "label"]
     cursor = _cycle_items(reader)
@@ -358,11 +358,6 @@ def feed_for_net(net_param, phase: Phase, seed: int = 0):
 # ---------------------------------------------------------------------------
 # Helpers
 # ---------------------------------------------------------------------------
-
-def _backend_name(value: Any) -> str:
-    s = str(value).upper()
-    return {"0": "LEVELDB", "1": "LMDB"}.get(s, s)
-
 
 def _pack(tops, imgs, labels) -> dict[str, np.ndarray]:
     out = {tops[0]: np.stack(imgs).astype(np.float32)}

@@ -80,26 +80,120 @@ def decode_and_resize(pairs: Iterator[tuple[bytes, int]], size: int = 256,
             yield img, label
 
 
-def load_imagenet(tar_root: str, label_file: str, num_partitions: int,
-                  size: int = 256, prefix: str = "") -> PartitionedDataset:
-    """Full chain: tars → (bytes, label) → decoded images, sharded into
-    partitions (ImageNetLoader.apply + ScaleAndConvert.makeMinibatchRDD's
-    decode half, reference: ImageNetLoader.scala:91)."""
+class LazyTarPartition:
+    """A partition of (image, label) records decoded on access.
+
+    Holds only an *index* — (tar key, byte offset, byte size, label) per
+    record — so resident memory is O(records · ~100 bytes), not
+    O(records · decoded image).  Slicing decodes just the touched window,
+    which is exactly RoundFeed's contiguous-run access pattern; undecodable
+    entries get drop-accounted per ScaleAndConvert semantics (replaced by
+    the partition's first decodable image so batch shapes stay static,
+    with the drop counted in ``dropped``)."""
+
+    def __init__(self, entries: list[tuple[str, int, int, int]],
+                 store, size: int):
+        self.entries = entries
+        self.store = store
+        self.size = size
+        self.decoded_count = 0     # observability + laziness tests
+        self.dropped = 0
+        self._fallback: tuple[np.ndarray, int] | None = None
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def _get_fallback(self) -> tuple[np.ndarray, int]:
+        """First decodable record of the partition (image AND its label —
+        substituting pixels under a corrupt record's label would inject
+        label noise)."""
+        if self._fallback is None:
+            for key, off, nbytes, label in self.entries:
+                raw = self.store.open_range(key, off, nbytes)
+                img = native.decode_jpeg_resize(raw, self.size, self.size)
+                if img is not None:
+                    self._fallback = (img, label)
+                    break
+            else:
+                raise RuntimeError(
+                    "no image in this partition decodes — the JPEG decode "
+                    "layer (native libjpeg / PIL fallback) is unavailable "
+                    "or broken, not the data")
+        return self._fallback
+
+    def _decode(self, entry) -> tuple[np.ndarray, int]:
+        key, off, nbytes, label = entry
+        raw = self.store.open_range(key, off, nbytes)
+        self.decoded_count += 1
+        img = native.decode_jpeg_resize(raw, self.size, self.size)
+        if img is None:
+            self.dropped += 1
+            return self._get_fallback()
+        if self._fallback is None:
+            self._fallback = (img, label)
+        return img, label
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self._decode(e) for e in self.entries[idx]]
+        return self._decode(self.entries[idx])
+
+    def __iter__(self):
+        for e in self.entries:
+            yield self._decode(e)
+
+
+def index_tars(source: str, label_file: str, prefix: str = "",
+               store=None) -> list[tuple[str, int, int, int]]:
+    """One sequential pass over the tar headers building the lazy record
+    index (no image bytes are read).  ``source`` may be a local dir,
+    file:// URL, or s3://, gs:// (reference: ImageNetLoader.scala:25-54).
+    Pass ``store`` to reuse an already-constructed client."""
+    if store is None:
+        from .objectstore import get_store
+        store, key_prefix = get_store(source)
+    else:
+        key_prefix = ""
     labels = read_label_map(label_file)
-    items = []
-    total = 0
-    for tar in list_tars(tar_root, prefix):
-        for pair in stream_tar_images(tar, labels):
-            total += 1
-            for decoded in decode_and_resize(iter([pair]), size):
-                items.append(decoded)
-    if total and not items:
-        raise RuntimeError(
-            f"all {total} images failed to decode — the JPEG decode layer "
-            f"(native libjpeg / PIL fallback) is unavailable or broken, "
-            f"not the data")
-    if not total:
+    entries: list[tuple[str, int, int, int]] = []
+    for key in store.list_keys(key_prefix or prefix):
+        if not key.endswith(".tar"):
+            continue
+        with store.open(key) as f:
+            with tarfile.open(fileobj=f, mode="r|") as tf:  # streaming
+                for member in tf:
+                    if not member.isfile():
+                        continue
+                    name = os.path.basename(member.name)
+                    if name not in labels:
+                        continue
+                    entries.append((key, member.offset_data, member.size,
+                                    labels[name]))
+    if not entries:
         raise FileNotFoundError(
-            f"no labeled images found under {tar_root!r} "
+            f"no labeled images found under {source!r} "
             f"(labels: {len(labels)} entries)")
-    return PartitionedDataset.from_items(items, num_partitions, shuffle=True)
+    return entries
+
+
+def load_imagenet(tar_root: str, label_file: str, num_partitions: int,
+                  size: int = 256, prefix: str = "", seed: int = 0,
+                  ) -> PartitionedDataset:
+    """Full chain: tars → record index → lazily-decoded partitions
+    (ImageNetLoader.apply, reference: ImageNetLoader.scala:91; decode on
+    access replaces the up-front ScaleAndConvert map, bounding RSS to the
+    touched slices instead of the whole dataset)."""
+    from .objectstore import get_store
+    store, key_prefix = get_store(tar_root)
+    entries = index_tars(tar_root, label_file, key_prefix or prefix,
+                         store=store)
+    rng = np.random.default_rng(seed)
+    rng.shuffle(entries)
+    parts = []
+    n = max(1, num_partitions)
+    per = len(entries) // n
+    for w in range(n):
+        lo = w * per
+        hi = lo + per if w < n - 1 else len(entries)
+        parts.append(LazyTarPartition(entries[lo:hi], store, size))
+    return PartitionedDataset(parts)

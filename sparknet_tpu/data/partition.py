@@ -17,10 +17,18 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 
 
 class PartitionedDataset:
-    """An ordered list of partitions, each a list of records."""
+    """An ordered list of partitions, each a sequence of records.
 
-    def __init__(self, partitions: Sequence[list[Any]]):
-        self.partitions = [list(p) for p in partitions]
+    Partitions may be plain lists or lazy sequences (e.g.
+    ``imagenet.LazyTarPartition``, which decodes records on slice access);
+    anything supporting ``__len__``/``__getitem__`` is kept as-is so lazy
+    partitions are never materialized here."""
+
+    def __init__(self, partitions: Sequence[Any]):
+        self.partitions = [
+            p if hasattr(p, "__len__") and hasattr(p, "__getitem__")
+            else list(p)
+            for p in partitions]
 
     @classmethod
     def from_items(cls, items: Iterable[Any], num_partitions: int,

@@ -98,14 +98,13 @@ class DataLayer(InputLikeLayer):
     liblmdb/libleveldb dependency)."""
 
     def out_shapes(self, lp: LayerParameter, bottom_shapes: Sequence[Shape]) -> list[Shape]:
-        from ..data.db import datum_to_array, open_db, _backend_name
+        from ..data.db import datum_to_array, open_db
         p = lp.sub("data_param")
         source = p.get("source")
         if source is None:
             raise ValueError(f"Data layer {lp.name!r} missing source")
         batch = int(p.get("batch_size", 1))
-        reader = open_db(str(source),
-                         _backend_name(p.get("backend", "LEVELDB")))
+        reader = open_db(str(source), str(p.get("backend", "LEVELDB")))
         try:
             _key, val = reader.first()
             img, _label = datum_to_array(val)

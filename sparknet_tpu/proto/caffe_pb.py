@@ -16,7 +16,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import math
-from typing import Any
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -657,6 +657,28 @@ def load_solver_prototxt_with_net(
     else:
         sp.snapshot_prefix = snapshot_prefix
     return sp
+
+
+def resolve_net_path(sp: "SolverParameter", solver_path: str,
+                     extra_bases: Sequence[str] = ()) -> str:
+    """Resolve a solver's ``net:``/``train_net:`` file reference.  Caffe
+    resolves relative to the process cwd (zoo solvers use paths like
+    examples/cifar10/...); we additionally probe the solver's own
+    directory, its basename there, and any ``extra_bases``."""
+    import os
+    net_ref = sp.net or sp.train_net
+    if net_ref is None:
+        raise FileNotFoundError("solver has no net:/train_net: reference")
+    bases = ["", os.path.dirname(os.path.abspath(solver_path)) or "."]
+    bases.extend(extra_bases)
+    for base in bases:
+        for cand in (os.path.join(base, net_ref) if base else net_ref,
+                     os.path.join(base, os.path.basename(net_ref))
+                     if base else net_ref):
+            if os.path.exists(cand):
+                return cand
+    raise FileNotFoundError(f"cannot resolve net path {net_ref!r} "
+                            f"(searched {bases})")
 
 
 def replace_data_layers(

@@ -51,13 +51,15 @@ def _train(args) -> int:
     interval = sp.test_interval if (sp.test_interval and test_feed_factory) \
         else 0
     test_iter = sp.test_iter[0] if sp.test_iter else 50
-    it = 0
+    # resume counts from the restored iteration and stops at max_iter total
+    # (caffe.cpp: Solve() returns immediately when iter_ >= max_iter)
+    it = solver.iter
     while it < max_iter:
         n = min(interval, max_iter - it) if interval else max_iter - it
         loss = solver.step(n)
         it += n
         print(f"Iteration {it}, loss = {loss:.6f}")
-        if interval and it < max_iter:
+        if interval:  # includes the final pass (Solver::Solve TestAll)
             scores = solver.test(test_iter)
             for k, v in scores.items():
                 print(f"    Test net output: {k} = {v / test_iter:.6f}")
@@ -128,26 +130,15 @@ def _device_query(args) -> int:
 
 
 def _resolve_solver_net(sp, solver_path: str) -> None:
-    """Load the solver's net:/train_net: reference into net_param, resolving
-    the path like the reference does (relative to the caffe root / cwd)."""
-    import os
-
+    """Load the solver's net:/train_net: reference into net_param."""
     from ..proto import load_net_prototxt
+    from ..proto.caffe_pb import resolve_net_path
     if sp.net_param or sp.train_net_param:
         return
-    ref = sp.net or sp.train_net
-    if ref is None:
-        raise SystemExit("solver has no net")
-    for base in ("", os.path.dirname(os.path.abspath(solver_path))):
-        cand = os.path.join(base, ref) if base else ref
-        if os.path.exists(cand):
-            sp.net_param = load_net_prototxt(cand)
-            return
-        cand = os.path.join(base, os.path.basename(ref)) if base else ref
-        if os.path.exists(cand):
-            sp.net_param = load_net_prototxt(cand)
-            return
-    raise SystemExit(f"cannot resolve net path {ref!r}")
+    try:
+        sp.net_param = load_net_prototxt(resolve_net_path(sp, solver_path))
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
 
 
 def main(argv=None) -> int:

@@ -38,21 +38,14 @@ DEFAULT_SOLVER = os.path.join(
 
 
 def _resolve_net_path(sp, solver_path: str) -> str:
-    """Caffe resolves the solver's ``net:`` path relative to the caffe
-    root (paths in zoo solvers look like examples/cifar10/...)."""
-    net_ref = sp.net or sp.train_net
-    if net_ref is None:
-        raise SystemExit("solver has no net: reference")
-    for base in (os.path.dirname(os.path.abspath(solver_path)) or ".",
-                 REFERENCE_CAFFE, "."):
-        cand = os.path.join(base, net_ref)
-        if os.path.exists(cand):
-            return cand
-        # solver dir + basename (solver and net usually sit together)
-        cand = os.path.join(base, os.path.basename(net_ref))
-        if os.path.exists(cand):
-            return cand
-    raise SystemExit(f"cannot resolve net path {net_ref!r}")
+    """Shared resolver, additionally probing the reference caffe root
+    (zoo solvers reference nets as examples/cifar10/...)."""
+    from ..proto.caffe_pb import resolve_net_path
+    try:
+        return resolve_net_path(sp, solver_path,
+                                extra_bases=(REFERENCE_CAFFE,))
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
 
 
 def _data_batch_sizes(net) -> tuple[int, int]:
