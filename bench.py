@@ -17,7 +17,8 @@ line instead of a stack trace.  A persistent XLA compilation cache makes
 retried attempts cheap.
 
 Env knobs (for smoke-testing): BENCH_PLATFORM=cpu, BENCH_MODEL=lenet,
-BENCH_BATCH, BENCH_ITERS, BENCH_REPS, BENCH_TIMEOUT_S, BENCH_ATTEMPTS.
+BENCH_BATCH, BENCH_ITERS, BENCH_REPS, BENCH_TIMEOUT_S, BENCH_ATTEMPTS,
+BENCH_DTYPE=bf16 (mixed-precision compute — params/loss stay f32).
 """
 
 from __future__ import annotations
@@ -73,19 +74,29 @@ def run_child() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from sparknet_tpu.models import caffenet, lenet
+    from sparknet_tpu.models import caffenet, googlenet, lenet, vgg16
     from sparknet_tpu.proto import load_solver_prototxt_with_net
     from sparknet_tpu.solvers import Solver
 
+    # baselines for the extra models: GoogLeNet K40+cuDNN fwd+bwd avg
+    # 1123.8 ms @ batch 128 (caffe/models/bvlc_googlenet/readme.md:24-27)
     if MODEL == "lenet":
         net, in_shape, classes = lenet(BATCH, BATCH), (1, 28, 28), 10
+    elif MODEL == "googlenet":
+        net, in_shape, classes = (googlenet(BATCH, BATCH, crop=224),
+                                  (3, 224, 224), 1000)
+    elif MODEL == "vgg16":
+        net, in_shape, classes = (vgg16(BATCH, BATCH, crop=224),
+                                  (3, 224, 224), 1000)
     else:
         net, in_shape, classes = caffenet(BATCH, BATCH), (3, 227, 227), 1000
 
     sp = load_solver_prototxt_with_net(
         'base_lr: 0.01\nmomentum: 0.9\nweight_decay: 0.0005\n'
         'lr_policy: "step"\ngamma: 0.1\nstepsize: 100000\n', net)
-    solver = Solver(sp, seed=0)
+    dtype = os.environ.get("BENCH_DTYPE")
+    solver = Solver(sp, seed=0,
+                    compute_dtype=jnp.bfloat16 if dtype == "bf16" else None)
 
     rng = np.random.default_rng(0)
     data = jnp.asarray(rng.normal(size=(1, BATCH) + in_shape).astype(np.float32))
@@ -161,6 +172,7 @@ def run_child() -> None:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_step": flops_per_step,
         "device": f"{dev.platform}/{dev.device_kind}",
+        "dtype": dtype or "f32",
         "batch": BATCH,
         "iters_per_block": ITERS,
         "reps": REPS,

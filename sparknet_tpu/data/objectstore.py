@@ -53,7 +53,7 @@ class LocalStore(ObjectStore):
     def list_keys(self, prefix: str = "") -> list[str]:
         out = []
         for dirpath, _, files in os.walk(self.root):
-            for f in sorted(files):
+            for f in files:
                 rel = os.path.relpath(os.path.join(dirpath, f), self.root)
                 if rel.startswith(prefix):
                     out.append(rel)

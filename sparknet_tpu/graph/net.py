@@ -363,8 +363,21 @@ class Net:
         blobs, _, _ = self._run(params, inputs, train, rng)
         return blobs
 
+    def _cast(self, arrs, dtype):
+        """Cast floating arrays for mixed-precision compute; ints (labels,
+        indices) pass through."""
+        return [a.astype(dtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a
+                for a in arrs]
+
     def _run(self, params, inputs, train, rng):
-        """The layer-by-layer forward shared by apply/apply_all."""
+        """The layer-by-layer forward shared by apply/apply_all.
+
+        With ``compute_dtype`` set (bf16 on TPU), params and activations
+        are cast per layer for MXU-rate matmuls while master params, BN
+        state updates, loss layers, and the loss sum stay float32 — the
+        standard mixed-precision recipe (params stay f32; casts are
+        differentiable, so grads flow back in f32)."""
         if train is None:
             train = self.state.phase == Phase.TRAIN
         if rng is None and any(n.impl.needs_rng(n.lp, train) for n in self.nodes):
@@ -376,6 +389,7 @@ class Net:
                 raise ValueError(f"missing input blob {name!r}")
         blobs: dict[str, jax.Array] = dict(inputs)
         new_params = dict(params)
+        cd = self.compute_dtype
         loss = jnp.zeros((), jnp.float32)
         for node in self.nodes:
             if getattr(node.impl, "is_input", lambda: False)():
@@ -385,8 +399,17 @@ class Net:
                 rng, layer_rng = jax.random.split(rng)
             p = self.node_params(new_params, node)
             bots = [blobs[b] for b in node.bottoms]
+            stateful = getattr(node.impl, "has_state", False)
+            if cd is not None:
+                if (node.impl.is_loss() or node.lp.type == "Accuracy"
+                        or stateful):
+                    # numerics-critical: losses, accuracy, BN batch stats
+                    bots = self._cast(bots, jnp.float32)
+                else:
+                    bots = self._cast(bots, cd)
+                    p = self._cast(p, cd)
             result = node.impl.apply(node.lp, p, bots, train, layer_rng)
-            if getattr(node.impl, "has_state", False):
+            if stateful:
                 tops, updated = result
                 self._scatter_node_params(new_params, node, updated)
             else:

@@ -134,6 +134,20 @@ class NetStateRule:
             not_stage=[str(s) for s in m.get_all("not_stage")],
         )
 
+    def to_pmsg(self) -> PMessage:
+        m = PMessage()
+        if self.phase is not None:
+            m.add("phase", self.phase.name)
+        if self.min_level is not None:
+            m.add("min_level", int(self.min_level))
+        if self.max_level is not None:
+            m.add("max_level", int(self.max_level))
+        for s in self.stage:
+            m.add("stage", s)
+        for s in self.not_stage:
+            m.add("not_stage", s)
+        return m
+
     def matches(self, state: "NetState") -> bool:
         """Mirror of Net::StateMeetsRule (reference: caffe/src/caffe/net.cpp:287-329)."""
         if self.phase is not None and self.phase != state.phase:
@@ -166,6 +180,15 @@ class NetState:
             level=int(m.get("level", 0)),
             stage=[str(s) for s in m.get_all("stage")],
         )
+
+    def to_pmsg(self) -> PMessage:
+        m = PMessage()
+        m.add("phase", self.phase.name)
+        if self.level:
+            m.add("level", int(self.level))
+        for s in self.stage:
+            m.add("stage", s)
+        return m
 
 
 @dataclasses.dataclass
@@ -471,6 +494,45 @@ class LayerParameter:
         """Type-specific sub-config, empty message if absent."""
         return self.params.get(key) or PMessage()
 
+    def to_pmsg(self, include_blobs: bool = False) -> PMessage:
+        """Serialize back to a (new-style) layer message — the write half
+        of the prototxt round-trip (upgrade tools, DSL-to-prototxt)."""
+        m = PMessage()
+        if self.name:
+            m.add("name", self.name)
+        if self.type:
+            m.add("type", self.type)
+        for b in self.bottom:
+            m.add("bottom", b)
+        for t in self.top:
+            m.add("top", t)
+        if self.phase is not None:
+            m.add("phase", self.phase.name)
+        for w in self.loss_weight:
+            m.add("loss_weight", float(w))
+        for ps in self.param:
+            pm = PMessage()
+            if ps.name:
+                pm.add("name", ps.name)
+            if ps.raw_lr_mult is not None:
+                pm.add("lr_mult", ps.raw_lr_mult)
+            if ps.raw_decay_mult is not None:
+                pm.add("decay_mult", ps.raw_decay_mult)
+            m.add("param", pm)
+        for r in self.include:
+            m.add("include", r.to_pmsg())
+        for r in self.exclude:
+            m.add("exclude", r.to_pmsg())
+        for p in self.propagate_down:
+            m.add("propagate_down", bool(p))
+        for key, sub in self.params.items():
+            m.add(key, sub)
+        if include_blobs and self.blobs:
+            from .caffemodel import array_to_blob
+            for b in self.blobs:
+                m.add("blobs", array_to_blob(np.asarray(b)))
+        return m
+
     def included_in(self, state: NetState) -> bool:
         """Mirror of Net::FilterNet layer inclusion (reference: net.cpp:256-286):
         no rules -> included; include rules -> any match; exclude -> none match;
@@ -520,6 +582,24 @@ class NetParameter:
             state=NetState.from_pmsg(m.get("state")),
             force_backward=bool(m.get("force_backward", False)),
         )
+
+    def to_pmsg(self, include_blobs: bool = False) -> PMessage:
+        """Serialize to a new-style (V2) net message — always upgraded,
+        exactly like the reference's upgrade_net_proto_* tools emit."""
+        m = PMessage()
+        if self.name:
+            m.add("name", self.name)
+        for i, name in enumerate(self.input):
+            m.add("input", name)
+        for s in self.input_shape:
+            m.add("input_shape", s.to_pmsg())
+        if self.force_backward:
+            m.add("force_backward", True)
+        if self.state != NetState():
+            m.add("state", self.state.to_pmsg())
+        for lp in self.layer:
+            m.add("layer", lp.to_pmsg(include_blobs=include_blobs))
+        return m
 
     def filtered(self, state: NetState) -> "NetParameter":
         """Phase-filtered copy — Net::FilterNet (reference: net.cpp:256)."""

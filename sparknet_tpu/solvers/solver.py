@@ -36,15 +36,17 @@ class Solver:
     """
 
     def __init__(self, sp: SolverParameter, *, seed: int | None = None,
-                 jit: bool = True):
+                 jit: bool = True, compute_dtype=None):
         self.sp = sp
         net_param = sp.net_param or sp.train_net_param
         if net_param is None:
             raise ValueError("SolverParameter carries no net definition")
         if seed is None:
             seed = sp.random_seed if sp.random_seed >= 0 else 0
-        self.train_net = Net(net_param, NetState(Phase.TRAIN))
-        self.test_net = Net(net_param, NetState(Phase.TEST))
+        self.train_net = Net(net_param, NetState(Phase.TRAIN),
+                             compute_dtype=compute_dtype)
+        self.test_net = Net(net_param, NetState(Phase.TEST),
+                            compute_dtype=compute_dtype)
         self.rule = make_update_rule(sp)
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_rng = jax.random.split(self._rng)

@@ -54,6 +54,12 @@ def _train(args) -> int:
     # resume counts from the restored iteration and stops at max_iter total
     # (caffe.cpp: Solve() returns immediately when iter_ >= max_iter)
     it = solver.iter
+    if interval and sp.test_initialization and it == 0:
+        # Solver::Solve tests before the first step (solver.cpp Step
+        # test_initialization path)
+        scores = solver.test(test_iter)
+        for k, v in scores.items():
+            print(f"    Test net output: {k} = {v / test_iter:.6f}")
     while it < max_iter:
         n = min(interval, max_iter - it) if interval else max_iter - it
         loss = solver.step(n)

@@ -143,3 +143,90 @@ snapshot: 1
 def test_device_query(capsys):
     assert caffe_cli.main(["device_query"]) == 0
     assert "Device kind" in capsys.readouterr().out
+
+
+def test_upgrade_net_proto_text(tmp_path):
+    """V0 prototxt -> upgraded V2 prototxt that parses as new-style and
+    builds (upgrade_net_proto_text.cpp analog)."""
+    from sparknet_tpu.tools import upgrade_net_proto
+
+    src = tmp_path / "v0.prototxt"
+    src.write_text("""
+name: "v0"
+input: "data"
+input_dim: 1 input_dim: 1 input_dim: 8 input_dim: 8
+layers { layer { name: "pad" type: "padding" pad: 1 }
+         bottom: "data" top: "p" }
+layers { layer { name: "c" type: "conv" num_output: 2 kernelsize: 3
+                 weight_filler { type: "xavier" } } bottom: "p" top: "c" }
+layers { layer { name: "r" type: "relu" } bottom: "c" top: "c" }
+""")
+    out = tmp_path / "v2.prototxt"
+    assert upgrade_net_proto.main([str(src), str(out)]) == 0
+    text = out.read_text()
+    assert "layers" not in text.replace("layer {", "")  # new-style only
+    assert 'type: "Convolution"' in text
+
+    import jax
+
+    from sparknet_tpu.graph import Net
+    from sparknet_tpu.proto import load_net_prototxt
+    net = Net(load_net_prototxt(str(out)))
+    params = net.init(jax.random.PRNGKey(0))
+    assert params["c"][0].shape == (2, 1, 3, 3)
+    assert net.blob_shapes["c"] == (1, 2, 8, 8)  # pad survived the upgrade
+
+
+def test_upgrade_net_proto_binary(tmp_path):
+    """Binary round-trip preserves weight blobs (upgrade_net_proto_binary)."""
+    from sparknet_tpu.proto.caffemodel import (
+        load_net_binaryproto,
+        save_caffemodel,
+    )
+    from sparknet_tpu.tools import upgrade_net_proto
+
+    src = str(tmp_path / "w.caffemodel")
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    save_caffemodel(src, {"ip": [w]})
+    out = str(tmp_path / "upgraded.caffemodel")
+    assert upgrade_net_proto.main([src, out, "--binary"]) == 0
+    net = load_net_binaryproto(out)
+    by_name = {l.name: l for l in net.layer}
+    np.testing.assert_array_equal(by_name["ip"].blobs[0], w)
+
+
+def test_upgrade_sniffs_named_caffemodel(tmp_path):
+    """A binary NetParameter whose first bytes are the name field
+    (b'\\n...' — printable ASCII) must still be detected as binary."""
+    from sparknet_tpu.proto.caffemodel import (
+        load_net_binaryproto,
+        save_caffemodel,
+    )
+    from sparknet_tpu.tools import upgrade_net_proto
+
+    src = str(tmp_path / "named.caffemodel")
+    w = np.ones((2, 2), np.float32)
+    save_caffemodel(src, {"ip": [w]}, name="CaffeNet")
+    with open(src, "rb") as f:
+        assert f.read(1) == b"\n"  # the sniffing trap: looks like text
+    out = str(tmp_path / "out.caffemodel")
+    assert upgrade_net_proto.main([src, out, "--binary"]) == 0
+    net = load_net_binaryproto(out)
+    assert net.name == "CaffeNet"
+
+
+def test_upgrade_preserves_net_state(tmp_path):
+    from sparknet_tpu.proto import load_net_prototxt
+    from sparknet_tpu.tools import upgrade_net_proto
+
+    src = tmp_path / "s.prototxt"
+    src.write_text("""
+name: "staged"
+state { phase: TEST stage: "deploy" }
+layer { name: "d" type: "Input" top: "x"
+        input_param { shape { dim: 1 dim: 2 } } }
+""")
+    out = tmp_path / "out.prototxt"
+    assert upgrade_net_proto.main([str(src), str(out)]) == 0
+    net = load_net_prototxt(str(out))
+    assert net.state.stage == ["deploy"]
