@@ -29,9 +29,19 @@ import subprocess
 import sys
 import time
 
-BASELINE_IMG_S = 267.0     # K40 + cuDNN train, performance_hardware.md:24
-BASELINE_BLOCK_S = 19.2    # seconds per 20 iter × 256, same row
-BASELINE_EVAL_IMG_S = 50000 / 60.7  # K40 + cuDNN test pass, ":25"
+# Per-model K40+cuDNN baselines:
+#   caffenet: 19.2 s / 20 iter × 256 train, 60.7 s / 50k eval
+#     (caffe/docs/performance_hardware.md:24-25)
+#   googlenet: 1123.8 ms fwd+bwd avg / 562.8 ms fwd @ batch 128
+#     (caffe/models/bvlc_googlenet/readme.md:24-27)
+_BASELINES = {
+    "caffenet": (267.0, 50000 / 60.7, 19.2),
+    "googlenet": (128 / 1.1238, 128 / 0.5628, None),
+}
+# models without a published reference row get null baselines — a wrong
+# multiplier is worse than none
+BASELINE_IMG_S, BASELINE_EVAL_IMG_S, BASELINE_BLOCK_S = _BASELINES.get(
+    os.environ.get("BENCH_MODEL", "caffenet"), (None, None, None))
 
 BATCH = int(os.environ.get("BENCH_BATCH", 256))
 ITERS = int(os.environ.get("BENCH_ITERS", 20))
@@ -164,11 +174,13 @@ def run_child() -> None:
         "metric": f"{MODEL}_train_images_per_sec",
         "value": round(img_s, 1),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 2)
+        if BASELINE_IMG_S else None,
         "block_20x256_s": round(block_s, 3),
         "baseline_block_s": BASELINE_BLOCK_S,
         "eval_images_per_sec": round(eval_img_s, 1),
-        "eval_vs_baseline": round(eval_img_s / BASELINE_EVAL_IMG_S, 2),
+        "eval_vs_baseline": round(eval_img_s / BASELINE_EVAL_IMG_S, 2)
+        if BASELINE_EVAL_IMG_S else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_step": flops_per_step,
         "device": f"{dev.platform}/{dev.device_kind}",

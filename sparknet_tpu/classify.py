@@ -1,0 +1,157 @@
+"""Classifier / Detector — prediction wrappers over a trained net (the
+pycaffe ``Classifier``/``Detector`` analogs; reference:
+caffe/python/caffe/classifier.py, detector.py, and the oversample helper
+in caffe/python/caffe/io.py:340-384).
+
+The reference exposes pycaffe as an alternative binding to the C++ core;
+this framework's core *is* Python, so these are thin layers: load
+prototxt + weights, preprocess (resize → mean subtract → center crop /
+10-crop oversample / R-CNN context-padded window warp), jitted batched
+forward.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def oversample(images: np.ndarray, crop: int) -> np.ndarray:
+    """(N, C, H, W) -> (10N, C, crop, crop): four corners + center, and
+    their mirrors (reference: caffe/python/caffe/io.py:340-384, in NCHW)."""
+    n, c, h, w = images.shape
+    ys = (0, h - crop)
+    xs = (0, w - crop)
+    cy, cx = (h - crop) // 2, (w - crop) // 2
+    wins = [(y, x) for y in ys for x in xs] + [(cy, cx)]
+    crops = np.empty((10 * n, c, crop, crop), images.dtype)
+    for i, (y, x) in enumerate(wins):
+        view = images[:, :, y:y + crop, x:x + crop]
+        crops[i * n:(i + 1) * n] = view
+        crops[(5 + i) * n:(6 + i) * n] = view[:, :, :, ::-1]
+    return crops
+
+
+class Classifier:
+    """Load a deploy prototxt + weights and predict class probabilities.
+
+    ``predict(inputs, oversample=True)`` matches Classifier.predict
+    semantics: inputs are resized to ``image_dims``, then either
+    center-cropped or 10-crop oversampled to the net's input size; crop
+    predictions are averaged per input."""
+
+    def __init__(self, model_file: str, pretrained_file: str | None = None,
+                 image_dims: tuple[int, int] | None = None,
+                 mean: np.ndarray | float | None = None,
+                 input_scale: float | None = None,
+                 raw_scale: float | None = None):
+        import jax
+
+        from .graph import Net
+        from .proto import NetState, Phase, load_net_prototxt
+        from .solvers.solver import Solver
+
+        net_param = load_net_prototxt(model_file)
+        self.net = Net(net_param, NetState(Phase.TEST))
+        params = self.net.init(jax.random.PRNGKey(0))
+        if pretrained_file:
+            loader = Solver.__new__(Solver)  # reuse the weight-loading path
+            loader.params = params
+            loader.train_net = self.net
+            loader.load_weights(pretrained_file)
+            params = loader.params
+        self.params = params
+        self.input_name = next(iter(self.net.input_blobs))
+        in_shape = self.net.input_blobs[self.input_name]
+        self.crop = in_shape[-1]
+        self.channels = in_shape[1]
+        self.image_dims = tuple(image_dims or (self.crop, self.crop))
+        self.mean = mean
+        self.input_scale = input_scale
+        self.raw_scale = raw_scale
+        self._fwd = jax.jit(
+            lambda p, x: self.net.apply(p, {self.input_name: x},
+                                        train=False).blobs)
+
+    def _preprocess(self, img: np.ndarray) -> np.ndarray:
+        """(C,H,W) or (H,W,C)/(H,W) float image -> (C, image_dims) with
+        raw_scale -> mean subtract -> input_scale (Transformer order)."""
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[0] not in (1, 3):
+            arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+        if self.raw_scale is not None:
+            arr = arr * self.raw_scale
+        h, w = self.image_dims
+        if arr.shape[-2:] != (h, w):
+            from .data.db import _warp
+            arr = _warp(arr, h, w)
+        if self.mean is not None:
+            arr = arr - self.mean
+        if self.input_scale is not None:
+            arr = arr * self.input_scale
+        return arr
+
+    def predict(self, inputs: Sequence[np.ndarray],
+                oversample_crops: bool = True) -> np.ndarray:
+        """Class probabilities, (N, classes); oversampled crops averaged
+        per input (classifier.py predict)."""
+        batch = np.stack([self._preprocess(im) for im in inputs])
+        n = len(batch)
+        if oversample_crops:
+            crops = oversample(batch, self.crop)
+        else:
+            y = (batch.shape[2] - self.crop) // 2
+            x = (batch.shape[3] - self.crop) // 2
+            crops = batch[:, :, y:y + self.crop, x:x + self.crop]
+        blobs = self._fwd(self.params, crops)
+        # the prediction top: last single output (deploy nets end in prob)
+        out = np.asarray(blobs[self.net.output_blobs[-1]])
+        out = out.reshape(out.shape[0], -1)
+        if oversample_crops:
+            out = out.reshape(10, n, -1).mean(axis=0)
+        return out
+
+
+class Detector(Classifier):
+    """Windowed (R-CNN style) detection: classify a list of image crops,
+    each extracted with ``context_pad`` surrounding context and warped to
+    the net input (reference: caffe/python/caffe/detector.py
+    detect_windows + the window crop of window_data_layer.cpp)."""
+
+    def __init__(self, model_file: str, pretrained_file: str | None = None,
+                 mean: np.ndarray | float | None = None,
+                 input_scale: float | None = None,
+                 raw_scale: float | None = None,
+                 context_pad: int = 0):
+        super().__init__(model_file, pretrained_file, mean=mean,
+                         input_scale=input_scale, raw_scale=raw_scale)
+        self.context_pad = context_pad
+
+    def detect_windows(self, images_windows: Sequence[tuple[np.ndarray,
+                                                            Sequence]]):
+        """``images_windows``: (image, [(y1, x1, y2, x2), ...]) pairs.
+        Returns a flat list of {'window', 'prediction'} dicts, matching
+        detect_windows' output shape."""
+        from .data.db import _crop_warp_window
+        crops, metas = [], []
+        for image, windows in images_windows:
+            arr = np.asarray(image, np.float32)
+            if arr.ndim == 3 and arr.shape[0] not in (1, 3):
+                arr = arr.transpose(2, 0, 1)
+            if self.raw_scale is not None:
+                arr = arr * self.raw_scale
+            for (y1, x1, y2, x2) in windows:
+                win = _crop_warp_window(
+                    arr, int(x1), int(y1), int(x2), int(y2), self.crop,
+                    self.context_pad, use_square=False, do_mirror=False,
+                    mean=self.mean, scale=self.input_scale or 1.0)
+                crops.append(win)
+                metas.append((y1, x1, y2, x2))
+        blobs = self._fwd(self.params, np.stack(crops))
+        out = np.asarray(blobs[self.net.output_blobs[-1]])
+        out = out.reshape(out.shape[0], -1)
+        return [{"window": w, "prediction": out[i]}
+                for i, w in enumerate(metas)]

@@ -1,0 +1,125 @@
+"""Spark RDD → partition bridge: the data tier the reference builds its
+whole driver loop around (reference: src/main/scala/apps/ImageNetApp.scala
+:89-95 — coalesce(numWorkers) → persist → count → per-partition sizes RDD
+→ zipPartitions task dispatch).
+
+The north star keeps Spark for multi-host data loading/sharding.  This
+bridge is written against the *minimal* RDD protocol the logic needs —
+``getNumPartitions()``, ``coalesce(n)``, ``mapPartitionsWithIndex(f)``,
+``collect()`` — which a live ``pyspark.RDD`` satisfies directly and a
+local fake can satisfy in tests (this rig has no pyspark; the import is
+gated exactly like the s3:// object store).
+
+Topology: on a TPU-VM pod each host process (jax.process_index) owns the
+partitions ``i ≡ process_index (mod nprocs)``; worker-side
+``mapPartitionsWithIndex`` ships each partition's records to its owner
+host, which feeds them to the trainer as a PartitionedDataset — the
+zipPartitions data-locality contract without the JVM."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+from .partition import PartitionedDataset
+
+
+def _require_rdd(rdd: Any) -> None:
+    for attr in ("getNumPartitions", "coalesce", "mapPartitionsWithIndex",
+                 "collect"):
+        if not hasattr(rdd, attr):
+            raise TypeError(
+                f"object {type(rdd).__name__} does not satisfy the RDD "
+                f"protocol (missing {attr}); pass a pyspark RDD or a "
+                "compatible fake")
+
+
+def spark_context(app_name: str = "sparknet_tpu"):
+    """A live SparkContext — requires pyspark on the driver host
+    (gated; reference cluster setup: SETUP.md, ec2/)."""
+    try:
+        from pyspark import SparkConf, SparkContext
+    except ImportError as e:
+        raise ImportError(
+            "the Spark data tier needs pyspark, which is not in this "
+            "build — use PartitionedDataset/load_imagenet for local "
+            "sharding, or install pyspark on the driver host") from e
+    conf = SparkConf().setAppName(app_name)
+    # the reference disables task retry: re-running a side-effectful
+    # training task corrupts state (CifarApp.scala:36)
+    conf.set("spark.task.maxFailures", "1")
+    return SparkContext(conf=conf)
+
+
+class SparkPartitionBridge:
+    """Shard an RDD of records across hosts the way the reference's apps
+    shard across executors."""
+
+    def __init__(self, rdd: Any, num_workers: int,
+                 process_index: int = 0, num_processes: int = 1):
+        _require_rdd(rdd)
+        if num_workers % num_processes:
+            raise ValueError(
+                f"num_workers={num_workers} must divide evenly across "
+                f"{num_processes} host processes")
+        self.rdd = rdd.coalesce(num_workers) \
+            if rdd.getNumPartitions() != num_workers else rdd
+        self.num_workers = num_workers
+        self.process_index = process_index
+        self.num_processes = num_processes
+
+    def partition_sizes(self) -> list[int]:
+        """Per-partition element counts (the trainPartitionSizes RDD,
+        reference: ImageNetApp.scala:94-95)."""
+        pairs = self.rdd.mapPartitionsWithIndex(
+            lambda i, it: [(i, sum(1 for _ in it))]).collect()
+        sizes = [0] * self.num_workers
+        for i, n in pairs:
+            sizes[i] = n
+        return sizes
+
+    def local_partition_indices(self) -> list[int]:
+        """Partitions owned by this host process."""
+        return list(range(self.process_index, self.num_workers,
+                          self.num_processes))
+
+    def to_local_dataset(self,
+                         transform: Callable[[Any], Any] | None = None,
+                         ) -> PartitionedDataset:
+        """Materialize THIS host's partitions as a PartitionedDataset
+        (records optionally mapped by ``transform`` worker-side).  The
+        collect ships only the owned partitions' records."""
+        owned = set(self.local_partition_indices())
+
+        def keep(i: int, it: Iterable[Any]):
+            if i not in owned:
+                return iter(())
+            if transform is None:
+                return ((i, x) for x in it)
+            return ((i, transform(x)) for x in it)
+
+        parts: dict[int, list[Any]] = {i: [] for i in owned}
+        for i, x in self.rdd.mapPartitionsWithIndex(keep).collect():
+            parts[i].append(x)
+        return PartitionedDataset([parts[i] for i in sorted(parts)])
+
+    def compute_mean(self, to_array: Callable[[Any], Any]) -> Any:
+        """Distributed mean image: per-partition pixel sums reduced on the
+        driver (ComputeMean.apply, reference: ComputeMean.scala:8-44)."""
+        import numpy as np
+
+        def partial(i: int, it: Iterable[Any]):
+            acc = None
+            n = 0
+            for rec in it:
+                arr = np.asarray(to_array(rec), np.float64)
+                acc = arr if acc is None else acc + arr
+                n += 1
+            return [(acc, n)] if n else []
+
+        total, count = None, 0
+        for acc, n in self.rdd.mapPartitionsWithIndex(partial).collect():
+            total = acc if total is None else total + acc
+            count += n
+        if not count:
+            raise ValueError("empty RDD")
+        return (total / count).astype(np.float32)

@@ -423,7 +423,9 @@ class Net:
                 weights = [1.0] + [0.0] * (len(node.tops) - 1)
             for w, v in zip(weights, tops):
                 if w:
-                    loss = loss + w * jnp.sum(v)
+                    # f32 accumulation even when the top was computed in a
+                    # reduced compute_dtype (loss_weight on non-loss layers)
+                    loss = loss + w * jnp.sum(v.astype(jnp.float32))
         return blobs, loss, new_params
 
     # -- introspection (FFI-parity helpers; reference: ccaffe.cpp:86-139,

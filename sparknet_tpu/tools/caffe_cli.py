@@ -54,9 +54,10 @@ def _train(args) -> int:
     # resume counts from the restored iteration and stops at max_iter total
     # (caffe.cpp: Solve() returns immediately when iter_ >= max_iter)
     it = solver.iter
-    if interval and sp.test_initialization and it == 0:
-        # Solver::Solve tests before the first step (solver.cpp Step
-        # test_initialization path)
+    # Solver::Step tests before the first step when iter % interval == 0
+    # and (iter > 0 || test_initialization) — covers both a fresh start
+    # with test_initialization and a resume landing on a boundary
+    if interval and it % interval == 0 and (it > 0 or sp.test_initialization):
         scores = solver.test(test_iter)
         for k, v in scores.items():
             print(f"    Test net output: {k} = {v / test_iter:.6f}")

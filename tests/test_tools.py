@@ -230,3 +230,75 @@ layer { name: "d" type: "Input" top: "x"
     assert upgrade_net_proto.main([str(src), str(out)]) == 0
     net = load_net_prototxt(str(out))
     assert net.state.stage == ["deploy"]
+
+
+def test_classifier_predict(tmp_path):
+    """pycaffe Classifier analog: deploy prototxt + caffemodel ->
+    center-crop and 10-crop-averaged predictions."""
+    from sparknet_tpu.classify import Classifier, oversample
+
+    deploy = tmp_path / "deploy.prototxt"
+    deploy.write_text("""
+name: "tinydeploy"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 4
+                              weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+""")
+    clf = Classifier(str(deploy), image_dims=(10, 10))
+    imgs = [np.random.default_rng(i).normal(size=(3, 10, 10)) for i in range(2)]
+    probs = clf.predict(imgs, oversample_crops=True)
+    assert probs.shape == (2, 4)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+    probs_c = clf.predict(imgs, oversample_crops=False)
+    assert probs_c.shape == (2, 4)
+
+    crops = oversample(np.stack([np.asarray(i, np.float32) for i in imgs]), 8)
+    assert crops.shape == (20, 3, 8, 8)
+    # crop 4 is the center crop; crop 9 is its mirror
+    np.testing.assert_allclose(crops[4 * 2], crops[9 * 2][:, :, ::-1])
+
+
+def test_draw_net(tmp_path):
+    from sparknet_tpu.tools import draw_net
+
+    net = tmp_path / "net.prototxt"
+    net.write_text("""
+name: "toy"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+        convolution_param { num_output: 2 kernel_size: 3
+                            weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "conv" top: "conv" }
+""")
+    out = tmp_path / "net.dot"
+    assert draw_net.main([str(net), str(out)]) == 0
+    dot = out.read_text()
+    assert dot.startswith('digraph "toy"')
+    assert '"L_conv"' in dot and '"B_data" -> "L_conv"' in dot
+    assert "kernel 3" in dot
+    assert dot.count("{") == dot.count("}")
+
+
+def test_detector_windows(tmp_path):
+    from sparknet_tpu.classify import Detector
+
+    deploy = tmp_path / "det.prototxt"
+    deploy.write_text("""
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 3
+                              weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+""")
+    det = Detector(str(deploy), context_pad=2)
+    img = np.random.default_rng(0).normal(size=(3, 32, 32)).astype(np.float32)
+    out = det.detect_windows([(img, [(0, 0, 15, 15), (8, 8, 31, 31)])])
+    assert len(out) == 2
+    assert out[0]["window"] == (0, 0, 15, 15)
+    assert out[0]["prediction"].shape == (3,)
+    np.testing.assert_allclose(out[0]["prediction"].sum(), 1.0, rtol=1e-4)
