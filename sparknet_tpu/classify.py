@@ -50,17 +50,13 @@ class Classifier:
 
         from .graph import Net
         from .proto import NetState, Phase, load_net_prototxt
-        from .solvers.solver import Solver
 
         net_param = load_net_prototxt(model_file)
         self.net = Net(net_param, NetState(Phase.TEST))
         params = self.net.init(jax.random.PRNGKey(0))
         if pretrained_file:
-            loader = Solver.__new__(Solver)  # reuse the weight-loading path
-            loader.params = params
-            loader.train_net = self.net
-            loader.load_weights(pretrained_file)
-            params = loader.params
+            from .solvers.solver import load_weights_into
+            params = load_weights_into(self.net, params, pretrained_file)
         self.params = params
         self.input_name = next(iter(self.net.input_blobs))
         in_shape = self.net.input_blobs[self.input_name]
@@ -75,8 +71,10 @@ class Classifier:
                                         train=False).blobs)
 
     def _preprocess(self, img: np.ndarray) -> np.ndarray:
-        """(C,H,W) or (H,W,C)/(H,W) float image -> (C, image_dims) with
-        raw_scale -> mean subtract -> input_scale (Transformer order)."""
+        """(C,H,W) or (H,W,C)/(H,W) float image -> (C, image_dims), with
+        raw_scale applied; mean/input_scale happen per-crop at net-input
+        size (the Transformer is configured with the net blob shape, so a
+        pycaffe-style mean array is crop-sized)."""
         arr = np.asarray(img, np.float32)
         if arr.ndim == 2:
             arr = arr[None]
@@ -88,11 +86,14 @@ class Classifier:
         if arr.shape[-2:] != (h, w):
             from .data.db import _warp
             arr = _warp(arr, h, w)
-        if self.mean is not None:
-            arr = arr - self.mean
-        if self.input_scale is not None:
-            arr = arr * self.input_scale
         return arr
+
+    def _transform_crops(self, crops: np.ndarray) -> np.ndarray:
+        if self.mean is not None:
+            crops = crops - self.mean  # crop-sized / per-channel / scalar
+        if self.input_scale is not None:
+            crops = crops * self.input_scale
+        return crops
 
     def predict(self, inputs: Sequence[np.ndarray],
                 oversample_crops: bool = True) -> np.ndarray:
@@ -106,7 +107,7 @@ class Classifier:
             y = (batch.shape[2] - self.crop) // 2
             x = (batch.shape[3] - self.crop) // 2
             crops = batch[:, :, y:y + self.crop, x:x + self.crop]
-        blobs = self._fwd(self.params, crops)
+        blobs = self._fwd(self.params, self._transform_crops(crops))
         # the prediction top: last single output (deploy nets end in prob)
         out = np.asarray(blobs[self.net.output_blobs[-1]])
         out = out.reshape(out.shape[0], -1)
@@ -139,18 +140,24 @@ class Detector(Classifier):
         crops, metas = [], []
         for image, windows in images_windows:
             arr = np.asarray(image, np.float32)
-            if arr.ndim == 3 and arr.shape[0] not in (1, 3):
+            if arr.ndim == 2:
+                arr = arr[None]
+            elif arr.ndim == 3 and arr.shape[0] not in (1, 3):
                 arr = arr.transpose(2, 0, 1)
             if self.raw_scale is not None:
                 arr = arr * self.raw_scale
             for (y1, x1, y2, x2) in windows:
+                # mean/input_scale applied to the full crop buffer after
+                # warp+paste — a crop-sized mean stays broadcastable even
+                # for border-clipped windows
                 win = _crop_warp_window(
                     arr, int(x1), int(y1), int(x2), int(y2), self.crop,
                     self.context_pad, use_square=False, do_mirror=False,
-                    mean=self.mean, scale=self.input_scale or 1.0)
+                    mean=None, scale=1.0)
                 crops.append(win)
                 metas.append((y1, x1, y2, x2))
-        blobs = self._fwd(self.params, np.stack(crops))
+        blobs = self._fwd(self.params,
+                          self._transform_crops(np.stack(crops)))
         out = np.asarray(blobs[self.net.output_blobs[-1]])
         out = out.reshape(out.shape[0], -1)
         return [{"window": w, "prediction": out[i]}

@@ -61,8 +61,18 @@ class SparkPartitionBridge:
             raise ValueError(
                 f"num_workers={num_workers} must divide evenly across "
                 f"{num_processes} host processes")
-        self.rdd = rdd.coalesce(num_workers) \
-            if rdd.getNumPartitions() != num_workers else rdd
+        n = rdd.getNumPartitions()
+        if n < num_workers and hasattr(rdd, "repartition"):
+            # pyspark coalesce cannot INCREASE partition count without a
+            # shuffle — repartition does
+            rdd = rdd.repartition(num_workers)
+        elif n != num_workers:
+            rdd = rdd.coalesce(num_workers)
+        if rdd.getNumPartitions() != num_workers:
+            raise ValueError(
+                f"could not shard RDD into {num_workers} partitions "
+                f"(got {rdd.getNumPartitions()}); repartition the source")
+        self.rdd = rdd
         self.num_workers = num_workers
         self.process_index = process_index
         self.num_processes = num_processes

@@ -547,6 +547,11 @@ class AccuracyLayer(LayerImpl):
         return 2
 
     def out_shapes(self, lp, bottom_shapes):
+        if len(lp.top) > 1:
+            # second top: per-class accuracy (accuracy_layer.cpp Reshape)
+            axis = _canon_axis(int(lp.sub("accuracy_param").get("axis", 1)),
+                               len(bottom_shapes[0]))
+            return [(), (bottom_shapes[0][axis],)]
         return [()]
 
     def apply(self, lp, params, bottoms, train, rng):
@@ -564,11 +569,20 @@ class AccuracyLayer(LayerImpl):
         # resolved optimistically like caffe's (>=) partial sort
         rank = jnp.sum(sc > true_score, axis=-1)
         correct = (rank < top_k).astype(jnp.float32)
-        if ignore is not None:
-            mask = (lab != int(ignore)).astype(jnp.float32)
-            denom = jnp.maximum(jnp.sum(mask), 1.0)
-            return [jnp.sum(correct * mask) / denom]
-        return [jnp.mean(correct)]
+        mask = (lab != int(ignore)).astype(jnp.float32) if ignore is not None \
+            else jnp.ones_like(correct)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        tops = [jnp.sum(correct * mask) / denom if ignore is not None
+                else jnp.mean(correct)]
+        if len(lp.top) > 1:
+            # per-class: correct/count per label value, 0 where the class
+            # never appears (accuracy_layer.cpp nums_buffer_ divide)
+            classes = sc.shape[-1]
+            onehot = (lab[:, :, None] == jnp.arange(classes)) * mask[:, :, None]
+            per_count = jnp.sum(onehot, axis=(0, 1))
+            per_correct = jnp.sum(onehot * correct[:, :, None], axis=(0, 1))
+            tops.append(per_correct / jnp.maximum(per_count, 1.0))
+        return tops
 
 
 @register_layer("Silence")

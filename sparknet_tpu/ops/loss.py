@@ -121,15 +121,31 @@ class HingeLossLayer(LossLayer):
 
 @register_layer("InfogainLoss")
 class InfogainLossLayer(LossLayer):
-    """-Σ_j H[label, j]·log(p_j) / N with an infogain matrix H supplied as a
-    third bottom (infogain_loss_layer.cpp; the file-source variant of H is
-    served by the checkpoint reader instead of a private proto load)."""
+    """-Σ_j H[label, j]·log(p_j) / N with an infogain matrix H supplied
+    either as a third bottom or via ``infogain_loss_param { source }`` —
+    a BlobProto binaryproto file, loaded once at trace time and folded
+    into the graph as a constant (infogain_loss_layer.cpp LayerSetUp)."""
+
+    _H_CACHE: dict = {}
+
+    def _matrix(self, lp, bottoms):
+        if len(bottoms) >= 3:
+            return bottoms[2]
+        source = lp.sub("infogain_loss_param").get("source")
+        if source is None:
+            raise ValueError(
+                "InfogainLoss needs H: a third bottom or "
+                "infogain_loss_param.source (infogain_loss_layer.cpp)")
+        source = str(source)
+        if source not in self._H_CACHE:
+            from ..proto.caffemodel import load_mean_binaryproto
+            self._H_CACHE[source] = load_mean_binaryproto(source)
+        return jnp.asarray(self._H_CACHE[source])
 
     def apply(self, lp, params, bottoms, train, rng):
         probs, labels = bottoms[0], bottoms[1]
-        if len(bottoms) < 3:
-            raise ValueError("InfogainLoss requires H as third bottom")
-        H = bottoms[2].reshape(probs.shape[1], probs.shape[1])
+        H = self._matrix(lp, bottoms).reshape(probs.shape[1],
+                                              probs.shape[1])
         n = probs.shape[0]
         lab = labels.astype(jnp.int32).reshape(n)
         logp = jnp.log(jnp.maximum(probs.reshape(n, -1), _LOG_THRESHOLD))

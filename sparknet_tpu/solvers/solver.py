@@ -25,6 +25,17 @@ from ..proto.caffe_pb import NetParameter, NetState, Phase, SolverParameter
 from .update_rules import make_update_rule
 
 
+def load_weights_into(net, params, path: str):
+    """Weights-only load into an existing (net, params) pair — the
+    Net::CopyTrainedLayersFrom path without constructing a full Solver
+    (used by Classifier/Detector, `caffe test`, extract_features)."""
+    loader = Solver.__new__(Solver)
+    loader.params = params
+    loader.train_net = net
+    loader.load_weights(path)
+    return loader.params
+
+
 class Solver:
     """Owns params + optimizer state and a compiled train step.
 
@@ -112,6 +123,36 @@ class Solver:
                     and self.iter % self.sp.snapshot == 0):
                 self.snapshot_caffe()
         return self.smoothed_loss() if self._smoothed else loss
+
+    def solve(self, max_iter: int | None = None) -> float:
+        """Drive training to ``max_iter`` with the Solver::Solve schedule
+        (reference: solver.cpp:285-330): optional test at start
+        (test_initialization / resume on an interval boundary), periodic
+        test passes every ``test_interval``, a final test pass, and the
+        step-level display/snapshot handled by ``step``.  Returns the
+        final smoothed loss."""
+        sp = self.sp
+        max_iter = max_iter or sp.max_iter or 100
+        interval = sp.test_interval \
+            if (sp.test_interval and self._test_iter_factory) else 0
+        test_iter = sp.test_iter[0] if sp.test_iter else 50
+        if interval and self.iter % interval == 0 and (
+                self.iter > 0 or sp.test_initialization):
+            self._print_test_scores(test_iter)
+        loss = 0.0
+        while self.iter < max_iter:
+            n = (min(interval - self.iter % interval, max_iter - self.iter)
+                 if interval else max_iter - self.iter)
+            loss = self.step(n)
+            print(f"Iteration {self.iter}, loss = {loss:.6f}")
+            if interval:
+                self._print_test_scores(test_iter)
+        print("Optimization Done.")
+        return loss
+
+    def _print_test_scores(self, test_iter: int) -> None:
+        for k, v in self.test(test_iter).items():
+            print(f"    Test net output: {k} = {v / test_iter:.6f}")
 
     def _log_debug_info(self, stacked, params_before, rng) -> None:
         """Per-blob/param mean-|x| dumps behind ``sp.debug_info`` — the

@@ -47,33 +47,10 @@ def _train(args) -> int:
     except ValueError:
         test_feed_factory = None
 
-    max_iter = sp.max_iter or 100
-    interval = sp.test_interval if (sp.test_interval and test_feed_factory) \
-        else 0
-    test_iter = sp.test_iter[0] if sp.test_iter else 50
-    # resume counts from the restored iteration and stops at max_iter total
-    # (caffe.cpp: Solve() returns immediately when iter_ >= max_iter)
-    it = solver.iter
-    # Solver::Step tests before the first step when iter % interval == 0
-    # and (iter > 0 || test_initialization) — covers both a fresh start
-    # with test_initialization and a resume landing on a boundary
-    if interval and it % interval == 0 and (it > 0 or sp.test_initialization):
-        scores = solver.test(test_iter)
-        for k, v in scores.items():
-            print(f"    Test net output: {k} = {v / test_iter:.6f}")
-    while it < max_iter:
-        n = min(interval, max_iter - it) if interval else max_iter - it
-        loss = solver.step(n)
-        it += n
-        print(f"Iteration {it}, loss = {loss:.6f}")
-        if interval:  # includes the final pass (Solver::Solve TestAll)
-            scores = solver.test(test_iter)
-            for k, v in scores.items():
-                print(f"    Test net output: {k} = {v / test_iter:.6f}")
+    solver.solve()
     if sp.snapshot_prefix:
-        model, state = solver.snapshot_caffe()
+        model, _state = solver.snapshot_caffe()
         print(f"Snapshotting to {model}")
-    print("Optimization Done.")
     return 0
 
 
@@ -86,17 +63,13 @@ def _test(args) -> int:
     from ..data.db import feed_for_net
     from ..graph import Net
     from ..proto import NetState, Phase, load_net_prototxt
-    from ..solvers.solver import Solver
+    from ..solvers.solver import load_weights_into
 
     net_param = load_net_prototxt(args.model)
     net = Net(net_param, NetState(Phase.TEST))
     params = net.init(jax.random.PRNGKey(0))
     if args.weights:
-        loader = Solver.__new__(Solver)
-        loader.params = params
-        loader.train_net = net
-        loader.load_weights(args.weights)
-        params = loader.params
+        params = load_weights_into(net, params, args.weights)
     feed = feed_for_net(net_param, Phase.TEST)
     fwd = jax.jit(lambda p, b: net.apply(p, b, train=False).blobs)
     totals: dict[str, float] = collections.defaultdict(float)

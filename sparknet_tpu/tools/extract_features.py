@@ -47,12 +47,8 @@ def main(argv=None) -> int:
     params = net.init(jax.random.PRNGKey(0))
 
     # weights: npz checkpoint or .caffemodel, matching by layer name
-    from ..solvers.solver import Solver
-    loader = Solver.__new__(Solver)  # reuse the loading logic statically
-    loader.params = params
-    loader.train_net = net
-    loader.load_weights(args.weights)
-    params = loader.params
+    from ..solvers.solver import load_weights_into
+    params = load_weights_into(net, params, args.weights)
 
     feed = feed_for_net(net_param, Phase.TEST)
 

@@ -503,3 +503,38 @@ def test_loss_gradients(np_rng):
     impl = get_layer_impl("SoftmaxWithLoss")
     f = lambda x: impl.apply(lp, [], [x, labels], True, None)[0]
     check_grads(f, (x,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
+
+
+def test_infogain_loss_source_file(tmp_path, np_rng):
+    """H supplied via infogain_loss_param.source (a BlobProto file) matches
+    the third-bottom variant (infogain_loss_layer.cpp LayerSetUp)."""
+    from sparknet_tpu.proto.caffemodel import save_mean_binaryproto
+
+    probs = np.abs(np_rng.normal(size=(4, 3))).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    labels = np.array([0, 1, 2, 1], np.float32)
+    H = np.eye(3, dtype=np.float32) * 2.0
+    path = str(tmp_path / "H.binaryproto")
+    save_mean_binaryproto(path, H[None])
+
+    lp3 = layer("l", "InfogainLoss", ["p", "y", "H"], ["loss"])
+    ref = float(apply_op(lp3, [probs, labels, H])[0])
+    lp2 = layer("l", "InfogainLoss", ["p", "y"], ["loss"],
+                infogain_loss_param={"source": path})
+    got = float(apply_op(lp2, [probs, labels])[0])
+    assert got == pytest.approx(ref, rel=1e-5)
+
+
+def test_accuracy_per_class_top(np_rng):
+    scores = np.array([[3.0, 1.0, 0.0],
+                       [0.0, 2.0, 1.0],
+                       [1.0, 0.0, 3.0],
+                       [2.0, 1.0, 0.0]], np.float32)
+    labels = np.array([0, 1, 2, 1], np.float32)  # last one wrong (pred 0)
+    lp = layer("a", "Accuracy", ["s", "y"], ["acc", "per_class"])
+    from sparknet_tpu.ops import get_layer_impl
+    impl = get_layer_impl("Accuracy")
+    assert impl.out_shapes(lp, [(4, 3), (4,)]) == [(), (3,)]
+    acc, per = apply_op(lp, [scores, labels])
+    assert float(acc) == pytest.approx(0.75)
+    np.testing.assert_allclose(np.asarray(per), [1.0, 0.5, 1.0])

@@ -140,3 +140,35 @@ def test_debug_info_logging(capsys):
     assert "[Forward] Layer conv1, top blob conv1 data:" in out
     assert "[Update] Layer conv1, param 0 data:" in out
     assert "diff:" in out
+
+
+def test_solver_solve_schedule(capsys):
+    """Solver.solve: test_initialization pass, interval-aligned test
+    passes, final pass, stop at max_iter (solver.cpp Solve/Step)."""
+    import numpy as np
+
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+
+    sp = load_solver_prototxt_with_net(
+        "base_lr: 0.01\nmax_iter: 4\ntest_interval: 2\ntest_iter: 1\n",
+        lenet(2, 2))
+    solver = Solver(sp, seed=0)
+    rng = np.random.default_rng(0)
+
+    def feed():
+        while True:
+            yield {"data": rng.normal(size=(2, 1, 28, 28)).astype(np.float32),
+                   "label": rng.integers(0, 10, size=(2,)).astype(np.float32)}
+
+    calls = []
+    orig = solver.test
+    solver.set_train_data(feed())
+    solver.set_test_data(lambda: feed())
+    solver.test = lambda n=None: (calls.append(solver.iter), orig(1))[1]
+    solver.solve()
+    assert solver.iter == 4
+    # test at iters 0 (test_initialization), 2, 4 (final)
+    assert calls == [0, 2, 4]
+    assert "Optimization Done." in capsys.readouterr().out

@@ -302,3 +302,31 @@ layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
     assert out[0]["window"] == (0, 0, 15, 15)
     assert out[0]["prediction"].shape == (3,)
     np.testing.assert_allclose(out[0]["prediction"].sum(), 1.0, rtol=1e-4)
+
+
+def test_classifier_crop_sized_mean(tmp_path):
+    """pycaffe-style mean arrays are net-input (crop) sized; subtraction
+    must happen per-crop, not at image_dims (Transformer.set_mean)."""
+    from sparknet_tpu.classify import Classifier, Detector
+
+    deploy = tmp_path / "m.prototxt"
+    deploy.write_text("""
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 2
+                              weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+""")
+    mean = np.ones((3, 8, 8), np.float32) * 7  # crop-sized, pycaffe-style
+    clf = Classifier(str(deploy), image_dims=(12, 12), mean=mean)
+    img = np.random.default_rng(0).normal(size=(3, 12, 12))
+    probs = clf.predict([img], oversample_crops=True)
+    assert probs.shape == (1, 2)
+
+    # detector: crop-sized mean + border-clipped window + grayscale->RGB-ish
+    det = Detector(str(deploy), mean=mean, context_pad=2)
+    gray = np.random.default_rng(1).normal(size=(20, 20))  # 2-D image
+    out = det.detect_windows([(np.tile(gray[None], (3, 1, 1)),
+                               [(0, 0, 10, 10)])])
+    assert out[0]["prediction"].shape == (2,)
