@@ -219,6 +219,27 @@ net_v0 = Net(v0)
 assert net_v0.blob_shapes["c"] == (1, 2, 8, 8)  # pad folded into conv
 print("V0 upgrade ok")
 
+# pallas LRN kernel (opt-in) matches the XLA path through the layer API
+import os as _os
+
+import jax.numpy as jnp
+
+from sparknet_tpu.ops import get_layer_impl as _gli
+from sparknet_tpu.models.dsl import layer as _mk_layer
+
+_lrn_lp = _mk_layer("n", "LRN", ["x"], ["y"],
+                    lrn_param={"local_size": 5, "alpha": 0.01, "beta": 0.75})
+_lx = jnp.asarray(rng.normal(size=(2, 6, 5, 7)).astype(np.float32))
+_ref_y = _gli("LRN").apply(_lrn_lp, [], [_lx], True, None)[0]
+_os.environ["SPARKNET_PALLAS_LRN"] = "1"
+try:
+    _pal_y = _gli("LRN").apply(_lrn_lp, [], [_lx], True, None)[0]
+finally:
+    _os.environ.pop("SPARKNET_PALLAS_LRN")
+np.testing.assert_allclose(np.asarray(_pal_y), np.asarray(_ref_y),
+                           rtol=1e-5, atol=1e-6)
+print("pallas LRN ok")
+
 # streaming ingestion: multi-tar -> lazy index -> bounded decodes
 import io
 import tarfile as tarmod

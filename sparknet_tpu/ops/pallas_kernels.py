@@ -1,0 +1,147 @@
+"""Pallas TPU kernels for ops XLA fuses poorly.
+
+Cross-channel LRN is AlexNet/CaffeNet's one non-matmul hot op (~13% of
+the measured f32 train step: 24.2 -> 21.2 ms/step with LRN stripped, TPU
+v5e batch 256).  XLA lowers it as reduce_window + pow + div in forward
+and a second windowed reduction in backward; these kernels do each pass
+in ONE trip through VMEM with the channel-window sums computed as
+unrolled shifted adds on the VPU, and a custom VJP that saves only
+``scale`` (Caffe's own trick — lrn_layer.cpp stores scale_ for
+CrossMapBackward).
+
+Math (reference: caffe/src/caffe/layers/lrn_layer.cpp):
+  scale(c) = k + alpha/n * sum_{d in window} x(c+d)^2
+  y        = x * scale^-beta
+  dx(c)    = dy(c)*scale(c)^-beta
+             - (2*alpha*beta/n) * x(c) * sum_{d} dy(c+d)*y(c+d)/scale(c+d)
+
+Layout: (N, C, H, W) -> grid over (batch, spatial tiles), block (C, TS)
+so the windowed sum runs along sublanes and the spatial axis rides the
+128-wide lanes.  Runs in interpreter mode off-TPU (tests/CPU rig).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_TS = 512  # spatial tile (lanes); f32 block C×TS stays well under VMEM
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _window_sum(v: jnp.ndarray, pre: int, post: int) -> jnp.ndarray:
+    """Σ over the [-pre, +post] channel window along axis 0, zero-padded
+    — unrolled shifted adds.  Forward uses Caffe's (pre=(n-1)/2, post);
+    the VJP uses the REFLECTED window (post, pre): c' contributes to c's
+    gradient iff c lies in c''s forward window."""
+    c = v.shape[0]
+    padded = jnp.pad(v, ((pre, post), (0, 0)))
+    out = padded[0:c]
+    for d in range(1, pre + post + 1):
+        out = out + padded[d:d + c]
+    return out
+
+
+def _fwd_window(size: int) -> tuple[int, int]:
+    pre = (size - 1) // 2
+    return pre, size - 1 - pre
+
+
+def _lrn_fwd_kernel(x_ref, y_ref, scale_ref, *, size, alpha, beta, k):
+    x = x_ref[:]
+    pre, post = _fwd_window(size)
+    scale = k + (alpha / size) * _window_sum(x * x, pre, post)
+    scale_ref[:] = scale
+    y_ref[:] = x * scale ** -beta
+
+
+def _lrn_infer_kernel(x_ref, y_ref, *, size, alpha, beta, k):
+    """Forward without the scale residual — the primal/inference path
+    (a pallas output cannot be dead-code-eliminated by XLA, so writing
+    scale when nothing consumes it costs a full HBM pass)."""
+    x = x_ref[:]
+    pre, post = _fwd_window(size)
+    scale = k + (alpha / size) * _window_sum(x * x, pre, post)
+    y_ref[:] = x * scale ** -beta
+
+
+def _lrn_bwd_kernel(x_ref, scale_ref, dy_ref, dx_ref, *, size, alpha, beta):
+    x = x_ref[:]
+    scale = scale_ref[:]
+    dy = dy_ref[:]
+    y = x * scale ** -beta
+    pre, post = _fwd_window(size)
+    ratio = _window_sum(dy * y / scale, post, pre)  # reflected window
+    dx_ref[:] = dy * scale ** -beta - (2.0 * alpha * beta / size) * x * ratio
+
+
+def _specs(n, c, s):
+    grid = (n, pl.cdiv(s, _TS))
+    spec = pl.BlockSpec((None, c, _TS), lambda i, j: (i, 0, j))
+    return grid, spec
+
+
+def _fwd_call(x, size, alpha, beta, k):
+    n, c, h, w = x.shape
+    xs = x.reshape(n, c, h * w)
+    grid, spec = _specs(n, c, h * w)
+    y, scale = pl.pallas_call(
+        functools.partial(_lrn_fwd_kernel, size=size, alpha=alpha,
+                          beta=beta, k=k),
+        out_shape=(jax.ShapeDtypeStruct(xs.shape, xs.dtype),
+                   jax.ShapeDtypeStruct(xs.shape, xs.dtype)),
+        grid=grid,
+        in_specs=[spec],
+        out_specs=(spec, spec),
+        interpret=_interpret(),
+    )(xs)
+    return y.reshape(x.shape), scale.reshape(x.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def lrn_across_channels(x, size: int, alpha: float, beta: float, k: float):
+    """Caffe ACROSS_CHANNELS LRN as a fused Pallas kernel."""
+    n, c, h, w = x.shape
+    xs = x.reshape(n, c, h * w)
+    grid, spec = _specs(n, c, h * w)
+    y = pl.pallas_call(
+        functools.partial(_lrn_infer_kernel, size=size, alpha=alpha,
+                          beta=beta, k=k),
+        out_shape=jax.ShapeDtypeStruct(xs.shape, xs.dtype),
+        grid=grid,
+        in_specs=[spec],
+        out_specs=spec,
+        interpret=_interpret(),
+    )(xs)
+    return y.reshape(x.shape)
+
+
+def _lrn_vjp_fwd(x, size, alpha, beta, k):
+    y, scale = _fwd_call(x, size, alpha, beta, k)
+    return y, (x, scale)
+
+
+def _lrn_vjp_bwd(size, alpha, beta, k, res, dy):
+    x, scale = res
+    n, c, h, w = x.shape
+    grid, spec = _specs(n, c, h * w)
+    dx = pl.pallas_call(
+        functools.partial(_lrn_bwd_kernel, size=size, alpha=alpha,
+                          beta=beta),
+        out_shape=jax.ShapeDtypeStruct((n, c, h * w), x.dtype),
+        grid=grid,
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        interpret=_interpret(),
+    )(x.reshape(n, c, h * w), scale.reshape(n, c, h * w),
+      dy.reshape(n, c, h * w))
+    return (dx.reshape(x.shape),)
+
+
+lrn_across_channels.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)

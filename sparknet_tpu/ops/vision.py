@@ -255,7 +255,20 @@ class LRNLayer(LayerImpl):
     """Local response normalization (reference:
     caffe/src/caffe/layers/lrn_layer.cpp): scale = k + (alpha/n)·Σ x² over a
     size-n window, out = x / scale^beta.  ACROSS_CHANNELS windows the channel
-    axis; WITHIN_CHANNEL uses AVE-pooling semantics spatially."""
+    axis; WITHIN_CHANNEL uses AVE-pooling semantics spatially.
+
+    SPARKNET_PALLAS_LRN=1 routes ACROSS_CHANNELS through the fused Pallas
+    kernel (ops/pallas_kernels.py).  Off by default: measured on TPU v5e
+    CaffeNet batch 256, the kernel wins in isolation (23.0 vs 24.2
+    ms/step) but LOSES inside the fully-fused scanned train block
+    (10.6k vs 11.0k img/s) — pallas_call is a fusion barrier, and the
+    surrounding relu/pool elementwise work XLA would have fused into the
+    LRN costs more than the kernel saves."""
+
+    @staticmethod
+    def _use_pallas() -> bool:
+        import os
+        return os.environ.get("SPARKNET_PALLAS_LRN") == "1"
 
     def apply(self, lp, params, bottoms, train, rng):
         p = lp.sub("lrn_param")
@@ -265,6 +278,10 @@ class LRNLayer(LayerImpl):
         k = float(p.get("k", 1.0))
         region = str(p.get("norm_region", "ACROSS_CHANNELS"))
         x = bottoms[0]
+        if (region == "ACROSS_CHANNELS" and x.ndim == 4
+                and x.dtype == jnp.float32 and self._use_pallas()):
+            from .pallas_kernels import lrn_across_channels
+            return [lrn_across_channels(x, size, alpha, beta, k)]
         sq = x * x
         if region == "ACROSS_CHANNELS":
             pre = (size - 1) // 2

@@ -1,0 +1,78 @@
+"""Pallas kernel tests (interpret mode on the CPU rig): the fused LRN
+must match the XLA lowering in forward and VJP, including through the
+LRNLayer dispatch."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from sparknet_tpu.models.dsl import layer
+from sparknet_tpu.ops import get_layer_impl
+from sparknet_tpu.ops.pallas_kernels import lrn_across_channels
+
+SIZE, ALPHA, BETA, K = 5, 1e-2, 0.75, 1.0
+
+
+def _xla_lrn(x, size=SIZE, alpha=ALPHA, beta=BETA, k=K):
+    pre = (size - 1) // 2
+    post = size - 1 - pre
+    ssum = lax.reduce_window(x * x, 0.0, lax.add, (1, size, 1, 1),
+                             (1, 1, 1, 1),
+                             ((0, 0), (pre, post), (0, 0), (0, 0)))
+    return x / (k + (alpha / size) * ssum) ** beta
+
+
+@pytest.fixture
+def x(np_rng):
+    return jnp.asarray(np_rng.normal(size=(2, 6, 5, 7)).astype(np.float32))
+
+
+def test_pallas_lrn_forward(x):
+    y = lrn_across_channels(x, SIZE, ALPHA, BETA, K)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(_xla_lrn(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_lrn_vjp(x):
+    g1 = jax.grad(lambda x: jnp.sum(
+        jnp.sin(lrn_across_channels(x, SIZE, ALPHA, BETA, K))))(x)
+    g2 = jax.grad(lambda x: jnp.sum(jnp.sin(_xla_lrn(x))))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_lrn_odd_window(np_rng):
+    x = jnp.asarray(np_rng.normal(size=(1, 8, 3, 3)).astype(np.float32))
+    y = lrn_across_channels(x, 3, 0.1, 0.5, 2.0)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_xla_lrn(x, 3, 0.1, 0.5, 2.0)),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_layer_pallas_dispatch(x, monkeypatch):
+    """SPARKNET_PALLAS_LRN=1 routes LRNLayer through the kernel (interpret
+    mode here) and matches the default XLA path."""
+    lp = layer("n", "LRN", ["x"], ["y"],
+               lrn_param={"local_size": SIZE, "alpha": ALPHA, "beta": BETA})
+    impl = get_layer_impl("LRN")
+    monkeypatch.setenv("SPARKNET_PALLAS_LRN", "0")
+    ref = impl.apply(lp, [], [x], True, None)[0]
+    monkeypatch.setenv("SPARKNET_PALLAS_LRN", "1")
+    got = impl.apply(lp, [], [x], True, None)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_lrn_even_window_vjp(np_rng):
+    """Even local_size has an asymmetric window — the VJP must use the
+    reflected offsets (regression for the window-reflection bug)."""
+    x = jnp.asarray(np_rng.normal(size=(1, 8, 3, 3)).astype(np.float32))
+    g1 = jax.grad(lambda x: jnp.sum(
+        jnp.sin(lrn_across_channels(x, 4, 0.1, 0.5, 2.0))))(x)
+    g2 = jax.grad(lambda x: jnp.sum(jnp.sin(_xla_lrn(x, 4, 0.1, 0.5, 2.0))))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-5)
