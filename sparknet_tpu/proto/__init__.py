@@ -21,6 +21,7 @@ from .caffe_pb import (
     SolverParameter,
     Phase,
     load_net_prototxt,
+    save_net_prototxt,
     load_solver_prototxt,
     load_solver_prototxt_with_net,
     replace_data_layers,

@@ -20,7 +20,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from .textformat import PMessage, parse, serialize
+from .textformat import EnumToken, PMessage, parse, serialize
 
 
 class Phase(enum.IntEnum):
@@ -137,7 +137,7 @@ class NetStateRule:
     def to_pmsg(self) -> PMessage:
         m = PMessage()
         if self.phase is not None:
-            m.add("phase", self.phase.name)
+            m.add("phase", EnumToken(self.phase.name))
         if self.min_level is not None:
             m.add("min_level", int(self.min_level))
         if self.max_level is not None:
@@ -183,7 +183,7 @@ class NetState:
 
     def to_pmsg(self) -> PMessage:
         m = PMessage()
-        m.add("phase", self.phase.name)
+        m.add("phase", EnumToken(self.phase.name))
         if self.level:
             m.add("level", int(self.level))
         for s in self.stage:
@@ -507,7 +507,7 @@ class LayerParameter:
         for t in self.top:
             m.add("top", t)
         if self.phase is not None:
-            m.add("phase", self.phase.name)
+            m.add("phase", EnumToken(self.phase.name))
         for w in self.loss_weight:
             m.add("loss_weight", float(w))
         for ps in self.param:
@@ -737,6 +737,18 @@ def load_solver_prototxt_with_net(
     else:
         sp.snapshot_prefix = snapshot_prefix
     return sp
+
+
+def save_net_prototxt(net: NetParameter, path_or_none: str | None = None
+                      ) -> str:
+    """Serialize a NetParameter (e.g. a DSL-built model) to prototxt text,
+    optionally writing it to a file — the write half of the ProtoLoader
+    round-trip (net_spec.py's to_proto role)."""
+    text = serialize(net.to_pmsg())
+    if path_or_none:
+        with open(path_or_none, "w") as f:
+            f.write(text)
+    return text
 
 
 def resolve_net_path(sp: "SolverParameter", solver_path: str,

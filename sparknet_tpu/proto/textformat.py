@@ -113,6 +113,16 @@ _INT_RE = re.compile(r"^[+-]?\d+$")
 _FLOAT_RE = re.compile(r"^[+-]?(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?$|^[+-]?(inf|nan)$", re.IGNORECASE)
 
 
+class EnumToken(str):
+    """A bare (unquoted) identifier — an enum value in proto text format.
+    Distinguishing it from quoted strings makes serialization lossless:
+    enums stay bare, every plain string gets quoted (an uppercase layer
+    NAME like "CONV1" must not be written as a bare token real protobuf
+    would reject, nor may a name like "NAN" reparse as a float)."""
+
+    __slots__ = ()
+
+
 def _convert_atom(atom: str) -> Any:
     if _INT_RE.match(atom):
         return int(atom)
@@ -122,7 +132,7 @@ def _convert_atom(atom: str) -> Any:
         return False
     if _FLOAT_RE.match(atom):
         return float(atom)
-    return atom  # enum identifier
+    return EnumToken(atom)  # enum identifier
 
 
 def _unquote(s: str) -> str:
@@ -228,10 +238,9 @@ def _format_scalar(v: Any) -> str:
         return repr(v)
     if isinstance(v, int):
         return str(v)
+    if isinstance(v, EnumToken):
+        return str(v)
     if isinstance(v, str):
-        # heuristically: enum identifiers are bare UPPERCASE tokens
-        if re.fullmatch(r"[A-Z][A-Z0-9_]*", v):
-            return v
         escaped = v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
         return f'"{escaped}"'
     raise TypeError(f"cannot serialize {v!r}")

@@ -32,7 +32,7 @@ import struct
 
 import numpy as np
 
-from .textformat import PMessage
+from .textformat import EnumToken, PMessage
 
 # wire types
 _VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
@@ -609,15 +609,21 @@ def decode(data: bytes | memoryview, msg_type: str) -> PMessage:
             pos += ln
         elif kind.startswith("enum:"):
             table = ENUMS[kind[5:]]
+
+            def _enum(v):
+                # EnumToken keeps binary->text round-trips writing bare
+                # enum identifiers (textformat serialization contract)
+                got = table.get(v)
+                return EnumToken(got) if got is not None else int(v)
             if wire == _LEN:  # packed repeated enum
                 ln, pos = _read_varint(buf, pos)
                 end = pos + ln
                 while pos < end:
                     v, pos = _read_varint(buf, pos)
-                    msg.add(name, table.get(v, int(v)))
+                    msg.add(name, _enum(v))
             else:
                 v, pos = _read_varint(buf, pos)
-                msg.add(name, table.get(v, int(v)))
+                msg.add(name, _enum(v))
         elif kind in ("pfloat32", "pfloat64", "pint64"):
             pos = _decode_packed(buf, pos, wire, kind, msg, name, msg_type)
         elif kind == "float":

@@ -96,16 +96,13 @@ def _time(args) -> int:
 
 
 def _device_query(args) -> int:
-    import jax
-    for d in jax.devices():
-        print(f"Device id:                     {d.id}")
-        print(f"Platform:                      {d.platform}")
-        print(f"Device kind:                   {d.device_kind}")
-        stats = getattr(d, "memory_stats", lambda: None)()
-        if stats:
-            for k in ("bytes_in_use", "bytes_limit"):
-                if k in stats:
-                    print(f"{k + ':':<30} {stats[k]}")
+    from ..utils.profiling import device_memory_summary
+    for row in device_memory_summary():
+        print(f"Device:                        {row['device']}")
+        print(f"Device kind:                   {row['kind']}")
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if row.get(key) is not None:
+                print(f"{key + ':':<30} {row[key]}")
     return 0
 
 

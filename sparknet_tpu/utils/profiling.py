@@ -40,3 +40,26 @@ def server(port: int = 9999) -> Iterator[None]:
         yield
     finally:
         del s
+
+
+def device_memory_summary() -> list[dict]:
+    """Per-device HBM usage (bytes in use / limit / peak) — the
+    observability the reference's SyncedMemory world never exposed; used
+    by `caffe device_query` and available for app logs."""
+    out = []
+    for d in jax.devices():
+        stats = getattr(d, "memory_stats", lambda: None)() or {}
+        out.append({
+            "device": f"{d.platform}:{d.id}",
+            "kind": d.device_kind,
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+        })
+    return out
+
+
+def save_memory_profile(path: str) -> None:
+    """Write a pprof-format device memory profile
+    (jax.profiler.save_device_memory_profile)."""
+    jax.profiler.save_device_memory_profile(path)

@@ -187,3 +187,29 @@ def test_v0_data_transform_field_upgrade():
     assert float(tp.get("scale")) == pytest.approx(0.0039)
     assert int(tp.get("crop_size")) == 8
     assert bool(tp.get("mirror")) is True
+
+
+def test_save_net_prototxt_roundtrip(tmp_path):
+    """DSL model -> prototxt text -> reload builds the same graph (the
+    net_spec.py to_proto role; write half of ProtoLoader)."""
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.proto import save_net_prototxt
+
+    src = lenet(4, 8)
+    path = str(tmp_path / "lenet.prototxt")
+    text = save_net_prototxt(src, path)
+    assert 'type: "Convolution"' in text
+    back = load_net_prototxt(path)
+    assert [l.name for l in back.layer] == [l.name for l in src.layer]
+    assert [l.type for l in back.layer] == [l.type for l in src.layer]
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.graph import Net
+    net = Net(back, NetState(Phase.TRAIN))
+    params = net.init(jax.random.PRNGKey(0))
+    out = net.apply(params, {"data": jnp.zeros((4, 1, 28, 28)),
+                             "label": jnp.zeros((4,))},
+                    rng=jax.random.PRNGKey(1))
+    assert float(out.loss) > 0

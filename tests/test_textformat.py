@@ -74,3 +74,20 @@ def test_parse_errors():
         parse("}")
     with pytest.raises(ParseError):
         parse("key value")
+
+
+def test_serialize_quotes_uppercase_strings():
+    """Quoted strings stay quoted on round-trip even when all-uppercase
+    (a layer named CONV1 or NAN must not serialize as a bare enum token
+    that real protobuf rejects / reparses as a float)."""
+    from sparknet_tpu.proto.textformat import EnumToken, serialize
+
+    m = parse('name: "CONV1" other: "NAN" pool: MAX')
+    text = serialize(m)
+    assert 'name: "CONV1"' in text
+    assert 'other: "NAN"' in text
+    assert "pool: MAX" in text  # real enum stays bare
+    back = parse(text)
+    assert back.get("name") == "CONV1"
+    assert back.get("other") == "NAN"          # NOT float('nan')
+    assert isinstance(back.get("pool"), EnumToken)
