@@ -77,6 +77,11 @@ def get_lib() -> ctypes.CDLL | None:
         lib.sn_decode_jpeg_resize.argtypes = [
             u8p, i64, ctypes.c_int, ctypes.c_int, f32p]
         lib.sn_decode_jpeg_resize.restype = ctypes.c_int
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.sn_parse_datum_batch.argtypes = [
+            u8p, i64p, i64p, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            f32p, i32p]
+        lib.sn_parse_datum_batch.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -182,3 +187,26 @@ def decode_jpeg_resize(data: bytes, out_h: int, out_w: int) -> np.ndarray | None
     if rc != 0:
         return None
     return out
+
+
+def parse_datum_batch(records: list[bytes], c: int, h: int, w: int,
+                      ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Parse serialized Datum protos into (f32 [n,c,h,w], i32 labels) in
+    one native pass (the data_reader + C++ protobuf role of the reference;
+    reference: caffe/src/caffe/data_reader.cpp, protobuf parse in C++).
+    Returns None when unavailable or when the batch has encoded/mismatched
+    records — callers fall back to the per-record Python decoder."""
+    lib = get_lib()
+    if lib is None or not records:
+        return None
+    sizes = np.asarray([len(r) for r in records], np.int64)
+    offsets = np.zeros(len(records), np.int64)
+    np.cumsum(sizes[:-1], out=offsets[1:])
+    buf = np.frombuffer(b"".join(records), np.uint8)
+    out = np.empty((len(records), c, h, w), np.float32)
+    labels = np.empty((len(records),), np.int32)
+    rc = lib.sn_parse_datum_batch(buf, offsets, sizes, len(records),
+                                  c, h, w, out.reshape(-1), labels)
+    if rc != 0:
+        return None
+    return out, labels

@@ -89,6 +89,103 @@ int sn_crop_batch_f32(const float* src, int64_t n, int C, int H, int W,
 }
 
 // ---------------------------------------------------------------------------
+// Batched Datum protobuf parse: n serialized Datum messages (wire format,
+// caffe.proto fields: 1 channels, 2 height, 3 width, 4 data(bytes),
+// 5 label, 6 float_data, 7 encoded) -> one f32 [n, c, h, w] batch +
+// labels.  The native half of the reference's data_reader + C++ protobuf
+// path; returns
+//   0 ok; -1 malformed wire data; -2 shape mismatch vs (c,h,w);
+//   -3 encoded/unsupported payload (caller falls back per-record).
+// ---------------------------------------------------------------------------
+static inline int dat_varint(const uint8_t* p, int64_t len, int64_t* pos,
+                             uint64_t* out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (*pos < len && shift < 64) {
+        uint8_t b = p[(*pos)++];
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) { *out = v; return 0; }
+        shift += 7;
+    }
+    return -1;
+}
+
+int sn_parse_datum_batch(const uint8_t* buf, const int64_t* offsets,
+                         const int64_t* sizes, int64_t n,
+                         int c, int h, int w,
+                         float* out, int32_t* labels) {
+    const int64_t plane = (int64_t)c * h * w;
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* p = buf + offsets[i];
+        const int64_t len = sizes[i];
+        int64_t pos = 0;
+        int64_t ch = -1, hh = -1, ww = -1;
+        const uint8_t* data = nullptr;
+        int64_t dlen = 0;
+        int64_t fcount = 0;
+        bool encoded = false;
+        float* dst = out + i * plane;
+        labels[i] = 0;
+        while (pos < len) {
+            uint64_t key;
+            if (dat_varint(p, len, &pos, &key)) return -1;
+            const int field = (int)(key >> 3);
+            const int wire = (int)(key & 7);
+            if (wire == 0) {
+                uint64_t v;
+                if (dat_varint(p, len, &pos, &v)) return -1;
+                switch (field) {
+                    case 1: ch = (int64_t)v; break;
+                    case 2: hh = (int64_t)v; break;
+                    case 3: ww = (int64_t)v; break;
+                    case 5: labels[i] = (int32_t)v; break;
+                    case 7: encoded = v != 0; break;
+                    default: break;
+                }
+            } else if (wire == 2) {
+                uint64_t ln;
+                if (dat_varint(p, len, &pos, &ln)) return -1;
+                // overflow-safe bound: a huge ln must not wrap pos+ln
+                if ((int64_t)ln < 0 || (int64_t)ln > len - pos) return -1;
+                if (field == 4) {
+                    data = p + pos;
+                    dlen = (int64_t)ln;
+                } else if (field == 6) {  // packed float_data
+                    if (ln % 4) return -1;
+                    int64_t cnt = (int64_t)ln / 4;
+                    if (fcount + cnt > plane) return -2;
+                    memcpy(dst + fcount, p + pos, ln);
+                    fcount += cnt;
+                }
+                pos += (int64_t)ln;
+            } else if (wire == 5) {
+                if (pos + 4 > len) return -1;
+                if (field == 6) {  // unpacked float_data element
+                    if (fcount >= plane) return -2;
+                    memcpy(dst + fcount, p + pos, 4);
+                    ++fcount;
+                }
+                pos += 4;
+            } else if (wire == 1) {
+                if (pos + 8 > len) return -1;
+                pos += 8;
+            } else {
+                return -1;  // groups/unknown wire types unsupported
+            }
+        }
+        if (encoded) return -3;
+        if (ch != c || hh != h || ww != w) return -2;
+        if (data != nullptr) {
+            if (dlen != plane) return -2;
+            for (int64_t j = 0; j < plane; ++j) dst[j] = (float)data[j];
+        } else if (fcount != plane) {
+            return -2;
+        }
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
 // Mean-image accumulation: sum a u8/f32 batch into float64 accumulators
 // (ComputeMean's per-partition pixel sums).
 // ---------------------------------------------------------------------------

@@ -80,3 +80,32 @@ def test_decode_jpeg_resize(np_rng):
 def test_decode_jpeg_garbage_returns_none():
     assert native.decode_jpeg_resize(b"not a jpeg at all", 8, 8) is None
     assert native.decode_jpeg_resize(b"\xff\xd8\xff\xe0truncated", 8, 8) is None
+
+
+def test_parse_datum_batch_matches_python():
+    """Native batched Datum parse == per-record Python decode (u8 and
+    float_data payloads), with clean fallback on mismatched shapes."""
+    import numpy as np
+
+    from sparknet_tpu import native
+    from sparknet_tpu.data.db import array_to_datum, datum_to_array
+
+    if not native.available():
+        import pytest
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(6, 3, 5, 4)).astype(np.uint8)
+    labels = rng.integers(0, 9, size=6)
+    recs = [array_to_datum(imgs[i], int(labels[i])) for i in range(6)]
+    out, labs = native.parse_datum_batch(recs, 3, 5, 4)
+    for i, r in enumerate(recs):
+        ref_img, ref_lab = datum_to_array(r)
+        np.testing.assert_array_equal(out[i], ref_img)
+        assert labs[i] == ref_lab
+
+    f = rng.normal(size=(2, 1, 2, 2)).astype(np.float32)
+    frecs = [array_to_datum(f[i], i) for i in range(2)]
+    fout, _ = native.parse_datum_batch(frecs, 1, 2, 2)
+    np.testing.assert_allclose(fout, f, rtol=1e-6)
+
+    assert native.parse_datum_batch(recs, 3, 9, 9) is None  # shape mismatch
