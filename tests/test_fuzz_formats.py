@@ -1,0 +1,105 @@
+"""Corrupt-input robustness for the binary format parsers: every mangled
+buffer must produce a clean Python exception (or a documented fallback),
+never a crash or a silent garbage parse — the native Datum parser's
+overflow-safe bounds are exercised the same way."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.db import array_to_datum
+from sparknet_tpu.data.leveldb_io import LeveldbError, LeveldbReader, write_leveldb
+from sparknet_tpu.data.lmdb_io import LmdbError, LmdbReader, write_lmdb
+from sparknet_tpu.proto.wireformat import WireError, decode, encode
+from sparknet_tpu.proto.textformat import PMessage
+
+
+def _mutations(data: bytes, rng, n=40):
+    out = []
+    for _ in range(n):
+        b = bytearray(data)
+        kind = rng.integers(0, 3)
+        if kind == 0 and len(b) > 1:          # truncate
+            del b[rng.integers(1, len(b)):]
+        elif kind == 1:                        # flip bytes
+            for _ in range(rng.integers(1, 4)):
+                b[rng.integers(0, len(b))] = rng.integers(0, 256)
+        else:                                  # insert garbage
+            pos = rng.integers(0, len(b))
+            b[pos:pos] = bytes(rng.integers(0, 256, size=5))
+        out.append(bytes(b))
+    return out
+
+
+def test_wireformat_decode_survives_mutations():
+    m = PMessage()
+    m.add("name", "net")
+    sub = PMessage()
+    sub.add("name", "l1")
+    sub.add("type", "ReLU")
+    m.add("layer", sub)
+    data = encode(m, "NetParameter")
+    rng = np.random.default_rng(0)
+    for mut in _mutations(data, rng):
+        try:
+            decode(mut, "NetParameter")
+        except (WireError, ValueError, KeyError):
+            pass  # clean rejection
+
+
+def test_native_datum_parse_survives_mutations():
+    from sparknet_tpu import native
+    if not native.available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 256, size=(3, 6, 6)).astype(np.uint8)
+    rec = array_to_datum(img, 3)
+    for mut in _mutations(rec, rng, n=80):
+        # must return a batch, or None (fallback) — never crash
+        res = native.parse_datum_batch([mut], 3, 6, 6)
+        if res is not None:
+            out, labels = res
+            assert out.shape == (1, 3, 6, 6)
+    # pathological: huge length varint that would overflow pos+ln
+    evil = bytes([0x22, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+                  0x7F]) + b"x"
+    assert native.parse_datum_batch([evil], 3, 6, 6) is None
+
+
+def test_lmdb_reader_survives_mutations(tmp_path):
+    import os
+    items = [(b"%04d" % i, b"v" * 50) for i in range(20)]
+    path = str(tmp_path / "db")
+    write_lmdb(path, items)
+    data = open(os.path.join(path, "data.mdb"), "rb").read()
+    rng = np.random.default_rng(2)
+    for i, mut in enumerate(_mutations(data, rng, n=25)):
+        mpath = str(tmp_path / f"m{i}")
+        os.makedirs(mpath, exist_ok=True)
+        with open(os.path.join(mpath, "data.mdb"), "wb") as f:
+            f.write(mut)
+        try:
+            with LmdbReader(mpath) as r:
+                for _ in r.items():
+                    pass
+        except Exception:
+            # any Python-level exception is a clean rejection; the fuzz
+            # assertion is no hang / no native crash / bounded recursion
+            pass
+
+
+def test_leveldb_reader_survives_mutations(tmp_path):
+    import os
+    items = [(b"%04d" % i, b"v" * 50) for i in range(20)]
+    path = str(tmp_path / "db")
+    write_leveldb(path, items)
+    log = os.path.join(path, "000003.log")
+    data = open(log, "rb").read()
+    rng = np.random.default_rng(3)
+    for mut in _mutations(data, rng, n=25):
+        with open(log, "wb") as f:
+            f.write(mut)
+        try:
+            with LeveldbReader(path) as r:
+                list(r.items())
+        except Exception:
+            pass  # clean Python-level rejection
