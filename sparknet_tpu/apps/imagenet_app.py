@@ -26,7 +26,12 @@ from ..data.imagenet import load_imagenet
 from ..data.partition import PartitionedDataset
 from ..data.transforms import center_crop, random_crop_mirror
 from ..models import alexnet, caffenet, googlenet, vgg16
-from ..parallel import DistributedTrainer, TrainerConfig, make_mesh
+from ..parallel import (
+    DistributedTrainer,
+    TrainerConfig,
+    device_crop_mirror_mean,
+    make_mesh,
+)
 from ..proto import load_solver_prototxt_with_net
 from ..utils.timing import PhaseLogger
 from .common import RoundFeed, eval_feed, run_training
@@ -77,6 +82,10 @@ def main(argv=None) -> dict[str, float]:
     ap.add_argument("--crop", type=int, default=None,
                     help="default 227 (AlexNet-class) / 224 (GoogLeNet, VGG)")
     ap.add_argument("--base-lr", type=float, default=None)
+    ap.add_argument("--device-preprocess", action="store_true",
+                    help="random crop/mirror/mean INSIDE the compiled "
+                         "round (host ships raw full-size images — for "
+                         "hosts whose CPUs can't keep up with the chips)")
     ap.add_argument("--snapshot", default=None)
     ap.add_argument("--log-dir", default=".")
     args = ap.parse_args(argv)
@@ -124,10 +133,15 @@ def main(argv=None) -> dict[str, float]:
     mean = (acc / max(count, 1)).astype(np.float32)
     log.log("computed mean image")
 
-    rng = np.random.default_rng(7)
-    train_pre = functools.partial(random_crop_mirror, crop=crop, rng=rng,
-                                  mean=mean)
     test_pre = functools.partial(center_crop, crop=crop, mean=mean)
+    if args.device_preprocess:
+        train_pre = None  # host ships raw images; crop runs on-device
+        device_pre = device_crop_mirror_mean(crop, mirror=True, mean=mean)
+    else:
+        train_pre = functools.partial(random_crop_mirror, crop=crop,
+                                      rng=np.random.default_rng(7),
+                                      mean=mean)
+        device_pre = None
 
     net = MODELS[args.model](args.batch * workers, args.batch * workers,
                              crop=crop)
@@ -135,12 +149,14 @@ def main(argv=None) -> dict[str, float]:
     if args.base_lr is not None:
         sp.base_lr = args.base_lr
     trainer = DistributedTrainer(
-        sp, mesh, TrainerConfig(strategy=args.strategy, tau=args.tau), seed=0)
+        sp, mesh, TrainerConfig(strategy=args.strategy, tau=args.tau,
+                                device_preprocess=device_pre), seed=0)
     log.log(f"built {args.model} on {workers}-worker mesh "
-            f"({args.strategy}, tau={args.tau}, crop={crop})")
+            f"({args.strategy}, tau={args.tau}, crop={crop}, "
+            f"{'device' if device_pre else 'host'} preprocess)")
 
     feed = RoundFeed(train_ds, args.batch, trainer.batches_per_round,
-                     preprocess=lambda x: train_pre(x), seed=3)
+                     preprocess=train_pre, seed=3)
     test_factory, test_steps = eval_feed(test_ds, args.batch,
                                          preprocess=lambda x: test_pre(x))
     scores = run_training(trainer, feed, test_factory, test_steps,

@@ -1,3 +1,7 @@
 from .mesh import make_mesh, replicated, batch_sharded
-from .trainer import DistributedTrainer, TrainerConfig
+from .trainer import (
+    DistributedTrainer,
+    TrainerConfig,
+    device_crop_mirror_mean,
+)
 from .cluster import init_cluster, is_multi_host, local_batch_slice

@@ -58,6 +58,48 @@ class TrainerConfig:
     strategy: str = "local_sgd"   # "local_sgd" | "sync"
     tau: int = 1                  # steps per round (local steps for local_sgd)
     donate: bool = True
+    # Optional pure-JAX augmentation applied to each micro-batch INSIDE the
+    # compiled round, (micro_batch_dict, rng) -> micro_batch_dict — the
+    # TPU-native fix for host-bound preprocessing (the reference crops on
+    # the host because GPU Caffe did; on TPU the crop is ~free next to the
+    # matmuls, and the host then only ships raw images).  Build one with
+    # ``device_crop_mirror_mean``.
+    device_preprocess: Any | None = None
+
+
+def device_crop_mirror_mean(crop: int, mirror: bool = True,
+                            mean=None, field: str = "data"):
+    """Build a ``TrainerConfig.device_preprocess``: random crop to
+    (crop, crop) + horizontal mirror + mean subtraction, fused into the
+    compiled round.  Caffe-window semantics: a full-size mean is
+    subtracted before cropping (== subtracting at each sample's window,
+    data_transformer.cpp).  The host then ships raw full-size images and
+    does no per-pixel work at all — the TPU-native resolution of the
+    reference's measured feed bottleneck (java_data_layer.cpp:36-44)."""
+    mean_arr = jnp.asarray(mean, jnp.float32) if mean is not None else None
+
+    def pre(micro, rng):
+        data = micro[field]
+        lead = data.shape[:-3]
+        c, h, w = data.shape[-3:]
+        flat = data.reshape((-1, c, h, w)).astype(jnp.float32)
+        if mean_arr is not None:
+            flat = flat - mean_arr
+        n = flat.shape[0]
+        ky, kx, kf = jax.random.split(rng, 3)
+        ys = jax.random.randint(ky, (n,), 0, h - crop + 1)
+        xs = jax.random.randint(kx, (n,), 0, w - crop + 1)
+        flips = (jax.random.bernoulli(kf, 0.5, (n,)) if mirror
+                 else jnp.zeros((n,), bool))
+
+        def one(img, y, x, f):
+            win = lax.dynamic_slice(img, (0, y, x), (c, crop, crop))
+            return jnp.where(f, win[:, :, ::-1], win)
+
+        out = jax.vmap(one)(flat, ys, xs, flips)
+        return {**micro, field: out.reshape(lead + (c, crop, crop))}
+
+    return pre
 
 
 class DistributedTrainer:
@@ -133,12 +175,22 @@ class DistributedTrainer:
             return jax.tree_util.tree_map(
                 lambda x: x.reshape((tau, iter_size) + x.shape[1:]), batches)
 
+        device_pre = self.config.device_preprocess
+
+        def maybe_preprocess(micro, rng):
+            if device_pre is None:
+                return micro
+            return device_pre(micro, rng)
+
         def sync_body(params, state, it, batches, rng):
             """Per-step grad pmean (P2PSync semantics)."""
             def step(carry, micro):
                 params, state, it, rng = carry
-                rng, sub = jax.random.split(rng)
+                rng, sub, pre_rng = jax.random.split(rng, 3)
                 sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+                micro = maybe_preprocess(
+                    micro, jax.random.fold_in(pre_rng,
+                                              lax.axis_index(DATA_AXIS)))
                 loss, params, grads = accum_grads(params, micro, sub)
                 grads = lax.pmean(grads, DATA_AXIS)
                 loss = lax.pmean(loss, DATA_AXIS)
@@ -167,7 +219,8 @@ class DistributedTrainer:
 
             def step(carry, micro):
                 params, state, it, rng = carry
-                rng, sub = jax.random.split(rng)
+                rng, sub, pre_rng = jax.random.split(rng, 3)
+                micro = maybe_preprocess(micro, pre_rng)
                 params, state, loss = local_update(params, state, it, micro, sub)
                 return (params, state, it + 1, rng), loss
 

@@ -274,3 +274,70 @@ def test_sync_state_only_pmean_preserves_replication(np_rng):
         shards = [np.asarray(s.data) for s in blob.addressable_shards]
         for s in shards[1:]:
             np.testing.assert_allclose(shards[0], s, rtol=1e-6)
+
+
+def test_device_preprocess_round(np_rng):
+    """TrainerConfig.device_preprocess crops/mirrors/mean-subtracts inside
+    the compiled round: the net sees crop-sized inputs while the feed
+    ships raw full-size images (the TPU-native feed-bottleneck fix)."""
+    from sparknet_tpu.models.dsl import java_data_layer, layer, net_param
+    from sparknet_tpu.parallel import device_crop_mirror_mean
+
+    crop, full = 6, 8
+    net = net_param("devpre", [
+        java_data_layer("input", ["data", "label"], None,
+                        (8, 1, crop, crop), (8,)),
+        layer("ip", "InnerProduct", ["data"], ["ip"],
+              inner_product_param={"num_output": 4,
+                                   "weight_filler": {"type": "xavier"}}),
+        layer("loss", "SoftmaxWithLoss", ["ip", "label"], ["loss"]),
+    ])
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, net)
+    mean = np_rng.normal(size=(1, full, full)).astype(np.float32)
+    for strategy in ("local_sgd", "sync"):
+        tr = DistributedTrainer(
+            sp, make_mesh(2),
+            TrainerConfig(strategy=strategy, tau=2,
+                          device_preprocess=device_crop_mirror_mean(
+                              crop, mirror=True, mean=mean)), seed=0)
+        x = np_rng.normal(size=(2, 8, 1, full, full)).astype(np.float32)
+        y = np_rng.integers(0, 4, size=(2, 8)).astype(np.float32)
+        loss = tr.train_round({"data": x, "label": y})
+        assert np.isfinite(loss), strategy
+
+
+def test_device_preprocess_deterministic_semantics(np_rng):
+    """With crop == input size and mirror off, the on-device path reduces
+    to exactly the host path's mean subtraction — same round result."""
+    from sparknet_tpu.models.dsl import java_data_layer, layer, net_param
+    from sparknet_tpu.parallel import device_crop_mirror_mean
+
+    size = 6
+    net = net_param("devpre_eq", [
+        java_data_layer("input", ["data", "label"], None,
+                        (8, 1, size, size), (8,)),
+        layer("ip", "InnerProduct", ["data"], ["ip"],
+              inner_product_param={"num_output": 3,
+                                   "weight_filler": {"type": "xavier"}}),
+        layer("loss", "SoftmaxWithLoss", ["ip", "label"], ["loss"]),
+    ])
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, net)
+    mean = np_rng.normal(size=(1, size, size)).astype(np.float32)
+    x = np_rng.normal(size=(2, 8, 1, size, size)).astype(np.float32)
+    y = np_rng.integers(0, 3, size=(2, 8)).astype(np.float32)
+
+    tr_host = DistributedTrainer(
+        sp, make_mesh(2), TrainerConfig(strategy="sync", tau=2), seed=0)
+    loss_host = tr_host.train_round({"data": x - mean, "label": y})
+
+    tr_dev = DistributedTrainer(
+        sp, make_mesh(2),
+        TrainerConfig(strategy="sync", tau=2,
+                      device_preprocess=device_crop_mirror_mean(
+                          size, mirror=False, mean=mean)), seed=0)
+    loss_dev = tr_dev.train_round({"data": x, "label": y})
+    np.testing.assert_allclose(float(loss_host), float(loss_dev), rtol=1e-5)
+    for k in tr_host.params:
+        for a, b in zip(tr_host.params[k], tr_dev.params[k]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
