@@ -77,13 +77,23 @@ def device_crop_mirror_mean(crop: int, mirror: bool = True,
     does no per-pixel work at all — the TPU-native resolution of the
     reference's measured feed bottleneck (java_data_layer.cpp:36-44)."""
     mean_arr = jnp.asarray(mean, jnp.float32) if mean is not None else None
+    # a crop-sized mean (the pycaffe mean-file shape) is subtracted AFTER
+    # cropping; a full-size mean before (equivalent to subtracting at each
+    # window); anything else should fail clearly, not deep in jit tracing
+    mean_after = (mean_arr is not None and mean_arr.ndim >= 2
+                  and mean_arr.shape[-2:] == (crop, crop))
 
     def pre(micro, rng):
         data = micro[field]
         lead = data.shape[:-3]
         c, h, w = data.shape[-3:]
         flat = data.reshape((-1, c, h, w)).astype(jnp.float32)
-        if mean_arr is not None:
+        if mean_arr is not None and not mean_after:
+            if mean_arr.ndim >= 2 and mean_arr.shape[-2:] != (h, w):
+                raise ValueError(
+                    f"device mean shape {mean_arr.shape} matches neither "
+                    f"the full image ({h}, {w}) nor the crop "
+                    f"({crop}, {crop})")
             flat = flat - mean_arr
         n = flat.shape[0]
         ky, kx, kf = jax.random.split(rng, 3)
@@ -94,6 +104,10 @@ def device_crop_mirror_mean(crop: int, mirror: bool = True,
 
         def one(img, y, x, f):
             win = lax.dynamic_slice(img, (0, y, x), (c, crop, crop))
+            if mean_after:
+                # crop-sized mean subtracts at unmirrored coordinates
+                # (data_transformer.cpp mirrors the subtracted result)
+                win = win - mean_arr
             return jnp.where(f, win[:, :, ::-1], win)
 
         out = jax.vmap(one)(flat, ys, xs, flips)

@@ -67,6 +67,8 @@ class Solver:
         self._lr_mults = self.train_net.lr_mult_tree(self.params)
         self._decay_mults = self.train_net.decay_mult_tree(self.params)
         self._smoothed = collections.deque(maxlen=max(sp.average_loss, 1))
+        self._signal_guard = None       # installed by solve(); polled per
+        self._stop_requested = False    # iteration inside step()
         self._train_iter: Iterator[Mapping[str, Any]] | None = None
         self._test_iter_factory: Callable[[], Iterator[Mapping[str, Any]]] | None = None
 
@@ -122,31 +124,59 @@ class Solver:
             if (self.sp.snapshot and self.sp.snapshot_prefix
                     and self.iter % self.sp.snapshot == 0):
                 self.snapshot_caffe()
+            # per-iteration signal poll (solver.cpp:270-281 GetRequestedAction
+            # inside Step — keeps huge chunks interruptible)
+            if self._signal_guard is not None:
+                from ..utils.signals import SolverAction
+                action = self._signal_guard.check()
+                if action == SolverAction.SNAPSHOT and self.sp.snapshot_prefix:
+                    print(f"Snapshotting (signal) at iter {self.iter}")
+                    self.snapshot_caffe()
+                elif action == SolverAction.STOP:
+                    self._stop_requested = True
+                    break
         return self.smoothed_loss() if self._smoothed else loss
 
     def solve(self, max_iter: int | None = None) -> float:
         """Drive training to ``max_iter`` with the Solver::Solve schedule
         (reference: solver.cpp:285-330): optional test at start
         (test_initialization / resume on an interval boundary), periodic
-        test passes every ``test_interval``, a final test pass, and the
-        step-level display/snapshot handled by ``step``.  Returns the
-        final smoothed loss."""
+        test passes every ``test_interval``, a final test pass, the
+        step-level display/snapshot handled by ``step``, and the
+        SignalHandler contract — SIGHUP snapshots, SIGINT snapshots then
+        stops at the next chunk boundary (solver.cpp:270-281).  Returns
+        the final smoothed loss."""
+        from ..utils.signals import SignalGuard
         sp = self.sp
         max_iter = max_iter or sp.max_iter or 100
         interval = sp.test_interval \
             if (sp.test_interval and self._test_iter_factory) else 0
         test_iter = sp.test_iter[0] if sp.test_iter else 50
+        can_snapshot = bool(sp.snapshot_prefix)
         if interval and self.iter % interval == 0 and (
                 self.iter > 0 or sp.test_initialization):
             self._print_test_scores(test_iter)
         loss = 0.0
-        while self.iter < max_iter:
-            n = (min(interval - self.iter % interval, max_iter - self.iter)
-                 if interval else max_iter - self.iter)
-            loss = self.step(n)
-            print(f"Iteration {self.iter}, loss = {loss:.6f}")
-            if interval:
-                self._print_test_scores(test_iter)
+        self._stop_requested = False
+        with SignalGuard() as guard:
+            self._signal_guard = guard
+            try:
+                while self.iter < max_iter:
+                    n = (min(interval - self.iter % interval,
+                             max_iter - self.iter)
+                         if interval else max_iter - self.iter)
+                    loss = self.step(n)
+                    if self._stop_requested:
+                        print(f"Optimization stopped early (signal) at "
+                              f"iter {self.iter}")
+                        if can_snapshot:
+                            self.snapshot_caffe()
+                        return loss
+                    print(f"Iteration {self.iter}, loss = {loss:.6f}")
+                    if interval:
+                        self._print_test_scores(test_iter)
+            finally:
+                self._signal_guard = None
         print("Optimization Done.")
         return loss
 

@@ -341,3 +341,23 @@ def test_device_preprocess_deterministic_semantics(np_rng):
         for a, b in zip(tr_host.params[k], tr_dev.params[k]):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_device_preprocess_crop_sized_mean(np_rng):
+    """A crop-sized (pycaffe mean-file style) mean works on the device
+    path, subtracted after cropping; a nonsense shape fails clearly."""
+    import pytest
+
+    from sparknet_tpu.parallel import device_crop_mirror_mean
+
+    crop, full = 4, 6
+    mean_c = np_rng.normal(size=(1, crop, crop)).astype(np.float32)
+    pre = device_crop_mirror_mean(crop, mirror=False, mean=mean_c)
+    x = np_rng.normal(size=(2, 3, 1, full, full)).astype(np.float32)
+    import jax
+    out = pre({"data": x}, jax.random.PRNGKey(0))["data"]
+    assert out.shape == (2, 3, 1, crop, crop)
+
+    bad = device_crop_mirror_mean(crop, mean=np.zeros((1, 5, 5), np.float32))
+    with pytest.raises(ValueError, match="matches neither"):
+        bad({"data": x}, jax.random.PRNGKey(0))

@@ -172,3 +172,47 @@ def test_solver_solve_schedule(capsys):
     # test at iters 0 (test_initialization), 2, 4 (final)
     assert calls == [0, 2, 4]
     assert "Optimization Done." in capsys.readouterr().out
+
+
+def test_solver_solve_signal_stop(tmp_path):
+    """SIGINT during solve: snapshot (when a prefix is set) then stop at
+    the chunk boundary (solver.cpp:270-281 SignalHandler contract)."""
+    import os
+    import signal
+
+    import numpy as np
+
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+
+    sp = load_solver_prototxt_with_net(
+        f'base_lr: 0.01\nmax_iter: 100\ntest_interval: 2\ntest_iter: 1\n'
+        f'snapshot_prefix: "{tmp_path}/sig"\n', lenet(2, 2),
+        snapshot_prefix=str(tmp_path / "sig"))
+    solver = Solver(sp, seed=0)
+    rng = np.random.default_rng(0)
+
+    def feed():
+        while True:
+            yield {"data": rng.normal(size=(2, 1, 28, 28)).astype(np.float32),
+                   "label": rng.integers(0, 10, size=(2,)).astype(np.float32)}
+
+    solver.set_train_data(feed())
+    solver.set_test_data(lambda: feed())
+    calls = {"n": 0}
+    orig_step = solver.step
+
+    def step_and_interrupt(n):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            os.kill(os.getpid(), signal.SIGINT)  # caught by the guard
+        return orig_step(n)
+
+    solver.step = step_and_interrupt
+    solver.solve()
+    # signal queued before chunk 2 ran; the per-iteration poll inside
+    # step() stops after ONE more iteration (iter 3), not chunk end
+    assert solver.iter == 3
+    snaps = list(tmp_path.glob("sig_iter_3.caffemodel"))
+    assert snaps, "no snapshot written on signal stop"
