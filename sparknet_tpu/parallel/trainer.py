@@ -65,6 +65,9 @@ class TrainerConfig:
     # matmuls, and the host then only ships raw images).  Build one with
     # ``device_crop_mirror_mean``.
     device_preprocess: Any | None = None
+    # jax.checkpoint the forward: backward recomputes activations instead
+    # of storing them (HBM for FLOPs; big-batch / VGG-class configs)
+    remat: bool = False
 
 
 def device_crop_mirror_mean(crop: int, mirror: bool = True,
@@ -173,7 +176,8 @@ class DistributedTrainer:
 
         iter_size = sp.iter_size
         _, local_update, accum_grads = make_step_fns(
-            sp, net, rule, lr_mults, decay_mults)
+            sp, net, rule, lr_mults, decay_mults,
+            remat=self.config.remat, in_scan=True)
 
         # params owned by forward-state layers (BatchNorm running stats):
         # the only blobs that drift per-shard under sync DP and need

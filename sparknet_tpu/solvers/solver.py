@@ -47,7 +47,7 @@ class Solver:
     """
 
     def __init__(self, sp: SolverParameter, *, seed: int | None = None,
-                 jit: bool = True, compute_dtype=None):
+                 jit: bool = True, compute_dtype=None, remat: bool = False):
         self.sp = sp
         net_param = sp.net_param or sp.train_net_param
         if net_param is None:
@@ -66,6 +66,7 @@ class Solver:
         self.iter = 0
         self._lr_mults = self.train_net.lr_mult_tree(self.params)
         self._decay_mults = self.train_net.decay_mult_tree(self.params)
+        self._remat = remat
         self._smoothed = collections.deque(maxlen=max(sp.average_loss, 1))
         self._signal_guard = None       # installed by solve(); polled per
         self._stop_requested = False    # iteration inside step()
@@ -85,7 +86,7 @@ class Solver:
         from .step import make_step_fns
         _, local_update, _ = make_step_fns(
             self.sp, self.train_net, self.rule, self._lr_mults,
-            self._decay_mults)
+            self._decay_mults, remat=self._remat)
         return local_update
 
     # -- data feeding (CaffeNet.setTrainData/setTestData analog;

@@ -19,7 +19,8 @@ from .update_rules import SolverUpdate, preprocess_grads
 
 
 def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
-                  lr_mults, decay_mults):
+                  lr_mults, decay_mults, remat: bool = False,
+                  in_scan: bool = False):
     """Returns (loss_and_grads, local_update, accum_loss_and_grads):
 
     - ``loss_and_grads(params, batch, rng) -> (loss, params_with_bn, grads)``
@@ -29,14 +30,29 @@ def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
       — the ``iter_size`` micro-batch accumulation of ``Solver::Step``
       (reference: solver.cpp:221-224), raw summed grads (normalization by
       iter_size happens in ``preprocess_grads``)
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint`` so the backward
+    recomputes activations instead of storing them — trades FLOPs for HBM
+    on memory-bound configs (big batches / VGG-class activation volumes).
+    ``in_scan=True`` (the DistributedTrainer, whose round bodies live in
+    ``lax.scan``) drops the CSE-prevention barriers — scan already keeps
+    XLA from undoing the rematerialization, and the barriers only block
+    fusion there (jax.checkpoint docs' prevent_cse guidance).
     """
 
+    def raw_fwd(p, batch, rng):
+        out = net.apply(p, batch, train=True, rng=rng)
+        return out.loss, out.params
+
+    if remat:
+        fwd = jax.checkpoint(raw_fwd, prevent_cse=not in_scan)
+        fwd_in_scan = jax.checkpoint(raw_fwd, prevent_cse=False)
+    else:
+        fwd = fwd_in_scan = raw_fwd
+
     def loss_and_grads(params, batch, rng):
-        def loss_fn(p):
-            out = net.apply(p, batch, train=True, rng=rng)
-            return out.loss, out.params
         (loss, new_params), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            fwd, has_aux=True)(params, batch, rng)
         return loss, new_params, grads
 
     def accum_loss_and_grads(params, batches, rng):
@@ -48,7 +64,8 @@ def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
         def body(carry, batch):
             params, acc, rng = carry
             rng, sub = jax.random.split(rng)
-            loss, params, g = loss_and_grads(params, batch, sub)
+            (loss, params), g = jax.value_and_grad(
+                fwd_in_scan, has_aux=True)(params, batch, sub)
             acc = jax.tree_util.tree_map(jnp.add, acc, g)
             return (params, acc, rng), loss
 

@@ -216,3 +216,34 @@ def test_solver_solve_signal_stop(tmp_path):
     assert solver.iter == 3
     snaps = list(tmp_path.glob("sig_iter_3.caffemodel"))
     assert snaps, "no snapshot written on signal stop"
+
+
+def test_remat_matches_plain():
+    """jax.checkpoint'd training (remat=True) is numerically identical to
+    plain training — it only changes what the backward stores."""
+    import numpy as np
+
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+
+    def run(remat):
+        sp = load_solver_prototxt_with_net(
+            "base_lr: 0.01\nmomentum: 0.9\n", lenet(2, 2))
+        s = Solver(sp, seed=0, remat=remat)
+        rng = np.random.default_rng(0)
+
+        def feed():
+            while True:
+                yield {"data": rng.normal(size=(2, 1, 28, 28)).astype(np.float32),
+                       "label": rng.integers(0, 10, size=(2,)).astype(np.float32)}
+
+        s.set_train_data(feed())
+        s.step(3)
+        return s.params
+
+    a, b = run(False), run(True)
+    for k in a:
+        for x, y in zip(a[k], b[k]):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-6, atol=1e-7)
