@@ -135,6 +135,8 @@ def run_child() -> None:
     raw_step = solver.make_train_step()
 
     if scan:
+        from jax import lax
+
         def block_fn(params, state, it0, batch, rng):
             def body(i, carry):
                 params, state, rng, _loss = carry
@@ -142,7 +144,6 @@ def run_child() -> None:
                 params, state, loss = raw_step(params, state, it0 + i,
                                                batch, sub)
                 return (params, state, rng, loss)
-            import jax.lax as lax
             return lax.fori_loop(0, ITERS, body,
                                  (params, state, rng, jnp.zeros(())))
         block = jax.jit(block_fn, donate_argnums=(0, 1))

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import io
 import os
+import threading
 from typing import BinaryIO, Iterator
 
 
@@ -43,12 +44,48 @@ class ObjectStore:
             f.seek(offset)
             return f.read(length)
 
+    def close(self) -> None:
+        """Release any pooled resources (no-op by default)."""
+
 
 class LocalStore(ObjectStore):
-    """Filesystem-backed store; keys are paths relative to ``root``."""
+    """Filesystem-backed store; keys are paths relative to ``root``.
+
+    ``open_range`` (the lazy-partition hot path — one call per record)
+    reuses a small pool of open file handles instead of open/seek/close
+    per record; the pool is lock-protected (one store is shared by every
+    LazyTarPartition of a dataset, and the prefetch thread reads it)."""
+
+    _MAX_HANDLES = 8
 
     def __init__(self, root: str):
         self.root = root
+        self._handles: dict[str, BinaryIO] = {}
+        self._lock = threading.Lock()
+
+    def open_range(self, key: str, offset: int, length: int) -> bytes:
+        with self._lock:
+            f = self._handles.get(key)
+            if f is None:
+                if len(self._handles) >= self._MAX_HANDLES:
+                    _, old = self._handles.popitem()
+                    old.close()
+                f = self.open(key)
+                self._handles[key] = f
+            f.seek(offset)
+            return f.read(length)
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._handles.values():
+                f.close()
+            self._handles.clear()
+
+    def __del__(self):  # best-effort fd release
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def list_keys(self, prefix: str = "") -> list[str]:
         out = []

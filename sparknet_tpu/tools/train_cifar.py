@@ -65,15 +65,6 @@ def _data_batch_sizes(net) -> tuple[int, int]:
     return train_b, test_b
 
 
-def synthetic_cifar(n: int, seed: int = 0):
-    rng = np.random.default_rng(seed)
-    labels = rng.integers(0, 10, size=n)
-    x = rng.normal(scale=20.0, size=(n, 3, 32, 32)).astype(np.float32) + 120
-    for k in range(10):
-        x[labels == k, k % 3, k:k + 3, :] += 60.0
-    return np.clip(x, 0, 255), labels.astype(np.int32)
-
-
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Reproduce the Caffe CIFAR-10 trajectory")
@@ -107,6 +98,7 @@ def main(argv=None):
             raise SystemExit("no --data-dir; pass --synthetic to run the "
                              "harness on a labeled stand-in dataset")
         data_kind = "synthetic"
+        from ..apps.cifar_app import synthetic_cifar  # deferred: pulls jax
         train_x, train_y = synthetic_cifar(10000, seed=1)
         test_x, test_y = synthetic_cifar(2000, seed=2)
     else:

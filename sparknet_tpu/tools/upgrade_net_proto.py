@@ -25,8 +25,7 @@ def main(argv=None) -> int:
                     help="write binary NetParameter (weights preserved)")
     args = ap.parse_args(argv)
 
-    from ..proto import load_net_prototxt
-    from ..proto.textformat import serialize
+    from ..proto import load_net_prototxt, save_net_prototxt
     from ..proto.wireformat import encode
 
     # sniff by parsing: a text prototxt is essentially never valid wire
@@ -40,13 +39,11 @@ def main(argv=None) -> int:
     except WireError:
         net = load_net_prototxt(args.input)  # upgrades run in from_pmsg
 
-    msg = net.to_pmsg(include_blobs=args.binary)
     if args.binary:
         with open(args.output, "wb") as f:
-            f.write(encode(msg, "NetParameter"))
+            f.write(encode(net.to_pmsg(include_blobs=True), "NetParameter"))
     else:
-        with open(args.output, "w") as f:
-            f.write(serialize(msg))
+        save_net_prototxt(net, args.output)
     print(f"Wrote upgraded NetParameter to {args.output}")
     return 0
 
