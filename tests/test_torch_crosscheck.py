@@ -54,7 +54,8 @@ def test_deconv_matches_torch(np_rng):
 
 
 def test_max_pool_matches_torch(np_rng):
-    x = np_rng.normal(size=(2, 3, 9, 9)).astype(np.float32)
+    # 8x8 with k=3 s=2 discriminates: ceil sizing gives 4x4, floor 3x3
+    x = np_rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
     lp = layer("p", "Pooling", ["x"], ["y"], pooling_param={
         "pool": "MAX", "kernel_size": 3, "stride": 2})
     got = _apply(lp, [x])

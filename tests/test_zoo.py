@@ -196,3 +196,34 @@ def test_solver_parses(rel):
     assert sp.base_lr > 0
     assert sp.lr_policy in {"fixed", "step", "exp", "inv", "multistep",
                             "poly", "sigmoid", "stepearly"}
+
+
+@pytest.mark.parametrize("rel", sorted(list(TRAIN_NETS) + DEPLOY_NETS
+                                       + PARSE_ONLY_NETS))
+def test_zoo_serialize_roundtrip(rel):
+    """Every zoo prototxt survives load -> to_pmsg -> serialize -> reload
+    with the same layer structure — the write half (save_net_prototxt /
+    upgrade tools) exercised over every real prototxt construct,
+    including V0/V1-format files which round-trip as upgraded V2."""
+    from sparknet_tpu.proto import save_net_prototxt
+
+    net = load_net_prototxt(os.path.join(REF, rel))
+    back = load_net_prototxt(save_net_prototxt(net))
+    assert [l.name for l in back.layer] == [l.name for l in net.layer]
+    assert [l.type for l in back.layer] == [l.type for l in net.layer]
+    assert [l.bottom for l in back.layer] == [l.bottom for l in net.layer]
+    assert [l.top for l in back.layer] == [l.top for l in net.layer]
+    for a, b in zip(net.layer, back.layer):
+        assert a.params == b.params, a.name
+        assert [(r.phase, r.stage) for r in a.include] == \
+            [(r.phase, r.stage) for r in b.include], a.name
+        assert [(r.phase, r.stage) for r in a.exclude] == \
+            [(r.phase, r.stage) for r in b.exclude], a.name
+        assert [(p.name, p.raw_lr_mult, p.raw_decay_mult)
+                for p in a.param] == \
+            [(p.name, p.raw_lr_mult, p.raw_decay_mult)
+             for p in b.param], a.name
+        assert a.loss_weight == b.loss_weight and a.phase == b.phase
+    assert back.input == net.input
+    assert [s.dim for s in back.input_shape] == \
+        [s.dim for s in net.input_shape]
