@@ -319,7 +319,7 @@ class DistributedTrainer:
         return float(loss)
 
     def test(self, feed: Iterator[Mapping[str, Any]], num_steps: int,
-             ) -> dict[str, float]:
+             ) -> dict[str, Any]:
         """Distributed eval: test batches shard across the mesh, per-output
         sums aggregate over all workers — the zipPartitions eval + driver
         sum of the reference (ImageNetApp.scala:108-141)."""
@@ -327,13 +327,15 @@ class DistributedTrainer:
             net = self.test_net
 
             def fwd(params, batch):
+                # element-wise like Solver.test / TestAndStoreResult:
+                # vector outputs (per-class accuracy) keep their shape
                 out = net.apply(params, batch, train=False)
-                return {k: jnp.sum(v) for k, v in out.blobs.items()}
+                return dict(out.blobs)
 
             self._test_fwd = jax.jit(fwd)
         sharding = batch_sharded(self.mesh)
         local_workers = max(self.n_workers // jax.process_count(), 1)
-        totals: dict[str, float] = {}
+        totals: dict[str, Any] = {}
         for _ in range(num_steps):
             batch = {}
             for k, v in next(feed).items():
@@ -344,7 +346,8 @@ class DistributedTrainer:
                 batch[k] = stage_local(v, sharding)
             scores = self._test_fwd(self.params, batch)
             for k, v in scores.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
+                val = float(v) if np.ndim(v) == 0 else np.asarray(v)
+                totals[k] = val if k not in totals else totals[k] + val
         return totals
 
     # -- checkpoint (driver-side averaged weights + per-worker state;

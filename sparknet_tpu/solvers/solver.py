@@ -182,8 +182,14 @@ class Solver:
         return loss
 
     def _print_test_scores(self, test_iter: int) -> None:
+        import numpy as np
         for k, v in self.test(test_iter).items():
-            print(f"    Test net output: {k} = {v / test_iter:.6f}")
+            arr = np.asarray(v, np.float64) / test_iter
+            if arr.ndim == 0:
+                print(f"    Test net output: {k} = {float(arr):.6f}")
+            else:  # per-element, like Caffe's indexed test outputs
+                for i, x in enumerate(arr.reshape(-1)):
+                    print(f"    Test net output: {k}[{i}] = {float(x):.6f}")
 
     def _log_debug_info(self, stacked, params_before, rng) -> None:
         """Per-blob/param mean-|x| dumps behind ``sp.debug_info`` — the
@@ -218,24 +224,30 @@ class Solver:
     # -- test pass (Solver::TestAndStoreResult; reference:
     #    solver.cpp:413-445 + ccaffe.cpp:179-187) -------------------------
     def _test_forward(self, params, batch):
+        # outputs pass through element-wise (Accuracy's per-class second
+        # top stays a vector) — Solver::TestAndStoreResult accumulates
+        # every element of every output blob (solver.cpp:413-445)
         out = self.test_net.apply(params, batch, train=False)
-        return {k: jnp.sum(v) for k, v in out.blobs.items()}
+        return dict(out.blobs)
 
-    def test(self, num_steps: int | None = None) -> dict[str, float]:
+    def test(self, num_steps: int | None = None) -> dict[str, Any]:
         """Run the weight-sharing test net ``num_steps`` times, accumulating
-        each output-blob scalar (the JVM then averages across workers —
-        reference: ImageNetApp.scala:138-140)."""
+        each output-blob element (the JVM then averages across workers —
+        reference: ImageNetApp.scala:138-140).  Scalar outputs come back
+        as floats; vector outputs (per-class accuracy) as numpy arrays."""
+        import numpy as np
         if self._test_iter_factory is None:
             raise RuntimeError("no test data set; call set_test_data first")
         if num_steps is None:
             num_steps = self.sp.test_iter[0] if self.sp.test_iter else 1
         it = self._test_iter_factory()
-        totals: dict[str, float] = collections.defaultdict(float)
+        totals: dict[str, Any] = {}
         for _ in range(num_steps):
             scores = self._test_fwd(self.params, dict(next(it)))
             for k, v in scores.items():
-                totals[k] += float(v)
-        return dict(totals)
+                val = float(v) if np.ndim(v) == 0 else np.asarray(v)
+                totals[k] = val if k not in totals else totals[k] + val
+        return totals
 
     # -- checkpointing (Solver::Snapshot/Restore; reference:
     #    solver.cpp:447-530, sgd_solver.cpp:242-296; FFI surface

@@ -247,3 +247,38 @@ def test_remat_matches_plain():
         for x, y in zip(a[k], b[k]):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        rtol=1e-6, atol=1e-7)
+
+
+def test_solver_test_per_class_accumulation():
+    """Vector test-net outputs (Accuracy's per-class top) accumulate
+    element-wise like Solver::TestAndStoreResult, not collapsed to a
+    scalar sum (solver.cpp:413-445)."""
+    import numpy as np
+
+    from sparknet_tpu.models.dsl import java_data_layer, layer, net_param
+    from sparknet_tpu.proto import Phase, load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+
+    net = net_param("pc", [
+        java_data_layer("input", ["data", "label"], None, (6, 4), (6,)),
+        layer("ip", "InnerProduct", ["data"], ["ip"],
+              inner_product_param={"num_output": 3,
+                                   "weight_filler": {"type": "xavier"}}),
+        layer("loss", "SoftmaxWithLoss", ["ip", "label"], ["loss"],
+              phase=Phase.TRAIN),
+        layer("acc", "Accuracy", ["ip", "label"], ["acc", "per_class"],
+              phase=Phase.TEST),
+    ])
+    sp = load_solver_prototxt_with_net("base_lr: 0.01\n", net)
+    solver = Solver(sp, seed=0)
+    rng = np.random.default_rng(0)
+
+    def feed():
+        while True:
+            yield {"data": rng.normal(size=(6, 4)).astype(np.float32),
+                   "label": rng.integers(0, 3, size=(6,)).astype(np.float32)}
+
+    solver.set_test_data(lambda: feed())
+    scores = solver.test(4)
+    assert isinstance(scores["acc"], float)
+    assert np.shape(scores["per_class"]) == (3,)   # element-wise, not summed
