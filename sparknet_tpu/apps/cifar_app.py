@@ -20,6 +20,8 @@ import time
 
 import numpy as np
 
+from typing import Any
+
 from ..data import compute_mean_image, load_cifar10_binary
 from ..data.partition import PartitionedDataset
 from ..models import cifar10_full, cifar10_quick
@@ -45,7 +47,7 @@ def synthetic_cifar(n: int, seed: int = 0):
     return np.clip(x, 0, 255), labels.astype(np.int32)
 
 
-def main(argv=None) -> dict[str, float]:
+def main(argv=None) -> dict[str, Any]:
     ap = argparse.ArgumentParser(description="CIFAR-10 parameter-averaging app")
     ap.add_argument("--workers", type=int, default=None,
                     help="mesh size (default: all devices)")

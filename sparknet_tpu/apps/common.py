@@ -22,7 +22,7 @@ preprocessing of round N+1 overlaps round N's device compute.
 from __future__ import annotations
 
 import time
-from typing import Callable, Iterator, Sequence
+from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
@@ -130,7 +130,7 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
                  test_interval: int = 10,
                  logger: PhaseLogger | None = None,
                  snapshot_path: str | None = None,
-                 prefetch_depth: int = 1) -> dict[str, float]:
+                 prefetch_depth: int = 1) -> dict[str, Any]:
     """The outer while-loop (reference: CifarApp.scala:87-128 — infinite
     there; bounded by ``rounds`` here).  SIGINT stops cleanly (snapshotting
     first when a path is given), SIGHUP snapshots and continues — the
@@ -144,7 +144,7 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
     from ..utils.signals import SignalGuard, SolverAction
 
     log = logger or PhaseLogger()
-    last_scores: dict[str, float] = {}
+    last_scores: dict[str, Any] = {}
     round_iter = device_feed(feed.rounds(), depth=prefetch_depth,
                              sharding=trainer.input_sharding)
 

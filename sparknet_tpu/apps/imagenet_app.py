@@ -22,6 +22,8 @@ import time
 
 import numpy as np
 
+from typing import Any
+
 from ..data.imagenet import load_imagenet
 from ..data.partition import PartitionedDataset
 from ..data.transforms import center_crop, random_crop_mirror
@@ -59,7 +61,7 @@ def synthetic_imagenet(n: int, size: int, classes: int, seed: int = 0):
     return np.clip(x, 0, 255), labels.astype(np.int32)
 
 
-def main(argv=None) -> dict[str, float]:
+def main(argv=None) -> dict[str, Any]:
     ap = argparse.ArgumentParser(description="ImageNet parameter-averaging app")
     ap.add_argument("--workers", type=int, default=None)
     ap.add_argument("--tar-dir", default=None,

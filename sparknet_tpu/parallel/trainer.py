@@ -328,9 +328,19 @@ class DistributedTrainer:
 
             def fwd(params, batch):
                 # element-wise like Solver.test / TestAndStoreResult:
-                # vector outputs (per-class accuracy) keep their shape
+                # vector outputs (per-class accuracy) keep their shape.
+                # Batch-dim outputs are summed over the batch axis inside
+                # the jit — the result is replicated, so every host can
+                # fetch it (a raw batch-sharded top would span
+                # non-addressable devices in multihost runs)
                 out = net.apply(params, batch, train=False)
-                return dict(out.blobs)
+                n = next(iter(batch.values())).shape[0]
+
+                def reduce(v):
+                    if v.ndim and v.shape[0] == n:
+                        return jnp.sum(v, axis=0)
+                    return v
+                return {k: reduce(v) for k, v in out.blobs.items()}
 
             self._test_fwd = jax.jit(fwd)
         sharding = batch_sharded(self.mesh)

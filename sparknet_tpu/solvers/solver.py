@@ -19,6 +19,7 @@ from typing import Any, Callable, Iterator, Mapping
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..graph.net import Net, WeightCollection
 from ..proto.caffe_pb import NetParameter, NetState, Phase, SolverParameter
@@ -182,7 +183,6 @@ class Solver:
         return loss
 
     def _print_test_scores(self, test_iter: int) -> None:
-        import numpy as np
         for k, v in self.test(test_iter).items():
             arr = np.asarray(v, np.float64) / test_iter
             if arr.ndim == 0:
@@ -235,7 +235,6 @@ class Solver:
         each output-blob element (the JVM then averages across workers —
         reference: ImageNetApp.scala:138-140).  Scalar outputs come back
         as floats; vector outputs (per-class accuracy) as numpy arrays."""
-        import numpy as np
         if self._test_iter_factory is None:
             raise RuntimeError("no test data set; call set_test_data first")
         if num_steps is None:
@@ -294,7 +293,6 @@ class Solver:
         reference: blob.cpp).  Any other mismatch raises, as Caffe's shape
         CHECKs do (a same-size layout difference, e.g. a transposed ip
         weight, must not be silently reshaped)."""
-        import numpy as np
         src = np.asarray(src)
         if src.shape == tuple(dst_shape):
             return src
@@ -358,7 +356,6 @@ class Solver:
         of learnable-param-order blobs per slot (AdaDelta/Adam push a second
         run onto ``history_``; reference: adadelta_solver.cpp ctor,
         adam_solver.cpp AdamPreSolve)."""
-        import numpy as np
         flat = []
         for slot in self._HISTORY_SLOTS[self.rule.name]:
             tree = self.state[slot]

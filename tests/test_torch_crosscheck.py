@@ -133,3 +133,68 @@ def test_batchnorm_matches_torch(np_rng):
         torch.from_numpy(x), None, None, training=True,
         eps=1e-5).numpy()
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_prelu_matches_torch(np_rng):
+    x = np_rng.normal(size=(2, 4, 3, 3)).astype(np.float32)
+    slope = np_rng.uniform(0.1, 0.4, size=(4,)).astype(np.float32)
+    lp = layer("pr", "PReLU", ["x"], ["y"])
+    got = _apply(lp, [x], [slope])
+    ref = torch.nn.functional.prelu(
+        torch.from_numpy(x), torch.from_numpy(slope)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_elu_family_neurons_match_torch(np_rng):
+    x = np_rng.normal(size=(3, 5)).astype(np.float32)
+    tx = torch.from_numpy(x)
+    cases = [
+        ("ReLU", {}, torch.nn.functional.relu(tx)),
+        ("Sigmoid", {}, torch.sigmoid(tx)),
+        ("TanH", {}, torch.tanh(tx)),
+        ("AbsVal", {}, tx.abs()),
+        ("BNLL", {}, torch.nn.functional.softplus(tx)),
+    ]
+    for type_, params, ref in cases:
+        got = _apply(layer("n", type_, ["x"], ["y"], **params), [x])
+        np.testing.assert_allclose(got, ref.numpy(), rtol=1e-5, atol=1e-6,
+                                   err_msg=type_)
+
+
+def test_softmax_matches_torch(np_rng):
+    x = np_rng.normal(size=(3, 6, 2)).astype(np.float32)
+    lp = layer("s", "Softmax", ["x"], ["y"])
+    got = _apply(lp, [x])
+    ref = torch.softmax(torch.from_numpy(x), dim=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_embed_matches_torch(np_rng):
+    ids = np_rng.integers(0, 7, size=(5,)).astype(np.float32)
+    table = np_rng.normal(size=(7, 3)).astype(np.float32)
+    lp = layer("e", "Embed", ["x"], ["y"],
+               embed_param={"input_dim": 7, "num_output": 3,
+                            "bias_term": False})
+    got = _apply(lp, [ids], [table])
+    ref = torch.nn.functional.embedding(
+        torch.from_numpy(ids.astype(np.int64)),
+        torch.from_numpy(table)).numpy()
+    np.testing.assert_allclose(got.reshape(5, 3), ref, rtol=1e-6)
+
+
+def test_dropout_train_scaling_matches_torch_semantics(np_rng):
+    """Caffe (and torch) scale kept units by 1/(1-p) at train time; the
+    expectation over masks equals the input."""
+    import jax
+
+    x = np.ones((2000,), np.float32)
+    lp = layer("d", "Dropout", ["x"], ["y"],
+               dropout_param={"dropout_ratio": 0.4})
+    from sparknet_tpu.ops import get_layer_impl
+    impl = get_layer_impl("Dropout")
+    import jax.numpy as jnp
+    out = np.asarray(impl.apply(lp, [], [jnp.asarray(x)], True,
+                                jax.random.PRNGKey(0))[0])
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-5)  # inverted scale
+    assert abs(out.mean() - 1.0) < 0.05                      # E[out] == x
