@@ -274,9 +274,15 @@ def window_data_feed(lp, phase: Phase, seed: int = 0
     window sampling at fg_fraction, crop + warp each window to crop_size,
     context padding, mean subtraction at the window."""
     p = lp.sub("window_data_param")
+    fg_threshold = float(p.get("fg_threshold", 0.5))
+    bg_threshold = float(p.get("bg_threshold", 0.5))
     images, fg, bg = read_window_file(str(p.get("source")),
-                                      float(p.get("fg_threshold", 0.5)),
-                                      float(p.get("bg_threshold", 0.5)))
+                                      fg_threshold, bg_threshold)
+    if not fg and not bg:
+        raise ValueError(
+            f"WindowData layer {lp.name!r}: no sampleable windows — every "
+            f"window overlap falls in [{bg_threshold}, {fg_threshold}) "
+            f"(fg_threshold={fg_threshold}, bg_threshold={bg_threshold})")
     batch = int(p.get("batch_size", 1))
     fg_frac = float(p.get("fg_fraction", 0.25))
     context_pad = int(p.get("context_pad", 0))

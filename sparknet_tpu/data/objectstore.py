@@ -68,10 +68,14 @@ class LocalStore(ObjectStore):
             f = self._handles.get(key)
             if f is None:
                 if len(self._handles) >= self._MAX_HANDLES:
-                    _, old = self._handles.popitem()
-                    old.close()
+                    # evict least-recently-used (hits re-append below, so
+                    # dict order is LRU-first)
+                    oldest = next(iter(self._handles))
+                    self._handles.pop(oldest).close()
                 f = self.open(key)
-                self._handles[key] = f
+            else:
+                del self._handles[key]  # re-append: mark most-recent
+            self._handles[key] = f
             f.seek(offset)
             return f.read(length)
 

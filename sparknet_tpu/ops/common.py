@@ -554,6 +554,9 @@ class AccuracyLayer(LayerImpl):
             return [(), (bottom_shapes[0][axis],)]
         return [()]
 
+    def top_has_batch_axis(self, lp, top_index: int) -> bool:
+        return False  # scalar accuracy; per-class vector is class-indexed
+
     def apply(self, lp, params, bottoms, train, rng):
         p = lp.sub("accuracy_param")
         top_k = int(p.get("top_k", 1))

@@ -29,6 +29,9 @@ class LossLayer(LayerImpl):
     def out_shapes(self, lp, bottom_shapes):
         return [()]
 
+    def top_has_batch_axis(self, lp, top_index: int) -> bool:
+        return False  # scalar loss
+
 
 @register_layer("SoftmaxWithLoss")
 class SoftmaxWithLossLayer(LossLayer):

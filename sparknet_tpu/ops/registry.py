@@ -59,6 +59,14 @@ class LayerImpl:
         when training; DummyData with random fillers in any phase)."""
         return False
 
+    def top_has_batch_axis(self, lp: LayerParameter, top_index: int) -> bool:
+        """Whether the given top carries the minibatch as axis 0.  Used by
+        distributed eval to decide batch-sum vs element-wise aggregation
+        (a per-class accuracy vector must NOT be summed over axis 0 even
+        if its length equals the batch).  Reducing layers (losses,
+        Accuracy) override to False."""
+        return True
+
 
 _REGISTRY: dict[str, LayerImpl] = {}
 

@@ -222,11 +222,38 @@ def ave_pool(x, kh, kw, sh, sw, ph, pw, oh, ow):
     return s / denom
 
 
+def stochastic_pool_train(x, kh, kw, sh, sw, ph, pw, oh, ow, rng):
+    """Train-mode stochastic pooling (reference: pooling_layer.cu
+    StoPoolForwardTrain): draw thres = U(0,1)·Σwindow, output the first
+    element whose running cumsum exceeds thres; gradient routes to the
+    sampled element only (StoPoolBackward).  Inputs are assumed
+    non-negative (the reference samples after ReLU the same way); an
+    all-zero window yields 0 with gradient to its first element."""
+    n, c, h, w = x.shape
+    pad_hi_h = (oh - 1) * sh + kh - h - ph
+    pad_hi_w = (ow - 1) * sw + kw - w - pw
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        ((ph, max(pad_hi_h, 0)), (pw, max(pad_hi_w, 0))),
+        dimension_numbers=DIMNUMS)  # (N, C·kh·kw, oh, ow)
+    p = patches.reshape(n, c, kh * kw, oh, ow)
+    cs = jnp.cumsum(p, axis=2)
+    total = cs[:, :, -1:, :, :]
+    thres = jax.random.uniform(rng, (n, c, 1, oh, ow), x.dtype) * total
+    idx = jnp.argmax(cs > thres, axis=2)  # first exceedance; all-False → 0
+    return jnp.take_along_axis(p, idx[:, :, None], axis=2)[:, :, 0]
+
+
 @register_layer("Pooling")
 class PoolingLayer(LayerImpl):
     """MAX/AVE/STOCHASTIC pooling (reference: pooling_layer.cpp).  STOCHASTIC
-    uses the test-time weighted-average form (sum x² / sum x) in both modes;
-    no zoo model trains with stochastic pooling."""
+    samples a window element with probability ∝ its value in train mode
+    (pooling_layer.cu StoPoolForwardTrain) and uses the weighted-average
+    form (sum x² / sum x) at test (StoPoolForwardTest)."""
+
+    def needs_rng(self, lp, train: bool = True) -> bool:
+        return train and str(
+            lp.sub("pooling_param").get("pool", "MAX")) == "STOCHASTIC"
 
     def out_shapes(self, lp, bottom_shapes):
         n, c, h, w = bottom_shapes[0]
@@ -244,6 +271,9 @@ class PoolingLayer(LayerImpl):
         if method == "AVE":
             return [ave_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)]
         if method == "STOCHASTIC":
+            if train:
+                return [stochastic_pool_train(x, kh, kw, sh, sw, ph, pw,
+                                              oh, ow, rng)]
             num = ave_pool(x * x, kh, kw, sh, sw, ph, pw, oh, ow)
             den = ave_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)
             return [num / jnp.where(den == 0, 1.0, den)]

@@ -325,22 +325,30 @@ class DistributedTrainer:
         sum of the reference (ImageNetApp.scala:108-141)."""
         if self._test_fwd is None:
             net = self.test_net
+            # per-blob batch-axis decision from producing-layer metadata
+            # (LayerImpl.top_has_batch_axis) — NOT from a runtime shape
+            # coincidence: a per-class accuracy vector whose length equals
+            # the batch must stay element-wise
+            has_batch_axis: dict[str, bool] = {}
+            for node in net.nodes:
+                for i, t in enumerate(node.tops):
+                    has_batch_axis[t] = node.impl.top_has_batch_axis(
+                        node.lp, i)
 
             def fwd(params, batch):
                 # element-wise like Solver.test / TestAndStoreResult:
                 # vector outputs (per-class accuracy) keep their shape.
-                # Batch-dim outputs are summed over the batch axis inside
+                # Batch-axis outputs are summed over the batch axis inside
                 # the jit — the result is replicated, so every host can
                 # fetch it (a raw batch-sharded top would span
                 # non-addressable devices in multihost runs)
                 out = net.apply(params, batch, train=False)
-                n = next(iter(batch.values())).shape[0]
 
-                def reduce(v):
-                    if v.ndim and v.shape[0] == n:
+                def reduce(k, v):
+                    if v.ndim and has_batch_axis.get(k, True):
                         return jnp.sum(v, axis=0)
                     return v
-                return {k: reduce(v) for k, v in out.blobs.items()}
+                return {k: reduce(k, v) for k, v in out.blobs.items()}
 
             self._test_fwd = jax.jit(fwd)
         sharding = batch_sharded(self.mesh)

@@ -195,13 +195,15 @@ class Solver:
         """Per-blob/param mean-|x| dumps behind ``sp.debug_info`` — the
         ForwardDebugInfo / UpdateDebugInfo logging of the reference
         (net.cpp:711-735, sgd_solver.cpp via Solver::Step).  The forward
-        re-runs eagerly on the first micro-batch; update magnitudes come
-        from the params delta (the jitted step exposes no grads)."""
+        re-runs eagerly on the first micro-batch with the PRE-update
+        params — net.cpp ForwardDebugInfo reflects the step's actual
+        forward; update magnitudes come from the params delta (the jitted
+        step exposes no grads)."""
         def asum(v) -> float:
             return float(jnp.mean(jnp.abs(v)))
 
         first = jax.tree_util.tree_map(lambda x: x[0], stacked)
-        blobs = self.train_net.apply_all(self.params, first, train=True,
+        blobs = self.train_net.apply_all(params_before, first, train=True,
                                          rng=rng)
         for node in self.train_net.nodes:
             for t in node.tops:

@@ -164,6 +164,58 @@ def test_pool_gradients(np_rng):
         check_grads(f, (x,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
 
 
+def test_stochastic_pool_train_samples_proportionally(np_rng):
+    # non-overlapping 2x2 windows; element picked with prob ∝ value
+    # (pooling_layer.cu StoPoolForwardTrain)
+    x = np.zeros((1, 1, 2, 2), np.float32)
+    x[0, 0] = [[1.0, 3.0], [0.0, 0.0]]
+    lp = make("Pooling", pooling_param={"pool": "STOCHASTIC",
+                                        "kernel_size": 2, "stride": 2})
+    picks = []
+    for i in range(400):
+        y = np.asarray(apply_op(lp, [x], train=True,
+                                rng=jax.random.PRNGKey(i))[0])
+        assert y.reshape(()) in (1.0, 3.0)  # always a window element
+        picks.append(float(y.reshape(())))
+    frac3 = sum(1 for p in picks if p == 3.0) / len(picks)
+    assert 0.65 < frac3 < 0.85  # expect 0.75
+
+
+def test_stochastic_pool_train_gradient_routes_to_sample(np_rng):
+    # d(sum y)/dx is a one-hot mask per (non-overlapping) window at the
+    # sampled element — StoPoolBackward semantics
+    x = jnp.asarray(np_rng.uniform(0.1, 1.0, size=(2, 3, 4, 4))
+                    .astype(np.float32))
+    lp = make("Pooling", pooling_param={"pool": "STOCHASTIC",
+                                        "kernel_size": 2, "stride": 2})
+    impl = get_layer_impl("Pooling")
+    key = jax.random.PRNGKey(7)
+    f = lambda x: jnp.sum(impl.apply(lp, [], [x], True, key)[0])
+    g = np.asarray(jax.grad(f)(x))
+    assert set(np.unique(g)) == {0.0, 1.0}
+    # exactly one selected element per 2x2 window
+    gsum = g.reshape(2, 3, 2, 2, 2, 2).sum(axis=(3, 5))
+    np.testing.assert_array_equal(gsum, np.ones((2, 3, 2, 2)))
+    # and the sampled value is what the forward returned
+    y = np.asarray(impl.apply(lp, [], [x], True, key)[0])
+    picked = (g * np.asarray(x)).reshape(2, 3, 2, 2, 2, 2).sum(axis=(3, 5))
+    np.testing.assert_allclose(picked, y, rtol=1e-6)
+
+
+def test_stochastic_pool_test_mode_weighted_average(np_rng):
+    x = np.abs(np_rng.normal(size=(1, 2, 4, 4))).astype(np.float32)
+    lp = make("Pooling", pooling_param={"pool": "STOCHASTIC",
+                                        "kernel_size": 2, "stride": 2})
+    y = np.asarray(apply_op(lp, [x], train=False)[0])
+    # sum x^2 / sum x per window
+    xr = x.reshape(1, 2, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5)
+    num = (xr ** 2).sum(axis=(-1, -2))
+    den = xr.sum(axis=(-1, -2))
+    np.testing.assert_allclose(y, num / den, rtol=1e-5)
+    assert get_layer_impl("Pooling").needs_rng(lp, train=True)
+    assert not get_layer_impl("Pooling").needs_rng(lp, train=False)
+
+
 # -- LRN --------------------------------------------------------------------
 
 def test_lrn_across_channels_matches_numpy(np_rng):
