@@ -109,7 +109,10 @@ class Net:
         tainted: set[str] = set()
 
         for lp in self.param.layer:
-            impl = get_layer_impl(lp.type)
+            # per_net_copy: layers with per-net host state (Python layers)
+            # get a fresh impl per Net — caffe instantiates layer objects
+            # per net (net.cpp Init); stateless impls stay singletons
+            impl = get_layer_impl(lp.type).per_net_copy()
             tops = list(lp.top)
             bottoms = list(lp.bottom)
             for b in bottoms:

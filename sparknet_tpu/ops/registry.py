@@ -59,6 +59,13 @@ class LayerImpl:
         when training; DummyData with random fillers in any phase)."""
         return False
 
+    def per_net_copy(self) -> "LayerImpl":
+        """Impl instance to bind into a Net being built.  Stateless layers
+        (the default) return the registry singleton; layers holding
+        per-net host state override to return a fresh copy (caffe
+        instantiates layer objects per net — net.cpp Init)."""
+        return self
+
     def top_has_batch_axis(self, lp: LayerParameter, top_index: int) -> bool:
         """Whether the given top carries the minibatch as axis 0.  Used by
         distributed eval to decide batch-sum vs element-wise aggregation

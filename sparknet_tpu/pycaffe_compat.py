@@ -1,0 +1,63 @@
+"""Minimal ``caffe`` module shim so pycaffe-style user layers import
+unmodified (reference: caffe/python/caffe/__init__.py surface that
+Python-layer modules actually touch — ``caffe.Layer`` plus the phase
+constants; e.g. examples/pycaffe/layers/pyloss.py does ``import caffe``
+and subclasses ``caffe.Layer``).
+
+Usage::
+
+    from sparknet_tpu import pycaffe_compat
+    pycaffe_compat.install()          # sys.modules.setdefault("caffe", ...)
+
+after which ``import caffe`` resolves to this shim unless a real pycaffe
+is already importable (the real one always wins).
+"""
+
+from __future__ import annotations
+
+import sys
+
+TRAIN = 0
+TEST = 1
+
+
+class Layer:
+    """Base class for user Python layers (python_layer.hpp analog).
+
+    Subclasses override ``setup/reshape/forward/backward`` operating on
+    blob lists whose elements expose ``.data``/``.diff`` numpy buffers
+    (see ops/python_layer.PyBlob).  ``self.param_str`` carries
+    ``python_param.param_str``; ``self.blobs`` is a plain list a layer
+    may fill in ``setup`` (ParameterLayer-style state is better expressed
+    through the functional protocol's ``init_params``)."""
+
+    param_str: str = ""
+
+    def __init__(self):
+        self.blobs: list = []
+
+    def setup(self, bottom, top):
+        pass
+
+    def reshape(self, bottom, top):
+        pass
+
+    def forward(self, bottom, top):
+        raise NotImplementedError
+
+    def backward(self, top, propagate_down, bottom):
+        pass
+
+
+def install() -> None:
+    """Make ``import caffe`` resolve to this shim if no real pycaffe is
+    installed.  Idempotent; never shadows an importable real caffe."""
+    if "caffe" in sys.modules:
+        return
+    try:
+        import importlib.util
+        if importlib.util.find_spec("caffe") is not None:
+            return
+    except (ImportError, ValueError):
+        pass
+    sys.modules["caffe"] = sys.modules[__name__]
