@@ -86,11 +86,15 @@ feed = iter(itertools.cycle([{"data": xs[0], "label": lab32}]))
 scores = tr.test(feed, num_steps=2)
 assert np.asarray(scores["per_class"]).shape == (32,), scores["per_class"].shape
 assert np.ndim(scores["accuracy"]) == 0
-# element-wise accumulation over 2 steps: each entry <= 2, not ~batch-sized
-assert float(np.max(np.asarray(scores["per_class"]))) <= 2.0 + 1e-6
+# per-worker element-wise accumulation (zipPartitions semantics): each
+# per-class entry <= valid worker-batches, never ~batch-sized sums
+nb = scores["__test_batches__"]
+assert nb == 16.0  # 8 workers x 2 steps
+assert float(np.max(np.asarray(scores["per_class"]))) <= nb + 1e-6
 print(f"distributed eval ok: per_class shape "
       f"{np.asarray(scores['per_class']).shape}, "
-      f"accuracy total {float(scores['accuracy']):.3f}/2 steps")
+      f"accuracy {float(scores['accuracy']) / nb:.3f} over {nb:.0f} "
+      f"worker-batches")
 
 # error probe: WindowData with no sampleable windows raises clearly
 from sparknet_tpu.data.db import window_data_feed

@@ -28,6 +28,7 @@ from ..models import cifar10_full, cifar10_quick
 from ..parallel import DistributedTrainer, TrainerConfig, make_mesh
 from ..proto import load_solver_prototxt_with_net
 from ..utils.timing import PhaseLogger
+from ..parallel.cluster import global_max
 from .common import RoundFeed, eval_feed, run_training
 
 SOLVER = """
@@ -109,6 +110,7 @@ def main(argv=None) -> dict[str, Any]:
         list(zip(test_x, test_y)), workers)
     feed = RoundFeed(train_ds, args.batch, trainer.batches_per_round, seed=3)
     test_factory, test_steps = eval_feed(test_ds, args.batch)
+    test_steps = global_max(test_steps)  # lockstep across hosts
 
     scores = run_training(trainer, feed, test_factory, test_steps,
                           rounds=args.rounds,

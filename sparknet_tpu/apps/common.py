@@ -100,29 +100,54 @@ def eval_feed(dataset: PartitionedDataset, per_worker_batch: int,
               preprocess: Callable[[np.ndarray], np.ndarray] | None = None):
     """Global test minibatches spanning all partitions (the zipPartitions
     test pass, reference: ImageNetApp.scala:108-137).  Lazy: each step
-    stacks only its own slice of every partition."""
+    stacks only its own slice of every partition.
+
+    Partitions may be UNEVEN: every worker contributes all of ITS full
+    batches (the reference's per-partition ``len``); lockstep steps run to
+    the largest partition's count, exhausted workers feeding padding rows
+    flagged invalid via ``"__valid__"`` so ``DistributedTrainer.test``
+    excludes them."""
     parts = dataset.partitions
-    steps = min(len(p) // per_worker_batch for p in parts)
-    if steps == 0:
+    per_part_steps = [len(p) // per_worker_batch for p in parts]
+    steps = max(per_part_steps)
+    if min(per_part_steps) == 0:
         sizes = dataset.partition_sizes()
         raise ValueError(
-            f"eval would run 0 steps: smallest test partition has "
-            f"{min(sizes)} items < per-worker batch {per_worker_batch}")
+            f"eval would run 0 steps on a worker: smallest test partition "
+            f"has {min(sizes)} items < per-worker batch {per_worker_batch}")
+    uneven = steps != min(per_part_steps)
 
     def factory():
         for t in range(steps):
-            imgs, labs = [], []
-            for p in parts:
-                recs = p[t * per_worker_batch:(t + 1) * per_worker_batch]
-                x = np.stack([r[0] for r in recs])
+            imgs, labs, valid = [], [], []
+            for p, n in zip(parts, per_part_steps):
+                # exhausted partitions re-feed their first batch as padding
+                # (masked out below — only the shape matters)
+                tt = t if t < n else 0
+                recs = p[tt * per_worker_batch:(tt + 1) * per_worker_batch]
+                x = np.stack([np.asarray(r[0]) for r in recs])
                 y = np.asarray([r[1] for r in recs], np.float32)
                 if preprocess is not None:
                     x = preprocess(x)
+                valid.append(1.0 if t < n else 0.0)
                 imgs.append(x)
                 labs.append(y)
-            yield {"data": np.concatenate(imgs), "label": np.concatenate(labs)}
+            batch = {"data": np.concatenate(imgs),
+                     "label": np.concatenate(labs)}
+            if uneven:
+                batch["__valid__"] = np.asarray(valid, np.float32)
+            yield batch
 
     return factory, steps
+
+
+def normalize_scores(totals: dict, test_steps: int) -> dict:
+    """The reference's score normalization: accumulated worker-batch sums
+    divided by the number of test minibatches actually scored
+    (ImageNetApp.scala:139-140 ``100F·v / numTestMinibatches``)."""
+    nb = float(totals.get("__test_batches__", test_steps)) or 1.0
+    return {k: v / nb for k, v in totals.items()
+            if k != "__test_batches__"}
 
 
 def run_training(trainer: DistributedTrainer, feed: RoundFeed,
@@ -165,7 +190,7 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
             if test_interval and r % test_interval == 0 and r > 0:
                 log.log("testing")
                 totals = trainer.test(test_factory(), test_steps)
-                last_scores = {k: v / test_steps for k, v in totals.items()}
+                last_scores = normalize_scores(totals, test_steps)
                 log.log(f"round {r}: eval {last_scores}")
             t0 = time.perf_counter()
             batches = next(round_iter)
@@ -173,6 +198,6 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
             log.log(f"round {r}: tau={trainer.config.tau} "
                     f"loss={loss:.4f} ({time.perf_counter() - t0:.2f}s)")
     totals = trainer.test(test_factory(), test_steps)
-    last_scores = {k: v / test_steps for k, v in totals.items()}
+    last_scores = normalize_scores(totals, test_steps)
     log.log(f"final eval: {last_scores}")
     return last_scores

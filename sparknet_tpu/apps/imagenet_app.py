@@ -36,6 +36,7 @@ from ..parallel import (
 )
 from ..proto import load_solver_prototxt_with_net
 from ..utils.timing import PhaseLogger
+from ..parallel.cluster import global_max
 from .common import RoundFeed, eval_feed, run_training
 
 SOLVER = """
@@ -161,6 +162,7 @@ def main(argv=None) -> dict[str, Any]:
                      preprocess=train_pre, seed=3)
     test_factory, test_steps = eval_feed(test_ds, args.batch,
                                          preprocess=lambda x: test_pre(x))
+    test_steps = global_max(test_steps)  # lockstep step count across hosts
     scores = run_training(trainer, feed, test_factory, test_steps,
                           rounds=args.rounds,
                           test_interval=args.test_interval, logger=log)

@@ -61,11 +61,14 @@ def register_python_layer(name: str, cls: type) -> None:
 
 
 def _resolve(module: str, layer: str) -> type:
-    if layer in _PROGRAMMATIC:
-        return _PROGRAMMATIC[layer]
+    # python_param.module wins when importable (the pycaffe contract); the
+    # programmatic registry is the fallback for classes with no module,
+    # so a registered name can never shadow a real import
     try:
         mod = importlib.import_module(module)
     except ImportError as e:
+        if layer in _PROGRAMMATIC:
+            return _PROGRAMMATIC[layer]
         raise ImportError(
             f"Python layer module {module!r} not importable (pycaffe "
             f"resolves it from $PYTHONPATH; register_python_layer() is the "
@@ -73,6 +76,8 @@ def _resolve(module: str, layer: str) -> type:
     try:
         return getattr(mod, layer)
     except AttributeError:
+        if layer in _PROGRAMMATIC:
+            return _PROGRAMMATIC[layer]
         raise AttributeError(
             f"module {module!r} has no class {layer!r}") from None
 
@@ -125,10 +130,19 @@ class _Binding:
         self.param_str = str(p.get("param_str", ""))
         cls = _resolve(module, layer)
         self.caffe_style = hasattr(cls, "reshape")
+        # pycaffe never passes __init__ args; bypass only a signature that
+        # REQUIRES them (catching TypeError here would mask real bugs
+        # inside a user __init__)
+        import inspect
         try:
-            self.inst = cls()
-        except TypeError:  # __init__ requiring args: pycaffe never passes any
-            self.inst = cls.__new__(cls)
+            sig = inspect.signature(cls.__init__)
+            needs_args = any(
+                p.default is inspect.Parameter.empty
+                and p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+                for name, p in sig.parameters.items() if name != "self")
+        except (TypeError, ValueError):
+            needs_args = False
+        self.inst = cls.__new__(cls) if needs_args else cls()
         # pycaffe sets param_str as an attribute before setup
         try:
             self.inst.param_str = self.param_str

@@ -70,3 +70,17 @@ def local_batch_slice(global_batch: int) -> slice:
                          f"{n} hosts")
     per = global_batch // n
     return slice(i * per, (i + 1) * per)
+
+
+def global_max(value: int) -> int:
+    """Max of a per-host integer across all processes (identity
+    single-host).  Use for eval step counts: every `DistributedTrainer.
+    test` step is a collective, so hosts with uneven partition sizes must
+    agree on the lockstep step count (the largest) — exhausted hosts pad
+    with invalid steps."""
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    arr = multihost_utils.process_allgather(np.asarray([int(value)]))
+    return int(np.max(arr))

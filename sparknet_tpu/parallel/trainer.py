@@ -320,9 +320,20 @@ class DistributedTrainer:
 
     def test(self, feed: Iterator[Mapping[str, Any]], num_steps: int,
              ) -> dict[str, Any]:
-        """Distributed eval: test batches shard across the mesh, per-output
-        sums aggregate over all workers — the zipPartitions eval + driver
-        sum of the reference (ImageNetApp.scala:108-141)."""
+        """Distributed eval, the zipPartitions contract made SPMD
+        (reference: ImageNetApp.scala:108-141): every worker scores ITS
+        batch rows independently (net.test() per partition), the
+        per-worker scores are masked by a validity flag and psum'd.
+
+        Feed batches may carry ``"__valid__"`` — a float (local_workers,)
+        0/1 mask — so partitions of UNEQUAL size eval with reference
+        semantics: exhausted workers feed padding rows with valid=0 and
+        contribute nothing, exactly like a zipPartitions worker whose
+        ``len`` ran out.  Returned totals are RAW sums over worker-batches
+        (the reference's accumulated ``v``); ``totals["__test_batches__"]``
+        counts the valid worker-batches, so ``score = totals[k] /
+        totals["__test_batches__"]`` is the reference's ``100F·v /
+        numTestMinibatches`` normalization (ImageNetApp.scala:139-140)."""
         if self._test_fwd is None:
             net = self.test_net
             # per-blob batch-axis decision from producing-layer metadata
@@ -335,34 +346,59 @@ class DistributedTrainer:
                     has_batch_axis[t] = node.impl.top_has_batch_axis(
                         node.lp, i)
 
-            def fwd(params, batch):
-                # element-wise like Solver.test / TestAndStoreResult:
-                # vector outputs (per-class accuracy) keep their shape.
-                # Batch-axis outputs are summed over the batch axis inside
-                # the jit — the result is replicated, so every host can
-                # fetch it (a raw batch-sharded top would span
-                # non-addressable devices in multihost runs)
+            def worker(params, batch, valid):
+                # one zipPartitions worker: score the local rows, zero out
+                # invalid (padding) batches, sum across the mesh — the
+                # result is replicated so every host can fetch it
                 out = net.apply(params, batch, train=False)
+                v = valid[0]
 
-                def reduce(k, v):
-                    if v.ndim and has_batch_axis.get(k, True):
-                        return jnp.sum(v, axis=0)
-                    return v
-                return {k: reduce(k, v) for k, v in out.blobs.items()}
+                def reduce(k, val):
+                    if val.ndim and has_batch_axis.get(k, True):
+                        val = jnp.sum(val, axis=0)
+                    return val * v
+                scores = {k: reduce(k, val) for k, val in out.blobs.items()}
+                scores["__test_batches__"] = v
+                return jax.tree_util.tree_map(
+                    lambda t: lax.psum(t, DATA_AXIS), scores)
 
-            self._test_fwd = jax.jit(fwd)
+            self._test_fwd = jax.jit(shard_map(
+                worker, mesh=self.mesh,
+                in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=P(), check_vma=False))
         sharding = batch_sharded(self.mesh)
         local_workers = max(self.n_workers // jax.process_count(), 1)
         totals: dict[str, Any] = {}
+        last_raw: dict[str, Any] | None = None
         for _ in range(num_steps):
             batch = {}
-            for k, v in next(feed).items():
+            try:
+                raw = dict(next(feed))
+            except StopIteration:
+                # every step is a collective, so all hosts must take the
+                # same num_steps (pass the global max — cluster.global_max);
+                # a host whose local feed ran out keeps participating with
+                # fully-invalid padding steps
+                if last_raw is None:
+                    raise ValueError(
+                        "eval feed yielded no batches but num_steps > 0")
+                raw = dict(last_raw)
+                raw["__valid__"] = np.zeros(local_workers, np.float32)
+            valid = np.asarray(raw.pop("__valid__",
+                                       np.ones(local_workers)), np.float32)
+            last_raw = dict(raw)
+            if valid.shape != (local_workers,):
+                raise ValueError(
+                    f"__valid__ must have shape ({local_workers},) — one "
+                    f"flag per local worker — got {valid.shape}")
+            for k, v in raw.items():
                 if v.shape[0] % local_workers:
                     raise ValueError(
                         f"{k}: eval batch {v.shape[0]} not divisible by "
                         f"{local_workers} local workers")
                 batch[k] = stage_local(v, sharding)
-            scores = self._test_fwd(self.params, batch)
+            scores = self._test_fwd(self.params, batch,
+                                    stage_local(valid, sharding))
             for k, v in scores.items():
                 val = float(v) if np.ndim(v) == 0 else np.asarray(v)
                 totals[k] = val if k not in totals else totals[k] + val

@@ -649,6 +649,7 @@ class SolverParameter:
     momentum2: float = 0.999
     rms_decay: float = 0.99
     debug_info: bool = False
+    snapshot_format: str = "BINARYPROTO"  # or HDF5 (caffe.proto:240-244)
 
     @classmethod
     def from_pmsg(cls, m: PMessage) -> "SolverParameter":
@@ -689,6 +690,8 @@ class SolverParameter:
             momentum2=float(m.get("momentum2", 0.999)),
             rms_decay=float(m.get("rms_decay", 0.99)),
             debug_info=bool(m.get("debug_info", False)),
+            snapshot_format=str(m.get("snapshot_format",
+                                      "BINARYPROTO")).upper(),
         )
         if m.has("train_state"):
             sp.train_state = NetState.from_pmsg(m.get("train_state"))

@@ -272,8 +272,9 @@ class Solver:
         """Weights-only load (Net::CopyTrainedLayersFrom; reference:
         net.cpp:843-848, Net.scala:195-197): copy blobs for layers whose
         names match, leave the rest initialized.  Accepts the repo's npz
-        checkpoints AND Caffe ``.caffemodel``/binaryproto files (sniffed by
-        magic; net.cpp:805-848), including V1-format zoo models."""
+        checkpoints, Caffe ``.caffemodel``/binaryproto files (sniffed by
+        magic; net.cpp:805-848) including V1-format zoo models, AND
+        ``.caffemodel.h5`` HDF5 models (net.cpp:889-924)."""
         with open(path, "rb") as f:
             magic = f.read(4)
         if magic[:2] == b"PK":  # npz (zip) — framework-native checkpoint
@@ -283,6 +284,11 @@ class Solver:
             for k, v in saved.items():
                 if k in self.params:
                     self.params[k] = [jnp.asarray(b) for b in v]
+            return
+        if magic == b"\x89HDF":  # .caffemodel.h5 (CopyTrainedLayersFromHDF5,
+            # net.cpp:889-924)
+            from ..data.hdf5 import load_model_hdf5
+            self.copy_trained_layers_from(load_model_hdf5(path))
             return
         from ..proto.caffemodel import load_caffemodel
         self.copy_trained_layers_from(load_caffemodel(path))
@@ -344,9 +350,9 @@ class Solver:
             blobs[pos] = arr
             self.params[key] = blobs
 
-    # -- Caffe-format snapshots (Solver::Snapshot/Restore with
-    #    snapshot_format=BINARYPROTO; reference: solver.cpp:447-530,
-    #    sgd_solver.cpp:242-296) -------------------------------------------
+    # -- Caffe-format snapshots (Solver::Snapshot/Restore, both
+    #    snapshot_format values: BINARYPROTO and HDF5; reference:
+    #    solver.cpp:447-530, sgd_solver.cpp:242-338) -----------------------
     _HISTORY_SLOTS = {
         "SGD": ("history",), "NESTEROV": ("history",),
         "ADAGRAD": ("history",), "RMSPROP": ("history",),
@@ -367,12 +373,16 @@ class Solver:
 
     def snapshot_caffe(self, prefix: str | None = None) -> tuple[str, str]:
         """Write ``<prefix>_iter_N.caffemodel`` + ``.solverstate`` exactly as
-        Solver::Snapshot names them (reference: solver.cpp:461-476)."""
+        Solver::Snapshot names them (reference: solver.cpp:461-476), or the
+        ``.caffemodel.h5`` + ``.solverstate.h5`` pair when
+        ``snapshot_format: HDF5`` (solver.cpp:449-459 SnapshotToHDF5,
+        sgd_solver.cpp:275-298)."""
         from ..proto.caffemodel import save_caffemodel, save_solverstate
         prefix = prefix if prefix is not None else self.sp.snapshot_prefix
         base = f"{prefix}_iter_{self.iter}"
-        model_path = base + ".caffemodel"
-        state_path = base + ".solverstate"
+        hdf5 = self.sp.snapshot_format == "HDF5"
+        model_path = base + (".caffemodel.h5" if hdf5 else ".caffemodel")
+        state_path = base + (".solverstate.h5" if hdf5 else ".solverstate")
         net_param = self.sp.net_param or self.sp.train_net_param
         # Net::ToProto writes every layer with its FULL blob list (sharer
         # layers repeat shared blobs), so Caffe's CopyTrainedLayersFrom
@@ -383,18 +393,28 @@ class Solver:
             blobs = self.train_net.node_params(self.params, node)
             if blobs:
                 full[node.lp.name] = blobs
-        save_caffemodel(model_path, full, net_param)
-        save_solverstate(state_path, self.iter, self._history_flat(),
-                         learned_net=model_path)
+        if hdf5:
+            from ..data.hdf5 import save_model_hdf5, save_state_hdf5
+            save_model_hdf5(model_path, full)
+            save_state_hdf5(state_path, self.iter, self._history_flat(),
+                            learned_net=model_path)
+        else:
+            save_caffemodel(model_path, full, net_param)
+            save_solverstate(state_path, self.iter, self._history_flat(),
+                             learned_net=model_path)
         return model_path, state_path
 
     def restore_caffe(self, state_path: str) -> None:
-        """Restore from a ``.solverstate`` (+ its learned_net caffemodel if
-        present; reference: solver.cpp:510-530, sgd_solver.cpp:280-296)."""
+        """Restore from a ``.solverstate`` / ``.solverstate.h5`` (+ its
+        learned_net model if present; reference: solver.cpp:510-530,
+        sgd_solver.cpp:280-296 binaryproto, :321-338 HDF5 — dispatched on
+        the HDF5 magic like caffe dispatches on the .h5 suffix)."""
         import os
 
+        from ..data.hdf5 import is_hdf5_file, load_state_hdf5
         from ..proto.caffemodel import load_solverstate
-        st = load_solverstate(state_path)
+        st = (load_state_hdf5(state_path) if is_hdf5_file(state_path)
+              else load_solverstate(state_path))
         history = st["history"]
         slots = self._HISTORY_SLOTS[self.rule.name]
         n_blobs = sum(len(v) for v in self.params.values())

@@ -225,7 +225,8 @@ def _run_distributed(args, sp0, raw_net, train_b, test_b, train_x, train_y,
             loss = trainer.train_round(feed.next_round())
             it += args.tau
         totals = trainer.test(test_factory(), test_steps)
-        acc = totals.get("accuracy", 0.0) / test_steps
+        from ..apps.common import normalize_scores
+        acc = normalize_scores(totals, test_steps).get("accuracy", 0.0)
         record(it, loss, acc)
 
 

@@ -20,6 +20,11 @@ def main() -> None:
     ap.add_argument("--out", required=True)
     ap.add_argument("--local-devices", type=int, default=None,
                     help="single-process mode: virtual CPU device count")
+    ap.add_argument("--expect-devices", type=int, default=4,
+                    help="global device count the mesh must have")
+    ap.add_argument("--fail-rank", type=int, default=None,
+                    help="failure-path mode: this rank dies (exit 3) after "
+                         "the first round")
     args = ap.parse_args()
 
     if args.local_devices:
@@ -45,7 +50,8 @@ def main() -> None:
     distributed = init_cluster_from_env()
     mesh = make_mesh()
     n_devices = mesh.shape["data"]
-    assert n_devices == 4, f"expected 4 global devices, got {n_devices}"
+    assert n_devices == args.expect_devices, (
+        f"expected {args.expect_devices} global devices, got {n_devices}")
 
     GLOBAL_BATCH, TAU, ROUNDS = 16, 2, 2
     sp = load_solver_prototxt_with_net(
@@ -58,7 +64,7 @@ def main() -> None:
 
     rng = np.random.default_rng(0)  # identical stream on every process
     losses = []
-    for _ in range(ROUNDS):
+    for r in range(ROUNDS):
         y = rng.integers(0, 10, size=(TAU, GLOBAL_BATCH))
         x = rng.normal(scale=0.3, size=(TAU, GLOBAL_BATCH, 1, 28, 28)
                        ).astype(np.float32)
@@ -67,6 +73,11 @@ def main() -> None:
                 x[t, i, :, int(k) % 28, :] += 2.0
         losses.append(tr.train_round(
             {"data": x[:, rows], "label": y[:, rows].astype(np.float32)}))
+        if r == 0 and args.fail_rank is not None \
+                and jax.process_index() == args.fail_rank:
+            print(f"driver: rank {args.fail_rank} dying (failure-path test)",
+                  flush=True)
+            os._exit(3)
 
     eval_y = rng.integers(0, 10, size=(GLOBAL_BATCH,))
     eval_x = rng.normal(scale=0.3, size=(GLOBAL_BATCH, 1, 28, 28)

@@ -131,7 +131,9 @@ def test_distributed_test_aggregation(np_rng):
                             for i in range(0, 64, 32)])
     scores = tr.test(feed, num_steps=2)
     assert "accuracy" in scores
-    assert 0.0 <= scores["accuracy"] / 2 <= 1.0
+    # raw worker-batch sums + count (ImageNetApp.scala:139-140 contract)
+    assert scores["__test_batches__"] == 16  # 8 workers × 2 steps
+    assert 0.0 <= scores["accuracy"] / scores["__test_batches__"] <= 1.0
 
 
 def test_trainer_snapshot_restore(tmp_path, np_rng):
@@ -361,3 +363,49 @@ def test_device_preprocess_crop_sized_mean(np_rng):
     bad = device_crop_mirror_mean(crop, mean=np.zeros((1, 5, 5), np.float32))
     with pytest.raises(ValueError, match="matches neither"):
         bad({"data": x}, jax.random.PRNGKey(0))
+
+
+def test_uneven_partition_eval_matches_per_worker_truth(np_rng):
+    """Reference semantics for unequal partitions (each zipPartitions
+    worker tests its OWN `len` batches — ImageNetApp.scala:108-141): the
+    masked SPMD eval must equal per-worker truth computed one partition
+    at a time on a 1-device mesh."""
+    from sparknet_tpu.apps.common import eval_feed
+    from sparknet_tpu.data.partition import PartitionedDataset
+
+    def mk_items(n, seed):
+        r = np.random.default_rng(seed)
+        return [(r.normal(size=(1, 28, 28)).astype(np.float32),
+                 float(r.integers(0, 10))) for _ in range(n)]
+
+    # sizes 6,4,4,2 with batch 2 -> per-worker steps 3,2,2,1; lockstep 3
+    parts = [mk_items(6, 0), mk_items(4, 1), mk_items(4, 2), mk_items(2, 3)]
+    ds = PartitionedDataset(parts)
+    factory, steps = eval_feed(ds, per_worker_batch=2)
+    assert steps == 3
+
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8))
+    tr = DistributedTrainer(sp, make_mesh(4), TrainerConfig(), seed=0)
+    totals = tr.test(factory(), steps)
+    assert totals["__test_batches__"] == 8.0  # 3+2+2+1
+
+    # ground truth: a single-worker mesh scores each partition's batches
+    sp1 = load_solver_prototxt_with_net(SOLVER_TXT, lenet(2, 2))
+    tr1 = DistributedTrainer(sp1, make_mesh(1), TrainerConfig(), seed=0)
+    for k in tr.params:  # identical weights
+        for a, b in zip(tr.params[k], tr1.params[k]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    truth: dict = {}
+    for p in parts:
+        for t in range(len(p) // 2):
+            recs = p[t * 2:(t + 1) * 2]
+            feed1 = iter([{
+                "data": np.stack([r[0] for r in recs]),
+                "label": np.asarray([r[1] for r in recs], np.float32)}])
+            s = tr1.test(feed1, 1)
+            for k, v in s.items():
+                truth[k] = truth.get(k, 0.0) + v
+    assert truth.pop("__test_batches__") == 8.0
+    for k, v in truth.items():
+        np.testing.assert_allclose(totals[k], v, rtol=1e-5, atol=1e-6,
+                                   err_msg=k)

@@ -93,3 +93,75 @@ def test_bridge_protocol_check():
 def test_spark_context_gated():
     with pytest.raises(ImportError, match="pyspark"):
         spark_context()
+
+
+class StreamingFakeRDD(FakeRDD):
+    """Live-pyspark shape: mapPartitionsWithIndex results support
+    toLocalIterator; whole-result collect() is forbidden (locality
+    tripwire — ImageNetApp.scala:145 zipPartitions never funnels records
+    through the driver)."""
+
+    def mapPartitionsWithIndex(self, f):
+        out = []
+        for i, p in enumerate(self.partitions):
+            out.append(list(f(i, iter(p))))
+        return _StreamingCollected(out)
+
+
+class _StreamingCollected(_Collected):
+    def toLocalIterator(self):
+        for p in self.parts:
+            yield from p
+
+    def collect(self):
+        # metadata-sized collects (spill counts) are fine; records are not
+        flat = [x for p in self.parts for x in p]
+        for x in flat:
+            assert isinstance(x, tuple) and len(x) == 2 and \
+                isinstance(x[1], int) and not hasattr(x[0], "shape"), \
+                f"record-bearing collect() reached the driver: {x!r}"
+        return flat
+
+
+def test_bridge_streams_partitions_not_collect():
+    """With toLocalIterator available (live pyspark), no record-bearing
+    collect() runs — partitions stream one at a time."""
+    recs = _records(12)
+    bridge = SparkPartitionBridge(StreamingFakeRDD([recs]), num_workers=4,
+                                  process_index=0, num_processes=2)
+    ds = bridge.to_local_dataset()
+    assert ds.num_partitions == 2
+    got = sorted(r[1] for p in ds.partitions for r in p)
+    # owns partitions 0 and 2 of round-robin coalesce over 12 records
+    assert len(got) == 6
+
+
+def test_bridge_spill_dir_keeps_records_off_driver(tmp_path):
+    """spill_dir tier: executors pickle partitions to a shared path;
+    the driver sees only (index, count) metadata (asserted by the
+    tripwire collect), and each host reads only owned files."""
+    recs = _records(16)
+    rdd = StreamingFakeRDD([recs])
+    b0 = SparkPartitionBridge(rdd, 4, process_index=0, num_processes=2)
+    b1 = SparkPartitionBridge(rdd, 4, process_index=1, num_processes=2)
+    d0 = b0.to_local_dataset(spill_dir=str(tmp_path))
+    d1 = b1.to_local_dataset(spill_dir=str(tmp_path))
+    assert d0.num_partitions == 2 and d1.num_partitions == 2
+    got = sorted(r[1] for p in d0.partitions + d1.partitions for r in p)
+    assert got == sorted(r[1] for r in recs)  # disjoint + complete
+    import os
+    assert sorted(os.listdir(tmp_path)) == (
+        ["_meta.json"] + [f"part-{i:05d}.pkl" for i in range(4)])
+
+
+def test_bridge_spill_transform_applied_worker_side(tmp_path):
+    recs = _records(6)
+    bridge = SparkPartitionBridge(StreamingFakeRDD([recs]), num_workers=2)
+    counts = bridge.spill_to(str(tmp_path),
+                             transform=lambda r: (r[0] * 3, r[1]))
+    assert counts == [3, 3]
+    ds = bridge.to_local_dataset(spill_dir=str(tmp_path))
+    # transform already baked into the spill; reading applies nothing more
+    ds2 = bridge.to_local_dataset(spill_dir=str(tmp_path))
+    v = float(ds.partitions[0][1][0].max())
+    assert v % 3 == 0 and ds2.count() == 6

@@ -223,6 +223,53 @@ def test_solver_caffe_snapshot_restore_equivalence(tmp_path):
                                        rtol=2e-4, atol=2e-5)
 
 
+def test_solver_hdf5_snapshot_restore_equivalence(tmp_path):
+    """``snapshot_format: HDF5`` writes .caffemodel.h5/.solverstate.h5 in
+    the reference layout (solver.cpp:449-459 SnapshotToHDF5,
+    sgd_solver.cpp:275-338, net.cpp:926-975 ToHDF5) and restores to the
+    exact state the binaryproto path restores to."""
+    h5py = pytest.importorskip("h5py")
+    sp_txt = SOLVER_TXT + "snapshot_format: HDF5\n"
+    sp = load_solver_prototxt_with_net(sp_txt, lenet(4, 4))
+    assert sp.snapshot_format == "HDF5"
+    a = Solver(sp, seed=0)
+    a.set_train_data(_feed())
+    a.step(3)
+    model, state = a.snapshot_caffe(str(tmp_path / "snap"))
+    assert model.endswith(".caffemodel.h5")
+    assert state.endswith(".solverstate.h5")
+
+    # reference on-disk layout: data/<layer>/<i> groups, history/<i>
+    with h5py.File(model) as f:
+        assert "conv1" in f["data"] and "0" in f["data"]["conv1"]
+    with h5py.File(state) as f:
+        assert int(np.asarray(f["iter"])) == 3 and "0" in f["history"]
+
+    # cross-format: restoring h5 == restoring binaryproto
+    bp = _solver()
+    bp_model, bp_state = None, None
+    a.sp.snapshot_format = "BINARYPROTO"
+    bp_model, bp_state = a.snapshot_caffe(str(tmp_path / "snap_bp"))
+
+    h = _solver()
+    h.load_weights(model)
+    h.restore_caffe(state)
+    bp.load_weights(bp_model)
+    bp.restore_caffe(bp_state)
+    assert h.iter == bp.iter == 3
+    for k in h.params:
+        for x, y in zip(h.params[k], bp.params[k]):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-6)
+    for slot in h.state:
+        if slot == "iter":
+            continue
+        for k in h.state[slot]:
+            for x, y in zip(h.state[slot][k], bp.state[slot][k]):
+                np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                           rtol=1e-6)
+
+
 SIAMESE_SOLVER_NET = """
 name: "siamese"
 layer { name: "d" type: "JavaData" top: "a" top: "label"
