@@ -16,9 +16,21 @@ timeout, retries with backoff, and on exhaustion emits a diagnostic JSON
 line instead of a stack trace.  A persistent XLA compilation cache makes
 retried attempts cheap.
 
+Both compute dtypes are measured in one run: f32 (the reference's
+numerics) and bf16 mixed precision (the idiomatic TPU mode — params,
+losses and BN stats stay f32; measured 28% less device time with the
+same convergence, see tests/test_e2e.py bf16 trajectory test).  The
+headline number is the faster (bf16), like the reference's headline was
+its fastest engine (cuDNN); the f32 block is reported alongside.
+
+Rep blocks are dispatched WITHOUT host sync between them (async JAX
+dispatch, the production dispatch pattern) so the tunneled chip's
+~100 ms per-call RPC latency doesn't bill against device throughput;
+timing spans first dispatch to final block_until_ready.
+
 Env knobs (for smoke-testing): BENCH_PLATFORM=cpu, BENCH_MODEL=lenet,
 BENCH_BATCH, BENCH_ITERS, BENCH_REPS, BENCH_TIMEOUT_S, BENCH_ATTEMPTS,
-BENCH_DTYPE=bf16 (mixed-precision compute — params/loss stay f32).
+BENCH_DTYPE=f32|bf16 (restrict to one compute dtype).
 """
 
 from __future__ import annotations
@@ -47,15 +59,11 @@ BATCH = int(os.environ.get("BENCH_BATCH", 256))
 ITERS = int(os.environ.get("BENCH_ITERS", 20))
 REPS = int(os.environ.get("BENCH_REPS", 5))  # tunneled chip: ~2x run-to-run
 MODEL = os.environ.get("BENCH_MODEL", "caffenet")
-
-# bf16 peak by device kind, for the MFU denominator (public spec sheets).
-_PEAK_FLOPS = {
-    "TPU v5 lite": 197e12, "TPU v5e": 197e12,
-    "TPU v5p": 459e12, "TPU v5": 459e12,
-    "TPU v4": 275e12, "TPU v4 lite": 138e12,
-    "TPU v3": 123e12, "TPU v2": 46e12,
-    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
-}
+DTYPE = os.environ.get("BENCH_DTYPE")
+if DTYPE not in (None, "", "f32", "bf16"):
+    print(f"[bench] BENCH_DTYPE={DTYPE!r} invalid (use f32 or bf16)",
+          file=sys.stderr)
+    sys.exit(2)
 
 
 def _log(msg: str) -> None:
@@ -83,141 +91,199 @@ def run_child() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from sparknet_tpu.models import caffenet, googlenet, lenet, vgg16
     from sparknet_tpu.proto import load_solver_prototxt_with_net
     from sparknet_tpu.solvers import Solver
+    from sparknet_tpu.utils.profiling import (
+        BENCH_SOLVER_PROTOTXT,
+        build_bench_model,
+        peak_flops,
+        scanned_train_block,
+        step_cost_flops,
+    )
 
-    # baselines for the extra models: GoogLeNet K40+cuDNN fwd+bwd avg
-    # 1123.8 ms @ batch 128 (caffe/models/bvlc_googlenet/readme.md:24-27)
-    if MODEL == "lenet":
-        net, in_shape, classes = lenet(BATCH, BATCH), (1, 28, 28), 10
-    elif MODEL == "googlenet":
-        net, in_shape, classes = (googlenet(BATCH, BATCH, crop=224),
-                                  (3, 224, 224), 1000)
-    elif MODEL == "vgg16":
-        net, in_shape, classes = (vgg16(BATCH, BATCH, crop=224),
-                                  (3, 224, 224), 1000)
-    else:
-        net, in_shape, classes = caffenet(BATCH, BATCH), (3, 227, 227), 1000
-
-    sp = load_solver_prototxt_with_net(
-        'base_lr: 0.01\nmomentum: 0.9\nweight_decay: 0.0005\n'
-        'lr_policy: "step"\ngamma: 0.1\nstepsize: 100000\n', net)
-    dtype = os.environ.get("BENCH_DTYPE")
-    solver = Solver(sp, seed=0,
-                    compute_dtype=jnp.bfloat16 if dtype == "bf16" else None)
+    net, in_shape, classes = build_bench_model(MODEL, BATCH)
+    sp = load_solver_prototxt_with_net(BENCH_SOLVER_PROTOTXT, net)
+    peak = peak_flops(dev.device_kind)
+    scan = os.environ.get("BENCH_SCAN", "1") != "0"
+    windows = int(os.environ.get("BENCH_WINDOWS", 3))
 
     rng = np.random.default_rng(0)
     data = jnp.asarray(rng.normal(size=(1, BATCH) + in_shape).astype(np.float32))
     label = jnp.asarray(rng.integers(0, classes, size=(1, BATCH)).astype(np.float32))
     batch = {"data": data, "label": label}
 
-    # train step: compile (cached across attempts), then measure
-    step_rng = jax.random.PRNGKey(0)
-    params, state = solver.params, solver.state
-    t0 = time.perf_counter()
-    flops_per_step = None
-    try:
-        lowered = solver._step.lower(params, state, 0, batch,
-                                     jax.random.PRNGKey(1))
-        cost = lowered.compile().cost_analysis()
-        if cost:
-            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-            flops_per_step = float(cost.get("flops", 0.0)) or None
-    except Exception as e:  # cost analysis is best-effort
-        _log(f"cost_analysis unavailable: {e}")
-
-    # The framework's production execution model is a scanned multi-step
-    # round in ONE compiled program (DistributedTrainer.train_round) — the
-    # bench block runs the same way unless BENCH_SCAN=0 falls back to
-    # per-step dispatch.
-    scan = os.environ.get("BENCH_SCAN", "1") != "0"
-    raw_step = solver.make_train_step()
-
-    if scan:
-        from jax import lax
-
-        def block_fn(params, state, it0, batch, rng):
-            def body(i, carry):
-                params, state, rng, _loss = carry
-                rng, sub = jax.random.split(rng)
-                params, state, loss = raw_step(params, state, it0 + i,
-                                               batch, sub)
-                return (params, state, rng, loss)
-            return lax.fori_loop(0, ITERS, body,
-                                 (params, state, rng, jnp.zeros(())))
-        block = jax.jit(block_fn, donate_argnums=(0, 1))
-
-        def run_block(params, state, it0, rng):
-            params, state, rng, loss = block(params, state, it0, batch, rng)
-            return params, state, rng, loss
-    else:
-        def run_block(params, state, it0, rng):
-            loss = None
-            for i in range(ITERS):
-                rng, sub = jax.random.split(rng)
-                params, state, loss = solver._step(params, state, it0 + i,
-                                                   batch, sub)
-            return params, state, rng, loss
-
-    params, state, step_rng, loss = run_block(params, state, 0, step_rng)
-    jax.block_until_ready(loss)
-    _log(f"train compile+warmup in {time.perf_counter() - t0:.1f}s "
-         f"(scan={scan})")
-
-    rates, blocks = [], []
-    it = ITERS
-    for rep in range(REPS):
+    def measure(dtype: str) -> dict:
+        solver = Solver(sp, seed=0,
+                        compute_dtype=jnp.bfloat16 if dtype == "bf16" else None)
+        step_rng = jax.random.PRNGKey(0)
+        params, state = solver.params, solver.state
         t0 = time.perf_counter()
-        params, state, step_rng, loss = run_block(params, state, it, step_rng)
+        flops_per_step = step_cost_flops(solver, batch)
+
+        # The framework's production execution model is a scanned
+        # multi-step round in ONE compiled program
+        # (DistributedTrainer.train_round) — the bench block runs the same
+        # way unless BENCH_SCAN=0 falls back to per-step dispatch.
+        if scan:
+            block = scanned_train_block(solver, ITERS)
+
+            def run_block(params, state, it0, rng):
+                params, state, rng, loss = block(params, state, it0, batch,
+                                                 rng)
+                return params, state, rng, loss
+        else:
+            def run_block(params, state, it0, rng):
+                loss = None
+                for i in range(ITERS):
+                    rng, sub = jax.random.split(rng)
+                    params, state, loss = solver._step(params, state,
+                                                       it0 + i, batch, sub)
+                return params, state, rng, loss
+
+        params, state, step_rng, loss = run_block(params, state, 0, step_rng)
         jax.block_until_ready(loss)
-        it += ITERS
-        dt = time.perf_counter() - t0
-        blocks.append(dt * (20 / ITERS))  # normalize to the 20-iter protocol
-        rates.append(BATCH * ITERS / dt)
-        _log(f"train rep {rep + 1}/{REPS}: {rates[-1]:.1f} img/s "
-             f"({dt:.2f}s / {ITERS} iters)")
+        _log(f"[{dtype}] train compile+warmup in "
+             f"{time.perf_counter() - t0:.1f}s (scan={scan})")
 
-    # eval pass (test-net forward only; performance_hardware.md:20,25)
-    eval_batch = {"data": data[0], "label": label[0]}
-    t0 = time.perf_counter()
-    out = solver._test_fwd(params, eval_batch)
-    jax.block_until_ready(out)
-    _log(f"eval compile in {time.perf_counter() - t0:.1f}s")
-    eval_rates = []
-    for rep in range(REPS):
+        # Per window: REPS blocks dispatched back-to-back, one sync at the
+        # end (async dispatch — the production dispatch pattern).  Median
+        # over windows rejects transient tunnel/host stalls.
+        it = ITERS
+        window_dts = []
+        for win in range(windows):
+            t0 = time.perf_counter()
+            for rep in range(REPS):
+                params, state, step_rng, loss = run_block(params, state, it,
+                                                          step_rng)
+                it += ITERS
+            jax.block_until_ready(loss)
+            window_dts.append(time.perf_counter() - t0)
+            _log(f"[{dtype}] train window {win + 1}/{windows}: "
+                 f"{BATCH * ITERS * REPS / window_dts[-1]:.1f} img/s "
+                 f"({window_dts[-1]:.2f}s / {REPS}x{ITERS} iters)")
+        dt = float(np.median(window_dts))
+        img_s = BATCH * ITERS * REPS / dt
+        block_s = dt / REPS * (20 / ITERS)  # normalized 20-iter protocol
+
+        # eval pass (test-net forward only; performance_hardware.md:20,25)
+        # — same windows-median outlier rejection as train
+        eval_batch = {"data": data[0], "label": label[0]}
         t0 = time.perf_counter()
-        for _ in range(ITERS):
-            out = solver._test_fwd(params, eval_batch)
+        out = solver._test_fwd(params, eval_batch)
         jax.block_until_ready(out)
-        eval_rates.append(BATCH * ITERS / (time.perf_counter() - t0))
-        _log(f"eval rep {rep + 1}/{REPS}: {eval_rates[-1]:.1f} img/s")
+        _log(f"[{dtype}] eval compile in {time.perf_counter() - t0:.1f}s")
+        eval_dts = []
+        for _win in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(ITERS * REPS):
+                out = solver._test_fwd(params, eval_batch)
+            jax.block_until_ready(out)
+            eval_dts.append(time.perf_counter() - t0)
+        eval_img_s = BATCH * ITERS * REPS / float(np.median(eval_dts))
+        _log(f"[{dtype}] eval: {eval_img_s:.1f} img/s")
 
-    img_s = float(np.median(rates))
-    block_s = float(np.median(blocks))
-    eval_img_s = float(np.median(eval_rates))
-    step_s = block_s / 20.0
-    peak = _PEAK_FLOPS.get(dev.device_kind)
-    mfu = (flops_per_step / step_s / peak) if (flops_per_step and peak) else None
+        step_s = block_s / 20.0
+        mfu = (flops_per_step / step_s / peak
+               if (flops_per_step and peak) else None)
+        return {
+            "images_per_sec": round(img_s, 1),
+            "block_20x256_s": round(block_s, 3),
+            "eval_images_per_sec": round(eval_img_s, 1),
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "flops_per_step": flops_per_step,
+        }
 
+    def measure_feed(dtype: str, compute_s: float) -> dict:
+        """Sustained throughput with the feed IN the loop: distinct host
+        batches flow host→HBM through the production prefetch path
+        (data/prefetch.device_feed → Solver.set_train_data → step), fixing
+        the reference's synchronous-callback feed
+        (java_data_layer.cpp:36-44) with a measurement, not a design
+        claim.  Overlap% compares the per-step total against feed-alone
+        and compute-alone times."""
+        import itertools
+
+        from sparknet_tpu.data import device_feed
+
+        solver = Solver(sp, seed=0,
+                        compute_dtype=jnp.bfloat16 if dtype == "bf16" else None)
+        m = 4
+        host = [{"data": rng.normal(size=(BATCH,) + in_shape
+                                    ).astype(np.float32),
+                 "label": rng.integers(0, classes, size=BATCH
+                                       ).astype(np.float32)}
+                for _ in range(m)]
+        feed_iters = int(os.environ.get("BENCH_FEED_ITERS", 8))
+
+        # feed-alone: host->HBM transfer time per batch with the transfers
+        # dispatched back-to-back (pipelined, like the prefetch thread
+        # issues them) — a per-batch synchronous measure would overstate
+        # the baseline and inflate the overlap figure
+        staged = [jax.device_put(hb) for hb in host]  # warm transfer path
+        jax.block_until_ready(staged)
+        del staged
+        t0 = time.perf_counter()
+        jax.block_until_ready([jax.device_put(hb) for hb in host])
+        feed_alone = (time.perf_counter() - t0) / m
+
+        solver.set_train_data(device_feed(iter(
+            itertools.islice(itertools.cycle(host), feed_iters + 2))))
+        solver.step(2)  # warmup/compile
+        t0 = time.perf_counter()
+        solver.step(feed_iters)
+        total = (time.perf_counter() - t0) / feed_iters
+        # overlap fraction: 1.0 when total == max(feed, compute) (perfect
+        # pipeline), 0.0 when total == feed + compute (fully serial)
+        denom = min(feed_alone, compute_s) or 1.0
+        overlap = (feed_alone + compute_s - total) / denom * 100.0
+        bound = "feed" if feed_alone > compute_s else "compute"
+        out = {
+            "images_per_sec": round(BATCH / total, 1),
+            "step_s": round(total, 4),
+            "feed_alone_s_per_batch": round(feed_alone, 4),
+            "compute_s_per_step": round(compute_s, 4),
+            "bound": bound,
+            "overlap_pct": round(max(0.0, min(100.0, overlap)), 1),
+        }
+        _log(f"[{dtype}] feed-in-loop: {out['images_per_sec']} img/s "
+             f"(feed-alone {feed_alone:.3f}s, compute {compute_s:.4f}s, "
+             f"{bound}-bound, overlap {out['overlap_pct']}%)")
+        return out
+
+    dtypes = [DTYPE] if DTYPE in ("f32", "bf16") else ["bf16", "f32"]
+    runs = {d: measure(d) for d in dtypes}
+    best = max(dtypes, key=lambda d: runs[d]["images_per_sec"])
+    b = runs[best]
+    feed = None
+    if os.environ.get("BENCH_FEED", "1") != "0":
+        try:
+            feed = measure_feed(best, b["block_20x256_s"] / 20.0)
+        except Exception as e:  # the feed tier must not sink the bench
+            _log(f"feed measurement failed: {e}")
+            feed = {"error": str(e)}
     result = {
         "metric": f"{MODEL}_train_images_per_sec",
-        "value": round(img_s, 1),
+        "value": b["images_per_sec"],
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 2)
+        "vs_baseline": round(b["images_per_sec"] / BASELINE_IMG_S, 2)
         if BASELINE_IMG_S else None,
-        "block_20x256_s": round(block_s, 3),
+        "block_20x256_s": b["block_20x256_s"],
         "baseline_block_s": BASELINE_BLOCK_S,
-        "eval_images_per_sec": round(eval_img_s, 1),
-        "eval_vs_baseline": round(eval_img_s / BASELINE_EVAL_IMG_S, 2)
+        "eval_images_per_sec": b["eval_images_per_sec"],
+        "eval_vs_baseline": round(b["eval_images_per_sec"] / BASELINE_EVAL_IMG_S, 2)
         if BASELINE_EVAL_IMG_S else None,
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "flops_per_step": flops_per_step,
+        "mfu": b["mfu"],
+        "flops_per_step": b["flops_per_step"],
         "device": f"{dev.platform}/{dev.device_kind}",
-        "dtype": dtype or "f32",
+        "dtype": best,
+        "dtype_note": ("mixed precision; f32 master params/losses/BN stats"
+                       if best == "bf16" else None),
         "batch": BATCH,
         "iters_per_block": ITERS,
         "reps": REPS,
+        "windows": windows,
+        "by_dtype": runs,
+        "feed_in_loop": feed,
     }
     print(json.dumps(result), flush=True)
 

@@ -58,6 +58,59 @@ def conv_geometry(lp: LayerParameter):
     return kh, kw, sh, sw, ph, pw, dh, dw, num_output, group, bias_term
 
 
+def _s2d_eligible(c_in: int, kh, kw, sh, sw, ph, pw, dh, dw, group) -> bool:
+    """Space-to-depth rewrite pays off when the input-channel count starves
+    the MXU's 128-wide contraction (RGB stems: C=3 → C·s² after regroup).
+
+    SPARKNET_NO_S2D=1 disables it — read at TRACE time: set it before the
+    net/Solver is built (jit caches the traced graph; flipping the env
+    after compilation has no effect on cached executables)."""
+    import os
+    if os.environ.get("SPARKNET_NO_S2D") == "1":
+        return False
+    return (group == 1 and dh == 1 and dw == 1 and c_in * sh * sw <= 64
+            and (sh > 1 or sw > 1) and kh >= sh and kw >= sw)
+
+
+def _space_to_depth_conv(x, weight, kh, kw, sh, sw, ph, pw):
+    """Stride-s conv as a stride-1 conv on stride-phase-regrouped input.
+
+    Exact rewrite (the MLPerf-era TPU stem trick): zero-pad the kernel up to
+    a stride multiple k' = ceil(k/s)·s, pad/clip the input so its extent is
+    exactly (O-1)·s + k', then fold the s×s stride phases of both operands
+    into channels and convolve with stride 1.  Zero kernel columns multiply
+    only padding, so outputs are identical up to float summation order; the
+    contraction dim grows C → C·s·s (3 → 48 for an 11×11/4 RGB stem),
+    filling MXU lanes that a 3-deep contraction leaves 97% idle.
+    """
+    n, c, h, w = x.shape
+    o = weight.shape[0]
+    kph = -kh % sh  # kernel zero-pad up to the next stride multiple
+    kpw = -kw % sw
+    keh, kew = kh + kph, kw + kpw
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    # input extent consumed by the padded windows ((O-1)·s + k'); the edge
+    # delta vs h+ph can be positive (zero-pad) or negative (clip unused rows)
+    hi_h = (oh - 1) * sh + keh - h - ph
+    hi_w = (ow - 1) * sw + kew - w - pw
+    zero = jnp.zeros((), x.dtype)
+    x = lax.pad(x, zero, ((0, 0, 0), (0, 0, 0), (ph, hi_h, 0), (pw, hi_w, 0)))
+    hp, wp = x.shape[2], x.shape[3]
+    x = x.reshape(n, c, hp // sh, sh, wp // sw, sw)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(
+        n, c * sh * sw, hp // sh, wp // sw)
+    wz = jnp.zeros((), weight.dtype)
+    weight = lax.pad(weight, wz,
+                     ((0, 0, 0), (0, 0, 0), (0, kph, 0), (0, kpw, 0)))
+    weight = weight.reshape(o, c, keh // sh, sh, kew // sw, sw)
+    weight = jnp.transpose(weight, (0, 1, 3, 5, 2, 4)).reshape(
+        o, c * sh * sw, keh // sh, kew // sw)
+    return lax.conv_general_dilated(
+        x, weight, window_strides=(1, 1), padding=((0, 0), (0, 0)),
+        dimension_numbers=DIMNUMS)
+
+
 @register_layer("Convolution")
 class ConvolutionLayer(LayerImpl):
     """2-D convolution (reference: caffe/src/caffe/layers/conv_layer.cpp;
@@ -89,14 +142,18 @@ class ConvolutionLayer(LayerImpl):
         weight = params[0]
         tops = []
         for x in bottoms:
-            y = lax.conv_general_dilated(
-                x, weight,
-                window_strides=(sh, sw),
-                padding=((ph, ph), (pw, pw)),
-                rhs_dilation=(dh, dw),
-                feature_group_count=group,
-                dimension_numbers=DIMNUMS,
-            )
+            if _s2d_eligible(x.shape[1], kh, kw, sh, sw, ph, pw, dh, dw,
+                             group):
+                y = _space_to_depth_conv(x, weight, kh, kw, sh, sw, ph, pw)
+            else:
+                y = lax.conv_general_dilated(
+                    x, weight,
+                    window_strides=(sh, sw),
+                    padding=((ph, ph), (pw, pw)),
+                    rhs_dilation=(dh, dw),
+                    feature_group_count=group,
+                    dimension_numbers=DIMNUMS,
+                )
             if bias_term:
                 y = y + params[1].reshape(1, -1, 1, 1)
             tops.append(y)
@@ -190,6 +247,13 @@ def _pool_geometry(lp: LayerParameter, bottom_shape: Shape):
 
 
 def max_pool(x, kh, kw, sh, sw, ph, pw, oh, ow):
+    """MAX pooling via ``reduce_window``; backward is XLA's
+    select-and-scatter, which routes each output's gradient to the
+    window's first maximum — Caffe's argmax scan (pooling_layer.cpp
+    Forward_cpu MAX branch).  A hand-unrolled compare/dilated-pad backward
+    was measured SLOWER on TPU v5e (XLA re-reads dy/idx once per kernel
+    tap in the fused form: 3.1 GB vs ~0.6 GB minimum traffic for CaffeNet
+    pool1, 4.2 ms vs 1.1 ms) — keep select-and-scatter."""
     h, w = x.shape[2], x.shape[3]
     pad_hi_h = (oh - 1) * sh + kh - h - ph
     pad_hi_w = (ow - 1) * sw + kw - w - pw

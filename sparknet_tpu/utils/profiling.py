@@ -63,3 +63,87 @@ def save_memory_profile(path: str) -> None:
     """Write a pprof-format device memory profile
     (jax.profiler.save_device_memory_profile)."""
     jax.profiler.save_device_memory_profile(path)
+
+
+# Benchmark-harness pieces shared by bench.py and tools/profile_step.py so
+# the profiled program IS the benchmarked one: model table, solver config,
+# per-step FLOPs estimate, peak table, and the scanned train block.
+
+BENCH_SOLVER_PROTOTXT = (
+    'base_lr: 0.01\nmomentum: 0.9\nweight_decay: 0.0005\n'
+    'lr_policy: "step"\ngamma: 0.1\nstepsize: 100000\n')
+
+
+def build_bench_model(name: str, batch: int):
+    """(net_param, input_shape, num_classes) for a benchmark model name."""
+    from ..models import caffenet, googlenet, lenet, vgg16
+    if name == "lenet":
+        return lenet(batch, batch), (1, 28, 28), 10
+    if name == "googlenet":
+        return googlenet(batch, batch, crop=224), (3, 224, 224), 1000
+    if name == "vgg16":
+        return vgg16(batch, batch, crop=224), (3, 224, 224), 1000
+    if name == "caffenet":
+        return caffenet(batch, batch), (3, 227, 227), 1000
+    raise ValueError(f"unknown bench model {name!r}")
+
+
+def step_cost_flops(solver, batch) -> float | None:
+    """Model FLOPs of one compiled train step via XLA cost analysis
+    (best-effort; a fori_loop block would undercount — cost the single
+    step).  Returns None with a stderr breadcrumb where the backend
+    doesn't support cost analysis."""
+    import sys
+    try:
+        lowered = solver._step.lower(solver.params, solver.state, 0, batch,
+                                     jax.random.PRNGKey(1))
+        cost = lowered.compile().cost_analysis()
+        if cost:
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            return float(cost.get("flops", 0.0)) or None
+    except Exception as e:
+        print(f"[profiling] cost_analysis unavailable: {e}", file=sys.stderr)
+    return None
+
+
+# bf16 peak FLOP/s by device kind (public spec sheets) — the MFU
+# denominator shared by bench.py and tools/profile_step.py.
+_PEAK_FLOPS_BF16 = {
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+    "TPU v5p": 459e12, "TPU v5": 459e12,
+    "TPU v4": 275e12, "TPU v4 lite": 138e12,
+    "TPU v3": 123e12, "TPU v2": 46e12,
+    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device_kind: str) -> float | None:
+    """bf16 peak FLOP/s for a jax device_kind, or None if unknown."""
+    return _PEAK_FLOPS_BF16.get(device_kind)
+
+
+def scanned_train_block(solver, iters: int):
+    """The production-shaped benchmark block: ``iters`` solver steps as ONE
+    compiled fori_loop with donated params/state — the same execution model
+    as DistributedTrainer.train_round.  Shared by bench.py and
+    tools/profile_step.py so the profiled program IS the benchmarked one.
+
+    Returns ``block(params, state, it0, batch, rng) -> (params, state,
+    rng, loss)``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    raw_step = solver.make_train_step()
+
+    def block_fn(params, state, it0, batch, rng):
+        def body(i, carry):
+            params, state, rng, _loss = carry
+            rng, sub = jax.random.split(rng)
+            params, state, loss = raw_step(params, state, it0 + i,
+                                           batch, sub)
+            return (params, state, rng, loss)
+        return lax.fori_loop(0, iters, body,
+                             (params, state, rng, jnp.zeros(())))
+
+    return jax.jit(block_fn, donate_argnums=(0, 1))

@@ -59,6 +59,51 @@ def test_conv_matches_numpy(rng, np_rng):
     np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
 
 
+def test_space_to_depth_conv_exact(rng, np_rng, monkeypatch):
+    """The stride-phase regroup rewrite (vision._space_to_depth_conv,
+    engaged for small-C strided stems) must match the direct strided conv
+    bitwise-close, forward and gradient, across stem geometries."""
+    from sparknet_tpu.ops import vision
+
+    impl = get_layer_impl("Convolution")
+    geoms = [  # (C, H, W, num_output, k, s, p) — CaffeNet & GoogLeNet stems
+        (3, 35, 35, 8, 11, 4, 0),
+        (3, 32, 32, 8, 7, 2, 3),
+        (2, 17, 19, 4, 5, 3, 1),
+    ]
+    for c, h, w, o, k, s, p in geoms:
+        lp = make("Convolution", convolution_param={
+            "num_output": o, "kernel_size": k, "stride": s, "pad": p})
+        params = impl.init(rng, lp, [(2, c, h, w)])
+        x = jnp.asarray(np_rng.normal(size=(2, c, h, w)).astype(np.float32))
+        assert vision._s2d_eligible(c, k, k, s, s, p, p, 1, 1, 1)
+
+        def loss(pp, xx):
+            return jnp.sum(jnp.sin(impl.apply(lp, pp, [xx], False, None)[0]))
+
+        y1, g1 = jax.value_and_grad(loss)(params, x)
+        monkeypatch.setenv("SPARKNET_NO_S2D", "1")
+        assert not vision._s2d_eligible(c, k, k, s, s, p, p, 1, 1, 1)
+        y2, g2 = jax.value_and_grad(loss)(params, x)
+        monkeypatch.delenv("SPARKNET_NO_S2D")
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=2e-5)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+
+
+def test_space_to_depth_gating():
+    """Grouped, dilated, stride-1, and wide-C convs must NOT be rewritten."""
+    from sparknet_tpu.ops import vision
+    ok = vision._s2d_eligible
+    assert not ok(3, 11, 11, 4, 4, 0, 0, 1, 1, 2)      # grouped
+    assert not ok(3, 11, 11, 4, 4, 0, 0, 2, 2, 1)      # dilated
+    assert not ok(3, 3, 3, 1, 1, 1, 1, 1, 1, 1)        # stride 1
+    assert not ok(64, 3, 3, 2, 2, 1, 1, 1, 1, 1)       # C*s*s > 64
+    assert not ok(3, 2, 2, 4, 4, 0, 0, 1, 1, 1)        # kernel < stride
+    assert ok(3, 11, 11, 4, 4, 0, 0, 1, 1, 1)
+
+
 def test_grouped_conv(rng):
     lp = make("Convolution", convolution_param={
         "num_output": 4, "kernel_size": 1, "group": 2})

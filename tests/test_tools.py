@@ -330,3 +330,41 @@ layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
     out = det.detect_windows([(np.tile(gray[None], (3, 1, 1)),
                                [(0, 0, 10, 10)])])
     assert out[0]["prediction"].shape == (2,)
+
+
+def test_bench_cpu_smoke(tmp_path):
+    """bench.py must emit exactly one valid JSON line on stdout with the
+    documented schema — the contract the benchmark driver consumes."""
+    import subprocess
+    import sys
+    env = dict(os.environ,
+               BENCH_PLATFORM="cpu", BENCH_MODEL="lenet", BENCH_BATCH="4",
+               BENCH_ITERS="1", BENCH_REPS="1", BENCH_WINDOWS="1",
+               BENCH_DTYPE="f32", BENCH_FEED_ITERS="2",
+               BENCH_ATTEMPTS="1", BENCH_TIMEOUT_S="280")
+    env.pop("XLA_FLAGS", None)  # conftest's 8-device flag slows the child
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, os.path.join(root, "bench.py")],
+                          capture_output=True, timeout=300, cwd=root, env=env)
+    assert proc.returncode == 0, proc.stderr.decode()[-800:]
+    lines = proc.stdout.decode().strip().splitlines()
+    assert len(lines) == 1, lines
+    result = json.loads(lines[0])
+    assert result["metric"] == "lenet_train_images_per_sec"
+    assert result["value"] > 0
+    assert result["dtype"] == "f32"
+    assert result["by_dtype"]["f32"]["images_per_sec"] == result["value"]
+    feed = result["feed_in_loop"]
+    assert feed["images_per_sec"] > 0 and "overlap_pct" in feed
+
+
+def test_bench_rejects_bad_dtype():
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py")],
+        capture_output=True, timeout=60, cwd=root,
+        env=dict(os.environ, BENCH_DTYPE="fp32"))
+    assert proc.returncode == 2
+    assert b"BENCH_DTYPE" in proc.stderr

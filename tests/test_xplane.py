@@ -1,0 +1,170 @@
+"""xplane trace parser tests (sparknet_tpu/utils/xplane.py).
+
+Builds a tiny XSpace protobuf by hand (the wire format is the spec:
+tensorflow/tsl/profiler/protobuf/xplane.proto) and checks the headless
+aggregation — plane selection, container exclusion, per-category and
+per-op rollups, and stat decoding incl. the double_value encoding.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from sparknet_tpu.utils import xplane
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(num: int, wire: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | wire) + payload
+
+
+def _len_field(num: int, payload: bytes) -> bytes:
+    return _field(num, 2, _varint(len(payload)) + payload)
+
+
+def _stat(meta_id: int, *, i64=None, dbl=None, s=None) -> bytes:
+    body = _field(1, 0, _varint(meta_id))
+    if i64 is not None:
+        body += _field(4, 0, _varint(i64))
+    if dbl is not None:
+        body += _field(2, 1, struct.pack("<d", dbl))
+    if s is not None:
+        body += _len_field(5, s.encode())
+    return body
+
+
+def _stat_metadata(mid: int, name: str) -> bytes:
+    inner = _field(1, 0, _varint(mid)) + _len_field(2, name.encode())
+    return _field(1, 0, _varint(mid)) + _len_field(2, inner)
+
+
+def _event_metadata(mid: int, name: str, display: str, *stats: bytes) -> bytes:
+    inner = (_field(1, 0, _varint(mid)) + _len_field(2, name.encode())
+             + _len_field(4, display.encode()))
+    for st in stats:
+        inner += _len_field(5, st)
+    return _field(1, 0, _varint(mid)) + _len_field(2, inner)
+
+
+def _event(mid: int, offset_ps: int, dur_ps: int) -> bytes:
+    return (_field(1, 0, _varint(mid)) + _field(2, 0, _varint(offset_ps))
+            + _field(3, 0, _varint(dur_ps)))
+
+
+def _line(name: str, *events: bytes) -> bytes:
+    body = _len_field(2, name.encode())
+    for ev in events:
+        body += _len_field(4, ev)
+    return body
+
+
+# stat metadata ids (arbitrary, resolved by name)
+_CAT, _FLOPS, _BYTES = 24, 27, 31
+
+
+def _plane(name: str, lines: list[bytes], metas: list[bytes],
+           stat_metas: list[bytes]) -> bytes:
+    body = _len_field(2, name.encode())
+    for ln in lines:
+        body += _len_field(3, ln)
+    for m in metas:
+        body += _len_field(4, m)
+    for sm in stat_metas:
+        body += _len_field(5, sm)
+    return body
+
+
+@pytest.fixture()
+def trace_file(tmp_path):
+    stat_metas = [_stat_metadata(_CAT, "hlo_category"),
+                  _stat_metadata(_FLOPS, "flops"),
+                  _stat_metadata(_BYTES, "bytes_accessed")]
+    metas = [
+        _event_metadata(1, "%fusion.3 = f32[8]{...}", "fusion.3",
+                        _stat(_CAT, s="convolution fusion"),
+                        _stat(_FLOPS, i64=10_000_000_000),
+                        _stat(_BYTES, i64=4096)),
+        _event_metadata(2, "%fusion.7 = f32[8]{...}", "fusion.7",
+                        _stat(_CAT, s="convolution fusion"),
+                        _stat(_FLOPS, i64=5_000_000_000),
+                        _stat(_BYTES, i64=2048)),
+        _event_metadata(3, "%while.1 = ...", "while.1",
+                        _stat(_CAT, s="while")),
+        _event_metadata(4, "%copy.2 = ...", "copy.2",
+                        _stat(_CAT, s="copy"),
+                        # double-typed stat must decode as a float value
+                        _stat(_BYTES, dbl=8_000_000_000.0)),
+    ]
+    dev_lines = [
+        _line("XLA Ops",
+              _event(3, 0, 10_000_000_000),      # container: excluded
+              _event(1, 0, 3_000_000_000),
+              _event(1, 5_000_000_000, 1_000_000_000),
+              _event(2, 3_000_000_000, 2_000_000_000),
+              _event(4, 8_000_000_000, 1_000_000_000)),
+        _line("Async XLA Ops", _event(4, 0, 9_000_000_000)),  # not counted
+    ]
+    host_lines = [_line("python", _event(1, 0, 50_000_000_000))]
+    space = (_len_field(1, _plane("/device:TPU:0", dev_lines, metas,
+                                  stat_metas))
+             + _len_field(1, _plane("/host:CPU", host_lines, metas,
+                                    stat_metas)))
+    p = tmp_path / "t.xplane.pb"
+    p.write_bytes(space)
+    return str(tmp_path)
+
+
+def test_plane_selection_and_rollups(trace_file):
+    tables = xplane.op_tables(trace_file)
+    assert tables["plane"] == "/device:TPU:0"
+    # container while excluded; async line excluded; 4 leaf events counted
+    assert tables["total_ms"] == pytest.approx(7.0)
+    cats = {r["op"]: r for r in tables["by_category"]}
+    assert cats["convolution fusion"]["total_ms"] == pytest.approx(6.0)
+    assert cats["convolution fusion"]["count"] == 3
+    assert "while" not in cats
+    # achieved FLOP/s: (2×10 GF + 5 GF) over 6 ms
+    assert cats["convolution fusion"]["gflops_per_s"] == pytest.approx(
+        25e9 / 6e-3 / 1e9, rel=1e-3)
+    # instance suffixes merge: fusion.3 + fusion.7 -> "fusion"
+    ops = {r["op"]: r for r in tables["by_op"]}
+    assert ops["fusion"]["count"] == 3
+    assert ops["fusion"]["total_ms"] == pytest.approx(6.0)
+    # double-typed bytes stat decoded as value, not IEEE bit pattern
+    assert cats["copy"]["gb_per_s"] == pytest.approx(
+        8e9 / 1e-3 / 1e9, rel=1e-3)
+
+
+def test_format_tables_renders(trace_file):
+    out = xplane.format_tables(xplane.op_tables(trace_file))
+    assert "/device:TPU:0" in out and "convolution fusion" in out
+
+
+def test_host_only_trace_falls_back(tmp_path):
+    # CPU-platform trace: no tpu/gpu plane; busiest plane with an
+    # "XLA Ops" line is used instead of raising
+    stat_metas = [_stat_metadata(_CAT, "hlo_category")]
+    metas = [_event_metadata(1, "%add.1", "add.1", _stat(_CAT, s="loop fusion"))]
+    lines = [_line("XLA Ops", _event(1, 0, 2_000_000_000))]
+    space = _len_field(1, _plane("/host:CPU", lines, metas, stat_metas))
+    (tmp_path / "h.xplane.pb").write_bytes(space)
+    tables = xplane.op_tables(str(tmp_path))
+    assert tables["plane"] == "/host:CPU"
+    assert tables["total_ms"] == pytest.approx(2.0)
+
+
+def test_missing_trace_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        xplane.find_xplane_file(str(tmp_path))

