@@ -99,7 +99,6 @@ class Net:
             self.input_blobs[name] = shape
 
         shared_owner: dict[str, tuple[str, int]] = {}  # ParamSpec.name -> (layer, idx)
-        consumed: set[str] = set()
         self._probe_cache: dict[str, list] = {}
         self._node_by_name: dict[str, _LayerNode] = {}
         # blobs whose batch dim is data-dependent (downstream of Filter):
@@ -120,7 +119,6 @@ class Net:
                     raise ValueError(
                         f"layer {lp.name!r} bottom {b!r} unknown "
                         f"(known: {sorted(self.blob_shapes)})")
-                consumed.add(b)
             bshapes = [self.blob_shapes[b] for b in bottoms]
             if any(b in tainted for b in bottoms):
                 self._check_batch_insensitive(lp, impl, bottoms, bshapes,
@@ -167,9 +165,23 @@ class Net:
             self.nodes.append(node)
             self._node_by_name[lp.name] = node
 
-        produced = [t for n in self.nodes for t in n.tops]
-        self.output_blobs = [t for t in dict.fromkeys(produced)
-                             if t not in consumed and t not in self.input_blobs]
+        # net outputs via Caffe's available-blob walk (net.cpp AppendTop/
+        # AppendBottom: a bottom is erased from the available set, a top
+        # re-inserted — so a trailing IN-PLACE layer's blob remains an
+        # output, unlike a naive produced-minus-consumed difference).
+        # Survivors are listed in FIRST-production order (stable for
+        # consumers indexing output_blobs, e.g. classify.py), not Caffe's
+        # reinsertion order.
+        available: dict[str, None] = {}
+        order: dict[str, None] = {}
+        for n in self.nodes:
+            for b in n.bottoms:
+                available.pop(b, None)
+            for t in n.tops:
+                available[t] = None
+                order[t] = None
+        self.output_blobs = [t for t in order
+                             if t in available and t not in self.input_blobs]
 
     @staticmethod
     def _check_batch_insensitive(lp, impl, bottoms, bshapes, tainted) -> None:
@@ -358,12 +370,22 @@ class Net:
         out = {t: blobs[t] for t in self.output_blobs}
         return NetOutputs(blobs=out, loss=loss, params=new_params)
 
-    def apply_all(self, params, inputs, *, train=None, rng=None
+    def apply_all(self, params, inputs, *, train=None, rng=None,
+                  upto: str | None = None,
+                  eps: Mapping[str, jax.Array] | None = None,
                   ) -> dict[str, jax.Array]:
         """Forward returning every intermediate blob (debug; the analog of
         reading arbitrary blobs over the reference's FFI introspection,
-        libccaffe/ccaffe.cpp:86-139)."""
-        blobs, _, _ = self._run(params, inputs, train, rng)
+        libccaffe/ccaffe.cpp:86-139).  ``upto`` stops execution after the
+        named layer (pycaffe's ``forward(end=...)`` truncation).  ``eps``
+        maps blob names to zero-valued perturbations added at each blob's
+        final assignment — differentiating w.r.t. them yields d(out)/d(blob)
+        for INTERMEDIATE blobs (pycaffe ``backward(diffs=[...])``)."""
+        if upto is not None and upto not in self._node_by_name:
+            raise ValueError(
+                f"unknown layer {upto!r} (layers: {self.layer_names()})")
+        blobs, _, _ = self._run(params, inputs, train, rng, upto=upto,
+                                eps=eps)
         return blobs
 
     def _cast(self, arrs, dtype):
@@ -373,7 +395,8 @@ class Net:
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a
                 for a in arrs]
 
-    def _run(self, params, inputs, train, rng):
+    def _run(self, params, inputs, train, rng, upto: str | None = None,
+             eps: Mapping[str, jax.Array] | None = None):
         """The layer-by-layer forward shared by apply/apply_all.
 
         With ``compute_dtype`` set (bf16 on TPU), params and activations
@@ -394,6 +417,14 @@ class Net:
         new_params = dict(params)
         cd = self.compute_dtype
         loss = jnp.zeros((), jnp.float32)
+        # eps injection point: a blob's FINAL assignment (in-place chains
+        # reassign; Caffe's per-blob diff is the diff at the final value)
+        last_producer: dict[str, str] = {}
+        if eps:
+            for n in self.nodes:
+                for t in n.tops:
+                    if t in eps:
+                        last_producer[t] = n.lp.name
         for node in self.nodes:
             if getattr(node.impl, "is_input", lambda: False)():
                 continue
@@ -411,12 +442,22 @@ class Net:
                 else:
                     bots = self._cast(bots, cd)
                     p = self._cast(p, cd)
-            result = node.impl.apply(node.lp, p, bots, train, layer_rng)
+            # named scope: XLA op metadata carries "L[<layer>]" through
+            # fwd AND the AD transpose, so profiler traces attribute
+            # device time per layer (tools/profile_step.py --by-layer —
+            # the `caffe time` per-layer view, reference:
+            # caffe/tools/caffe.cpp:290-376, but post-fusion on-device)
+            with jax.named_scope(f"L[{node.lp.name}]"):
+                result = node.impl.apply(node.lp, p, bots, train, layer_rng)
             if stateful:
                 tops, updated = result
                 self._scatter_node_params(new_params, node, updated)
             else:
                 tops = result
+            if eps:
+                tops = [v + eps[t]
+                        if last_producer.get(t) == node.lp.name else v
+                        for t, v in zip(node.tops, tops)]
             for t, v in zip(node.tops, tops):
                 blobs[t] = v
             # loss accumulation (reference: Layer::SetLossWeights +
@@ -429,6 +470,8 @@ class Net:
                     # f32 accumulation even when the top was computed in a
                     # reduced compute_dtype (loss_weight on non-loss layers)
                     loss = loss + w * jnp.sum(v.astype(jnp.float32))
+            if upto is not None and node.lp.name == upto:
+                break
         return blobs, loss, new_params
 
     # -- introspection (FFI-parity helpers; reference: ccaffe.cpp:86-139,

@@ -1,8 +1,22 @@
-"""Minimal ``caffe`` module shim so pycaffe-style user layers import
-unmodified (reference: caffe/python/caffe/__init__.py surface that
-Python-layer modules actually touch — ``caffe.Layer`` plus the phase
-constants; e.g. examples/pycaffe/layers/pyloss.py does ``import caffe``
-and subclasses ``caffe.Layer``).
+"""``caffe`` module shim: pycaffe's user-facing surface over this
+framework (reference: caffe/python/caffe/__init__.py + pycaffe.py).
+
+Covers what pycaffe scripts actually touch:
+
+- ``caffe.Layer`` + phase constants — user Python layers import
+  unmodified (e.g. examples/pycaffe/layers/pyloss.py).
+- ``caffe.Net`` — the net-surgery/inspection interface
+  (reference: caffe/python/caffe/pycaffe.py): ``net.blobs`` /
+  ``net.params`` as mutable ``.data``/``.diff`` numpy buffers,
+  ``forward(end=...)``, ``backward(**top_diffs)`` (via ``jax.vjp`` —
+  no per-layer Backward code), ``save``/``copy_from``.
+- ``caffe.Classifier`` / ``caffe.Detector`` / ``caffe.draw`` are
+  re-exported from their homes in this package.
+
+Differences by design: shapes are static (XLA compiles per shape), so
+``net.blobs['data'].reshape(...)`` is unsupported — build the net with
+the shapes you need; ``forward(start=...)`` is unsupported (functional
+graphs re-run from the inputs; use ``end=`` truncation).
 
 Usage::
 
@@ -15,7 +29,10 @@ is already importable (the real one always wins).
 
 from __future__ import annotations
 
+import collections
 import sys
+
+import numpy as np
 
 TRAIN = 0
 TEST = 1
@@ -47,6 +64,246 @@ class Layer:
 
     def backward(self, top, propagate_down, bottom):
         pass
+
+
+class PyBlob:
+    """Mutable host mirror of one blob — pycaffe's ``blob.data`` /
+    ``blob.diff`` numpy buffers (reference: caffe/python/caffe/_caffe.cpp
+    Blob bindings).  Mutations are picked up by the next forward/save."""
+
+    def __init__(self, data: np.ndarray):
+        self.data = np.array(data)
+        self.diff = np.zeros_like(self.data)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    def count(self) -> int:
+        return int(self.data.size)
+
+
+class _LayerView:
+    """Entry of ``net.layers`` (type + blobs), matching the pycaffe
+    ``net.layers[i].type`` / ``.blobs`` access pattern."""
+
+    def __init__(self, type_: str, blobs: list):
+        self.type = type_
+        self.blobs = blobs
+
+
+class Net:
+    """pycaffe-style Net façade (reference: caffe/python/caffe/pycaffe.py).
+
+    ``model`` is a prototxt path or text; ``weights`` an optional
+    ``.caffemodel``/npz/HDF5 path; ``phase`` caffe.TRAIN or caffe.TEST.
+    """
+
+    def __init__(self, model: str, weights: str | None = None,
+                 phase: int = TEST):
+        import jax
+
+        from .graph import Net as GraphNet
+        from .proto import NetState, Phase, load_net_prototxt
+
+        self._train = phase == TRAIN
+        net_param = load_net_prototxt(model)
+        self._net = GraphNet(net_param, NetState(
+            Phase.TRAIN if self._train else Phase.TEST))
+        # full filler init even when weights are given: layers absent from
+        # the weights file must keep their filler values, exactly like
+        # Net::CopyTrainedLayersFrom over a freshly SetUp net
+        params = self._net.init(jax.random.PRNGKey(0))
+        if weights:
+            from .solvers.solver import load_weights_into
+            params = load_weights_into(self._net, params, weights)
+        # host-side mutable mirrors (net surgery edits these in place)
+        self.params: dict[str, list[PyBlob]] = collections.OrderedDict(
+            (k, [PyBlob(np.asarray(b)) for b in v]) for k, v in params.items())
+        self.blobs: dict[str, PyBlob] = collections.OrderedDict(
+            (name, PyBlob(np.zeros(shape, np.float32)))
+            for name, shape in self._net.blob_shapes.items())
+        self._fwd_cache: dict = {}
+        self._rng = jax.random.PRNGKey(0)
+        self._last_rng = self._rng  # mask of the most recent forward
+        self._needs_rng = any(n.impl.needs_rng(n.lp, self._train)
+                              for n in self._net.nodes)
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def _layer_names(self) -> list[str]:
+        return self._net.layer_names()
+
+    def _node_pyblobs(self, node) -> list[PyBlob]:
+        """A node's blob list through shared-param refs — the PyBlob
+        mirror of graph.Net.node_params, so shared blobs alias the owner's
+        PyBlob objects (surgery on either side edits the one buffer)."""
+        if not node.shared_refs:
+            return self.params.get(node.param_key, [])
+        out = []
+        for i in range(node.n_blobs or 0):
+            ref = node.shared_refs.get(i)
+            if ref is None:
+                out.append(self.params[node.param_key][node.own_map[i]])
+            else:
+                out.append(self.params[ref[0]][ref[1]])
+        return out
+
+    @property
+    def layers(self) -> list[_LayerView]:
+        return [_LayerView(n.lp.type, self._node_pyblobs(n))
+                for n in self._net.nodes]
+
+    @property
+    def inputs(self) -> list[str]:
+        return list(self._net.input_blobs)
+
+    @property
+    def outputs(self) -> list[str]:
+        return list(self._net.output_blobs)
+
+    # -- execution --------------------------------------------------------
+    def _device_params(self):
+        return {k: [b.data for b in v] for k, v in self.params.items()}
+
+    def _gather_inputs(self, kwargs) -> dict[str, np.ndarray]:
+        inputs = {}
+        for name, shape in self._net.input_blobs.items():
+            arr = np.asarray(kwargs[name] if name in kwargs
+                             else self.blobs[name].data, np.float32)
+            if tuple(arr.shape) != tuple(shape):
+                raise ValueError(
+                    f"input {name!r} has shape {arr.shape}, net expects "
+                    f"{shape} (static shapes: build the net with the "
+                    f"shapes you need; pycaffe reshape is unsupported)")
+            self.blobs[name].data = arr
+            inputs[name] = arr
+        unknown = set(kwargs) - set(self._net.input_blobs)
+        if unknown:
+            raise ValueError(f"not input blobs: {sorted(unknown)}")
+        return inputs
+
+    def forward(self, blobs=None, end: str | None = None, **kwargs):
+        """Run forward; returns {output blob: data} (plus any extra blob
+        names in ``blobs``), filling every ``net.blobs[...].data`` along
+        the way — pycaffe _Net_forward semantics with ``end=``
+        truncation."""
+        import jax
+
+        if end is not None and end not in self._layer_names:
+            raise ValueError(
+                f"unknown layer {end!r} (layers: {self._layer_names})")
+        key = ("fwd", end)
+        if key not in self._fwd_cache:
+            self._fwd_cache[key] = jax.jit(
+                lambda p, x, r: self._net.apply_all(
+                    p, x, train=self._train, rng=r, upto=end))
+        if self._needs_rng:  # fresh masks per forward (Caffe resamples)
+            self._rng, self._last_rng = jax.random.split(self._rng)
+        out = self._fwd_cache[key](self._device_params(),
+                                   self._gather_inputs(kwargs),
+                                   self._last_rng if self._needs_rng
+                                   else None)
+        for name, val in out.items():
+            # np.array copies: jax-backed views are read-only, mirrors
+            # must stay mutable for the net-surgery idiom
+            self.blobs[name].data = np.array(val)
+        if end is not None:
+            node = next(n for n in self._net.nodes if n.lp.name == end)
+            wanted = list(node.tops)
+        else:
+            wanted = list(self._net.output_blobs)
+        for extra in blobs or []:
+            if extra not in wanted:
+                wanted.append(extra)
+        return {k: self.blobs[k].data for k in wanted}
+
+    def backward(self, diffs=None, **kwargs):
+        """Back-propagate: cotangents come from ``kwargs`` (np arrays per
+        top blob) or, when omitted, from the ``.diff`` mirrors of the net
+        output blobs.  Fills ``.diff`` on params and input blobs and
+        returns {input blob: diff, plus any blob named in ``diffs``} —
+        pycaffe _Net_backward, implemented as one ``jax.vjp`` over the
+        functional forward (there is no per-layer Backward here).
+        Intermediate-blob diffs requested via ``diffs`` come from
+        cotangents of zero perturbations injected at each blob's final
+        assignment.  Stochastic layers replay the most recent forward's
+        masks (Caffe backprops through the stored rand_vec)."""
+        import jax
+        import jax.numpy as jnp
+
+        for b in diffs or ():
+            if b not in self._net.blob_shapes:
+                raise ValueError(f"unknown blob {b!r} in diffs")
+        # input blobs already get diffs from the vjp inputs cotangent
+        extra = tuple(b for b in diffs or ()
+                      if b not in self._net.input_blobs)
+        key = ("bwd", extra)
+        if key not in self._fwd_cache:
+            def run_bwd(p, x, eps, cts, r):
+                def fn(p, x, eps):
+                    return self._net.apply_all(p, x, train=self._train,
+                                               rng=r, eps=eps)
+                _out, vjp = jax.vjp(fn, p, x, eps)
+                return vjp(cts)
+            self._fwd_cache[key] = jax.jit(run_bwd)
+
+        inputs = {name: self.blobs[name].data
+                  for name in self._net.input_blobs}
+        eps = {b: jnp.zeros(self._net.blob_shapes[b], jnp.float32)
+               for b in extra}
+        cts = {k: np.zeros(shape, np.float32)
+               for k, shape in self._net.blob_shapes.items()}
+        seeds = dict(kwargs)
+        if not seeds:
+            seeds = {k: self.blobs[k].diff for k in self._net.output_blobs}
+        for k, v in seeds.items():
+            if k not in cts:
+                raise ValueError(f"unknown top blob {k!r}")
+            cts[k] = np.asarray(v, np.float32).reshape(cts[k].shape)
+        p_bar, x_bar, e_bar = self._fwd_cache[key](
+            self._device_params(), inputs, eps,
+            {k: jnp.asarray(v) for k, v in cts.items()},
+            self._last_rng if self._needs_rng else None)
+        for lname, blobs_bar in p_bar.items():
+            for pb, bar in zip(self.params[lname], blobs_bar):
+                pb.diff = np.array(bar)
+        for name, bar in x_bar.items():
+            self.blobs[name].diff = np.array(bar)
+        result = {name: self.blobs[name].diff for name in x_bar}
+        for b in extra:
+            self.blobs[b].diff = np.array(e_bar[b])
+            result[b] = self.blobs[b].diff
+        return result
+
+    # -- persistence (net surgery round trip) -----------------------------
+    def save(self, path: str) -> None:
+        """Write current (possibly surgically edited) params as a
+        .caffemodel (reference: pycaffe Net.save)."""
+        from .proto.caffemodel import save_caffemodel
+        save_caffemodel(path, {k: [b.data for b in v]
+                               for k, v in self.params.items()})
+
+    def copy_from(self, path: str) -> None:
+        """Load weights by layer name into the existing net
+        (Net::CopyTrainedLayersFrom)."""
+        from .solvers.solver import load_weights_into
+        params = load_weights_into(self._net, self._device_params(), path)
+        for k, v in params.items():
+            self.params[k] = [PyBlob(np.asarray(b)) for b in v]
+
+
+def __getattr__(name: str):
+    """Lazy re-exports of the rest of the pycaffe surface from their
+    homes in this package (caffe.Classifier / caffe.Detector /
+    caffe.draw)."""
+    if name in ("Classifier", "Detector"):
+        from . import classify
+        return getattr(classify, name)
+    if name == "draw":
+        from .tools import draw_net
+        return draw_net
+    raise AttributeError(name)
 
 
 def install() -> None:

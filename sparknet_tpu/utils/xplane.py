@@ -20,7 +20,10 @@ from __future__ import annotations
 import dataclasses
 import glob
 import os
+import re
 import struct
+
+_LAYER_RE = re.compile(r"L\[([^\]]+)\]")
 
 
 def _read_varint(buf: memoryview, pos: int) -> tuple[int, int]:
@@ -63,12 +66,20 @@ class OpMeta:
     name: str = ""
     display: str = ""
     category: str = ""
+    scope: str = ""         # tf_op / named_scope path ("jit(f)/L[conv1]/…")
     flops: int = 0          # model flops per occurrence (XLA 'flops' stat)
     bytes_accessed: int = 0
 
     @property
     def label(self) -> str:
         return self.display or self.name
+
+    def layer(self) -> str | None:
+        """Layer attribution from the net executor's L[...] named scopes
+        (graph/net.py); the AD transpose keeps the scope inside
+        transpose(jvp(L[...]))."""
+        hits = _LAYER_RE.findall(self.scope) or _LAYER_RE.findall(self.name)
+        return hits[-1] if hits else None
 
 
 @dataclasses.dataclass
@@ -146,6 +157,8 @@ def _parse_plane(body: memoryview) -> Plane:
                         if "hlo_category" in st:
                             meta.category = st["hlo_category"].decode(
                                 "utf-8", "replace")
+                        if "tf_op" in st:
+                            meta.scope = st["tf_op"].decode("utf-8", "replace")
                         meta.flops = int(st.get("flops", meta.flops) or 0)
                         meta.bytes_accessed = int(
                             st.get("bytes_accessed", meta.bytes_accessed) or 0)
@@ -254,15 +267,25 @@ def op_tables(log_dir: str, *, top: int = 30) -> dict:
         return base[0] if len(base) == 2 and base[1].isdigit() else m.label
     by_op = agg(op_key)[:top]
     total_ms = sum(r["total_ms"] for r in by_cat)
-    return {"plane": plane.name, "total_ms": round(total_ms, 3),
-            "by_category": by_cat, "by_op": by_op}
+    out = {"plane": plane.name, "total_ms": round(total_ms, 3),
+           "by_category": by_cat, "by_op": by_op}
+    # per-layer attribution when the program was built with the net
+    # executor's L[...] named scopes (fused ops are attributed to the
+    # fusion root's scope — post-fusion reality, unlike `caffe time`'s
+    # pre-fusion per-layer timers)
+    if any(e.meta.layer() for e in leaf):
+        out["by_layer"] = agg(lambda m: m.layer() or "(outside layers)")
+    return out
 
 
 def format_tables(tables: dict) -> str:
     out = [f"device plane: {tables['plane']}  "
            f"(busy {tables['total_ms']:.1f} ms total)"]
-    for title, rows in (("by HLO category", tables["by_category"]),
-                        ("top ops", tables["by_op"])):
+    sections = [("by HLO category", tables["by_category"]),
+                ("top ops", tables["by_op"])]
+    if "by_layer" in tables:
+        sections.append(("by layer (L[...] scopes)", tables["by_layer"]))
+    for title, rows in sections:
         out.append(f"\n-- {title} --")
         out.append(f"{'op':<40} {'ms':>9} {'count':>6} {'%':>6} "
                    f"{'GF/s':>9} {'GB/s':>8}")

@@ -1,0 +1,191 @@
+"""pycaffe Net facade tests (sparknet_tpu/pycaffe_compat.py Net).
+
+The net-surgery/inspection surface of pycaffe (reference:
+caffe/python/caffe/pycaffe.py, tests caffe/python/caffe/test/test_net.py):
+blobs/params mirrors, forward with end= truncation, backward filling
+diffs, surgery -> save -> reload round trip.
+"""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import pycaffe_compat as caffe
+
+NET = """
+name: "pynet"
+input: "data"
+input_shape { dim: 4 dim: 1 dim: 6 dim: 6 }
+layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+  convolution_param { num_output: 2 kernel_size: 3
+    weight_filler { type: "gaussian" std: 0.1 }
+    bias_filler { type: "constant" value: 0.5 } } }
+layer { name: "relu" type: "ReLU" bottom: "conv" top: "conv" }
+layer { name: "ip" type: "InnerProduct" bottom: "conv" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "xavier" } } }
+"""
+
+
+@pytest.fixture()
+def net():
+    return caffe.Net(NET, phase=caffe.TEST)
+
+
+def test_introspection(net):
+    assert net.inputs == ["data"]
+    assert net.outputs == ["ip"]
+    assert net._layer_names == ["conv", "relu", "ip"]
+    assert [l.type for l in net.layers] == ["Convolution", "ReLU",
+                                            "InnerProduct"]
+    assert net.params["conv"][0].shape == (2, 1, 3, 3)
+    assert net.params["conv"][1].shape == (2,)
+    assert net.blobs["data"].shape == (4, 1, 6, 6)
+    assert net.blobs["ip"].shape == (4, 3)
+
+
+def test_forward_fills_blobs_and_returns_outputs(net):
+    x = np.random.default_rng(0).normal(size=(4, 1, 6, 6)).astype(np.float32)
+    out = net.forward(data=x)
+    assert set(out) == {"ip"}
+    assert out["ip"].shape == (4, 3)
+    # intermediate blob captured, relu applied in place
+    assert net.blobs["conv"].data.min() >= 0.0
+    # blobs['data'].data mirror was set
+    np.testing.assert_array_equal(net.blobs["data"].data, x)
+    # pycaffe style: mutate the data mirror, call with no kwargs
+    net.blobs["data"].data[...] = 0.0
+    out2 = net.forward()
+    # conv of zeros + bias 0.5 -> relu -> constant rows
+    np.testing.assert_allclose(net.blobs["conv"].data, 0.5, rtol=1e-6)
+    assert not np.allclose(out2["ip"], out["ip"])
+
+
+def test_forward_end_truncates(net):
+    x = np.zeros((4, 1, 6, 6), np.float32)
+    out = net.forward(end="conv", data=x)
+    assert set(out) == {"conv"}
+    # extra blob request
+    out = net.forward(blobs=["conv"], data=x)
+    assert set(out) == {"ip", "conv"}
+
+
+def test_forward_shape_mismatch_clear_error(net):
+    with pytest.raises(ValueError, match="static shapes"):
+        net.forward(data=np.zeros((2, 1, 6, 6), np.float32))
+    with pytest.raises(ValueError, match="not input blobs"):
+        net.forward(conv=np.zeros((4, 2, 4, 4), np.float32))
+
+
+def test_backward_fills_diffs(net):
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 1, 6, 6)).astype(np.float32)
+    net.forward(data=x)
+    dy = rng.normal(size=(4, 3)).astype(np.float32)
+    diffs = net.backward(ip=dy)
+    assert set(diffs) == {"data"}
+    assert diffs["data"].shape == x.shape
+    assert np.any(net.params["ip"][0].diff != 0)
+    assert np.any(net.params["conv"][0].diff != 0)
+    # numeric sanity: ip bias diff == column sums of dy
+    np.testing.assert_allclose(net.params["ip"][1].diff, dy.sum(0),
+                               rtol=1e-5, atol=1e-5)
+    # default seed: output blob .diff mirrors
+    net.blobs["ip"].diff[...] = dy
+    diffs2 = net.backward()
+    np.testing.assert_allclose(diffs2["data"], diffs["data"],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_surgery_save_reload_roundtrip(net, tmp_path):
+    x = np.random.default_rng(2).normal(size=(4, 1, 6, 6)).astype(np.float32)
+    base = net.forward(data=x)["ip"].copy()
+    # net surgery: double the ip weights in place (pycaffe idiom)
+    net.params["ip"][0].data[...] *= 2.0
+    doubled = net.forward(data=x)["ip"].copy()
+    np.testing.assert_allclose(doubled, base * 2.0, rtol=1e-4)
+    path = str(tmp_path / "surgery.caffemodel")
+    net.save(path)
+    net2 = caffe.Net(NET, weights=path, phase=caffe.TEST)
+    np.testing.assert_allclose(net2.forward(data=x)["ip"], doubled,
+                               rtol=1e-5)
+    # copy_from over an existing net
+    net3 = caffe.Net(NET, phase=caffe.TEST)
+    net3.copy_from(path)
+    np.testing.assert_allclose(net3.forward(data=x)["ip"], doubled,
+                               rtol=1e-5)
+
+
+def test_train_phase_dropout_runs():
+    train_net = NET + """
+layer { name: "drop" type: "Dropout" bottom: "ip" top: "ip"
+  dropout_param { dropout_ratio: 0.5 } }
+"""
+    net = caffe.Net(train_net, phase=caffe.TRAIN)
+    out = net.forward(data=np.ones((4, 1, 6, 6), np.float32))
+    assert out["ip"].shape == (4, 3)
+    net.backward(ip=np.ones((4, 3), np.float32))
+    assert np.any(net.params["conv"][0].diff != 0)
+
+
+def test_lazy_reexports():
+    assert caffe.Classifier is not None
+    assert hasattr(caffe.draw, "main") or hasattr(caffe.draw, "draw_net")
+
+
+def test_backward_diffs_intermediate(net):
+    """pycaffe backward(diffs=[...]) returns intermediate-blob diffs
+    (cotangent of a zero perturbation at the blob's final value)."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 1, 6, 6)).astype(np.float32)
+    net.forward(data=x)
+    dy = rng.normal(size=(4, 3)).astype(np.float32)
+    out = net.backward(diffs=["conv"], ip=dy)
+    assert set(out) == {"data", "conv"}
+    # d(ip)/d(conv) via the ip weights: conv blob (post-relu) feeds ip
+    w = net.params["ip"][0].data  # (3, 2*4*4)
+    expect = (dy @ w).reshape(4, 2, 4, 4)
+    np.testing.assert_allclose(out["conv"], expect, rtol=1e-4, atol=1e-5)
+    # input blob listed in diffs: served from the input cotangent
+    out2 = net.backward(diffs=["data"], ip=dy)
+    np.testing.assert_allclose(out2["data"], out["data"], rtol=1e-6)
+
+
+def test_shared_params_alias_in_layers():
+    shared = """
+name: "siamese"
+input: "a"
+input_shape { dim: 2 dim: 3 }
+input: "b"
+input_shape { dim: 2 dim: 3 }
+layer { name: "ip_a" type: "InnerProduct" bottom: "a" top: "fa"
+  param { name: "w" } param { name: "bias" }
+  inner_product_param { num_output: 4 weight_filler { type: "xavier" } } }
+layer { name: "ip_b" type: "InnerProduct" bottom: "b" top: "fb"
+  param { name: "w" } param { name: "bias" }
+  inner_product_param { num_output: 4 weight_filler { type: "xavier" } } }
+"""
+    net = caffe.Net(shared, phase=caffe.TEST)
+    layers = {n: l for n, l in zip(net._layer_names, net.layers)}
+    assert len(layers["ip_b"].blobs) == 2
+    # the sharer's blobs ARE the owner's PyBlob objects
+    assert layers["ip_b"].blobs[0] is layers["ip_a"].blobs[0]
+    x = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+    out = net.forward(a=x, b=x)
+    np.testing.assert_allclose(out["fa"], out["fb"], rtol=1e-6)
+
+
+def test_train_forward_resamples_dropout():
+    train_net = NET + """
+layer { name: "drop" type: "Dropout" bottom: "ip" top: "ip"
+  dropout_param { dropout_ratio: 0.5 } }
+"""
+    net = caffe.Net(train_net, phase=caffe.TRAIN)
+    x = np.ones((4, 1, 6, 6), np.float32)
+    a = net.forward(data=x)["ip"].copy()
+    b = net.forward(data=x)["ip"].copy()
+    assert not np.array_equal(a, b)  # fresh masks per forward
+
+
+def test_forward_unknown_end_clear_error(net):
+    with pytest.raises(ValueError, match="unknown layer"):
+        net.forward(end="nope", data=np.zeros((4, 1, 6, 6), np.float32))

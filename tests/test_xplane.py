@@ -168,3 +168,38 @@ def test_host_only_trace_falls_back(tmp_path):
 def test_missing_trace_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         xplane.find_xplane_file(str(tmp_path))
+
+
+def test_by_layer_attribution(tmp_path):
+    """Events whose tf_op scope carries the net executor's L[...] named
+    scopes aggregate into a by_layer table; AD-transposed scopes
+    (transpose(jvp(L[conv1]))) attribute to the same layer."""
+    _TFOP = 26
+    stat_metas = [_stat_metadata(_CAT, "hlo_category"),
+                  _stat_metadata(_TFOP, "tf_op")]
+    metas = [
+        _event_metadata(1, "%conv.1", "conv.1",
+                        _stat(_CAT, s="convolution"),
+                        _stat(_TFOP, s="jit(f)/L[conv1]/conv")),
+        _event_metadata(2, "%fus.2", "fus.2",
+                        _stat(_CAT, s="loop fusion"),
+                        _stat(_TFOP, s="jit(f)/transpose(jvp(L[conv1]))/mul")),
+        _event_metadata(3, "%fus.3", "fus.3",
+                        _stat(_CAT, s="loop fusion"),
+                        _stat(_TFOP, s="jit(f)/L[pool1]/reduce")),
+        _event_metadata(4, "%upd.4", "upd.4",
+                        _stat(_CAT, s="loop fusion")),  # no layer scope
+    ]
+    lines = [_line("XLA Ops",
+                   _event(1, 0, 4_000_000_000),
+                   _event(2, 4_000_000_000, 2_000_000_000),
+                   _event(3, 6_000_000_000, 1_000_000_000),
+                   _event(4, 7_000_000_000, 1_000_000_000))]
+    space = _len_field(1, _plane("/device:TPU:0", lines, metas, stat_metas))
+    (tmp_path / "l.xplane.pb").write_bytes(space)
+    tables = xplane.op_tables(str(tmp_path))
+    layers = {r["op"]: r for r in tables["by_layer"]}
+    assert layers["conv1"]["total_ms"] == pytest.approx(6.0)  # fwd + bwd
+    assert layers["pool1"]["total_ms"] == pytest.approx(1.0)
+    assert layers["(outside layers)"]["total_ms"] == pytest.approx(1.0)
+    assert "by layer" in xplane.format_tables(tables)
