@@ -427,6 +427,10 @@ class Net:
                         last_producer[t] = n.lp.name
         for node in self.nodes:
             if getattr(node.impl, "is_input", lambda: False)():
+                # Input-type layers still honor upto= (their tops are the
+                # bound inputs; nothing to execute)
+                if upto is not None and node.lp.name == upto:
+                    break
                 continue
             layer_rng = None
             if rng is not None and node.impl.needs_rng(node.lp, train):

@@ -66,21 +66,28 @@ class Layer:
         pass
 
 
-class PyBlob:
-    """Mutable host mirror of one blob — pycaffe's ``blob.data`` /
-    ``blob.diff`` numpy buffers (reference: caffe/python/caffe/_caffe.cpp
-    Blob bindings).  Mutations are picked up by the next forward/save."""
+def _pyblob_cls():
+    """The one PyBlob (ops/python_layer.PyBlob): .data/.diff numpy
+    buffers plus num/channels/height/width/count properties — reused
+    here so ``net.blobs[...]`` and Python-layer bottoms/tops expose the
+    identical pycaffe Blob surface."""
+    from .ops.python_layer import PyBlob
+    return PyBlob
 
-    def __init__(self, data: np.ndarray):
-        self.data = np.array(data)
-        self.diff = np.zeros_like(self.data)
 
-    @property
-    def shape(self):
-        return self.data.shape
-
-    def count(self) -> int:
-        return int(self.data.size)
+def __getattr__(name: str):
+    """Lazy exports: PyBlob (shared with ops/python_layer) and the rest
+    of the pycaffe surface from their homes in this package
+    (caffe.Classifier / caffe.Detector / caffe.draw)."""
+    if name == "PyBlob":
+        return _pyblob_cls()
+    if name in ("Classifier", "Detector"):
+        from . import classify
+        return getattr(classify, name)
+    if name == "draw":
+        from .tools import draw_net
+        return draw_net
+    raise AttributeError(name)
 
 
 class _LayerView:
@@ -117,10 +124,11 @@ class Net:
         if weights:
             from .solvers.solver import load_weights_into
             params = load_weights_into(self._net, params, weights)
+        PyBlob = _pyblob_cls()
         # host-side mutable mirrors (net surgery edits these in place)
-        self.params: dict[str, list[PyBlob]] = collections.OrderedDict(
-            (k, [PyBlob(np.asarray(b)) for b in v]) for k, v in params.items())
-        self.blobs: dict[str, PyBlob] = collections.OrderedDict(
+        self.params: dict[str, list] = collections.OrderedDict(
+            (k, [PyBlob(np.array(b)) for b in v]) for k, v in params.items())
+        self.blobs: dict[str, object] = collections.OrderedDict(
             (name, PyBlob(np.zeros(shape, np.float32)))
             for name, shape in self._net.blob_shapes.items())
         self._fwd_cache: dict = {}
@@ -238,13 +246,29 @@ class Net:
         # input blobs already get diffs from the vjp inputs cotangent
         extra = tuple(b for b in diffs or ()
                       if b not in self._net.input_blobs)
-        key = ("bwd", extra)
+
+        seeds = dict(kwargs)
+        if not seeds:
+            seeds = {k: self.blobs[k].diff for k in self._net.output_blobs}
+        for k in seeds:
+            if k not in self._net.blob_shapes:
+                raise ValueError(f"unknown top blob {k!r}")
+        seeds = {k: np.asarray(v, np.float32).reshape(
+                     self._net.blob_shapes[k])
+                 for k, v in seeds.items()}
+
+        # only the seed arrays cross host->device; the dense zero
+        # cotangents for every other blob materialize as constants
+        # INSIDE the compiled program
+        key = ("bwd", extra, tuple(sorted(seeds)))
         if key not in self._fwd_cache:
-            def run_bwd(p, x, eps, cts, r):
+            def run_bwd(p, x, eps, seeds, r):
                 def fn(p, x, eps):
                     return self._net.apply_all(p, x, train=self._train,
                                                rng=r, eps=eps)
-                _out, vjp = jax.vjp(fn, p, x, eps)
+                out, vjp = jax.vjp(fn, p, x, eps)
+                cts = {k: seeds[k] if k in seeds else jnp.zeros_like(v)
+                       for k, v in out.items()}
                 return vjp(cts)
             self._fwd_cache[key] = jax.jit(run_bwd)
 
@@ -252,18 +276,8 @@ class Net:
                   for name in self._net.input_blobs}
         eps = {b: jnp.zeros(self._net.blob_shapes[b], jnp.float32)
                for b in extra}
-        cts = {k: np.zeros(shape, np.float32)
-               for k, shape in self._net.blob_shapes.items()}
-        seeds = dict(kwargs)
-        if not seeds:
-            seeds = {k: self.blobs[k].diff for k in self._net.output_blobs}
-        for k, v in seeds.items():
-            if k not in cts:
-                raise ValueError(f"unknown top blob {k!r}")
-            cts[k] = np.asarray(v, np.float32).reshape(cts[k].shape)
         p_bar, x_bar, e_bar = self._fwd_cache[key](
-            self._device_params(), inputs, eps,
-            {k: jnp.asarray(v) for k, v in cts.items()},
+            self._device_params(), inputs, eps, seeds,
             self._last_rng if self._needs_rng else None)
         for lname, blobs_bar in p_bar.items():
             for pb, bar in zip(self.params[lname], blobs_bar):
@@ -286,24 +300,24 @@ class Net:
 
     def copy_from(self, path: str) -> None:
         """Load weights by layer name into the existing net
-        (Net::CopyTrainedLayersFrom)."""
+        (Net::CopyTrainedLayersFrom).  Copies INTO the existing PyBlob
+        buffers so user-held references and shared-param aliases stay
+        live, like the reference copies into existing blobs."""
         from .solvers.solver import load_weights_into
         params = load_weights_into(self._net, self._device_params(), path)
+        PyBlob = _pyblob_cls()
         for k, v in params.items():
-            self.params[k] = [PyBlob(np.asarray(b)) for b in v]
-
-
-def __getattr__(name: str):
-    """Lazy re-exports of the rest of the pycaffe surface from their
-    homes in this package (caffe.Classifier / caffe.Detector /
-    caffe.draw)."""
-    if name in ("Classifier", "Detector"):
-        from . import classify
-        return getattr(classify, name)
-    if name == "draw":
-        from .tools import draw_net
-        return draw_net
-    raise AttributeError(name)
+            mine = self.params.get(k)
+            if mine is not None and len(mine) == len(v):
+                for pb, b in zip(mine, v):
+                    arr = np.asarray(b, pb.data.dtype)
+                    if pb.data.shape == arr.shape:
+                        pb.data[...] = arr
+                    else:  # shape changed: fresh buffers on this PyBlob
+                        pb.data = np.array(arr)
+                        pb.diff = np.zeros_like(pb.data)
+            else:
+                self.params[k] = [PyBlob(np.array(b)) for b in v]
 
 
 def install() -> None:

@@ -43,6 +43,13 @@ def main(argv=None) -> None:
     ap.add_argument("--iterations", type=int, default=10)
     ap.add_argument("--per-layer", action="store_true",
                     help="also time each layer in isolation (slow)")
+    ap.add_argument("--trace", action="store_true",
+                    help="profile the FUSED fwd+bwd program and print the "
+                         "per-layer device-time partition (L[...] scopes "
+                         "via jax.profiler; the `caffe time` view that is "
+                         "actually true post-fusion)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="keep the profiler trace here (default: temp)")
     args = ap.parse_args(argv)
 
     from ..utils.platform import honor_platform_env
@@ -87,6 +94,38 @@ def main(argv=None) -> None:
     print(f"Average Forward-Backward:      {fb_ms:10.3f} ms")
     print(f"  (backward ≈ {fb_ms - f_ms:.3f} ms by subtraction; XLA fuses "
           f"the whole net, so whole-net numbers are the real TPU cost)")
+
+    if args.trace:
+        import tempfile
+
+        from ..utils import xplane
+
+        out_dir = args.trace_dir or tempfile.mkdtemp(prefix="time_net_")
+        jax.profiler.start_trace(out_dir)
+        for _ in range(args.iterations):
+            out = fwdbwd(params, inputs)
+        jax.block_until_ready(out)
+        jax.profiler.stop_trace()
+        try:
+            tables = xplane.op_tables(out_dir)
+        except (ValueError, FileNotFoundError) as e:
+            print(f"\n(per-layer trace needs a TPU/GPU device plane — "
+                  f"{e}; trace kept at {out_dir})")
+            tables = {}
+        rows = tables.get("by_layer")
+        if rows:
+            print(f"\nPer-layer device time over {args.iterations} fused "
+                  f"fwd+bwd iterations (trace: {out_dir}):")
+            print(f"{'layer':<28} {'ms/iter':>10} {'%':>6} "
+                  f"{'GF/s':>9} {'GB/s':>8}")
+            for r in rows:
+                print(f"{r['op']:<28} "
+                      f"{r['total_ms'] / args.iterations:>10.3f} "
+                      f"{r['pct']:>6.1f} {r['gflops_per_s']:>9.1f} "
+                      f"{r['gb_per_s']:>8.1f}")
+        else:
+            print("\n(trace captured no L[...] layer scopes — platform "
+                  f"without XLA op events? trace: {out_dir})")
 
     if args.per_layer:
         print(f"{'layer':<28} {'type':<18} {'fwd ms':>10}")

@@ -128,3 +128,67 @@ def test_worker_death_is_reported_not_hung(tmp_path):
         os.environ.update(old_env)
     assert rc != 0, "worker death must surface as a failed job"
     assert time.monotonic() - t0 < 400, "launcher hung past its timeout"
+
+
+def test_ssh_mode_via_shim(tmp_path):
+    """Exercise launch_ssh end-to-end against a local `ssh` shim: the shim
+    logs the wire command (host, BatchMode, env contract) and executes the
+    remote string locally, so two fake 'hosts' form a real 2-process
+    jax.distributed mesh.  This pins the ssh tier's command construction
+    and env contract without an sshd (the pod itself stays
+    live-system-untested, as documented in README)."""
+    from sparknet_tpu.tools.launch import free_port, launch_ssh
+
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    log = tmp_path / "ssh.log"
+    shim = shim_dir / "ssh"
+    shim.write_text(
+        "#!/bin/bash\n"
+        f"echo \"ARGS:$*\" >> {log}\n"
+        "# ssh -o BatchMode=yes <host> <remote>\n"
+        "exec bash -c \"$4\"\n")
+    shim.chmod(0o755)
+
+    single = str(tmp_path / "single.npz")
+    multi = str(tmp_path / "multi.npz")
+    _run_single(single, "sync")
+
+    old_env = dict(os.environ)
+    os.environ["PATH"] = f"{shim_dir}:{os.environ['PATH']}"
+    os.environ.pop("XLA_FLAGS", None)
+    for k in list(os.environ):
+        if k.startswith("SPARKNET_"):
+            os.environ.pop(k)
+    try:
+        rc = launch_ssh(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", multi,
+             "--local-devices", "2"],
+            hosts=["127.0.0.1", "localhost"],
+            coordinator_port=free_port(), cwd=REPO, timeout=420)
+    finally:
+        os.environ.clear()
+        os.environ.update(old_env)
+    assert rc == 0, f"ssh-shim run failed rc={rc}"
+
+    # wire-command contract
+    lines = log.read_text().strip().splitlines()
+    args = [l for l in lines if l.startswith("ARGS:")]
+    assert len(args) == 2
+    assert any("127.0.0.1" in a for a in args)
+    assert any("localhost" in a for a in args)
+    for a in args:
+        assert "-o BatchMode=yes" in a
+        assert f"cd {REPO}" in a
+        assert "SPARKNET_COORDINATOR=" in a
+        assert "SPARKNET_NUM_PROCS='2'" in a
+    assert any("SPARKNET_PROC_ID='0'" in a for a in args)
+    assert any("SPARKNET_PROC_ID='1'" in a for a in args)
+
+    # numerics equal the single-process run, like the local-mode test
+    a, b = np.load(single), np.load(multi)
+    np.testing.assert_allclose(a["__losses__"], b["__losses__"],
+                               rtol=1e-5, atol=1e-6)
+    for k in a.files:
+        if not k.startswith("__"):
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6)
