@@ -10,6 +10,12 @@ Covers what pycaffe scripts actually touch:
   ``net.params`` as mutable ``.data``/``.diff`` numpy buffers,
   ``forward(end=...)``, ``backward(**top_diffs)`` (via ``jax.vjp`` —
   no per-layer Backward code), ``save``/``copy_from``.
+- ``caffe.io`` — load_image/resize_image/oversample + ``Transformer``
+  (pycaffe_io.py; reference python/caffe/io.py preprocessing order).
+- ``caffe.NetSpec`` / ``caffe.layers`` (L) / ``caffe.params`` (P) — the
+  net_spec programmatic builder (pycaffe_netspec.py; reference
+  python/caffe/net_spec.py), emitting the same typed NetParameter the
+  prototxt parser does.
 - ``caffe.Classifier`` / ``caffe.Detector`` / ``caffe.draw`` are
   re-exported from their homes in this package.
 
@@ -87,6 +93,14 @@ def __getattr__(name: str):
     if name == "draw":
         from .tools import draw_net
         return draw_net
+    if name == "io":
+        from . import pycaffe_io
+        return pycaffe_io
+    if name in ("layers", "params", "NetSpec", "net_spec", "to_proto"):
+        from . import pycaffe_netspec
+        if name == "net_spec":
+            return pycaffe_netspec
+        return getattr(pycaffe_netspec, name)
     raise AttributeError(name)
 
 

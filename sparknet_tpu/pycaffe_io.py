@@ -1,0 +1,172 @@
+"""``caffe.io`` shim — the image-IO/preprocessing helpers pycaffe
+scripts universally use (reference: caffe/python/caffe/io.py):
+``load_image``, ``resize_image``, ``oversample``, and ``Transformer``
+(set_transpose / set_channel_swap / set_raw_scale / set_mean /
+set_input_scale → ``preprocess``/``deprocess``).
+
+Semantics follow the reference order exactly (io.py Transformer.preprocess):
+resize → transpose → channel_swap → raw_scale → mean subtract →
+input_scale; deprocess inverts in reverse.  Images are float arrays in
+[0, 1] HxWxC (skimage convention), like ``caffe.io.load_image`` returns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_image", "resize_image", "oversample", "Transformer"]
+
+
+def oversample(images, crop_dims) -> np.ndarray:
+    """io.py oversample: for each HxWxC image, the 4 corners + center
+    crops and their mirrors — returns (10·N, crop_h, crop_w, C).
+    (classify.oversample is the NCHW Classifier-internal variant; this
+    one matches the reference caffe.io signature and layout.)"""
+    ch, cw = int(crop_dims[0]), int(crop_dims[1])
+    out = []
+    for im in images:
+        im = np.asarray(im)
+        h, w = im.shape[:2]
+        if h < ch or w < cw:
+            raise ValueError(f"image {im.shape} smaller than crop "
+                             f"{(ch, cw)}")
+        starts = [(0, 0), (0, w - cw), (h - ch, 0), (h - ch, w - cw),
+                  ((h - ch) // 2, (w - cw) // 2)]
+        for y, x in starts:
+            crop = im[y:y + ch, x:x + cw]
+            out.append(crop)
+            out.append(crop[:, ::-1])
+    return np.stack(out)
+
+
+def load_image(filename: str, color: bool = True) -> np.ndarray:
+    """Load an image as float32 [0, 1] HxWxC (RGB) — io.py load_image
+    (skimage.img_as_float), via PIL here."""
+    from PIL import Image
+    img = Image.open(filename)
+    img = img.convert("RGB" if color else "L")
+    arr = np.asarray(img, np.float32) / 255.0
+    if not color:
+        arr = arr[:, :, None]
+    return arr
+
+
+def resize_image(im: np.ndarray, new_dims, interp_order: int = 1) -> np.ndarray:
+    """Resize HxWxC float image to ``new_dims`` (H, W) — io.py
+    resize_image (bilinear by default)."""
+    from PIL import Image
+    h, w = int(new_dims[0]), int(new_dims[1])
+    resample = Image.NEAREST if interp_order == 0 else Image.BILINEAR
+    chans = []
+    for c in range(im.shape[2]):
+        ch = Image.fromarray(im[:, :, c].astype(np.float32), mode="F")
+        chans.append(np.asarray(ch.resize((w, h), resample)))
+    return np.stack(chans, axis=2).astype(im.dtype)
+
+
+class Transformer:
+    """io.py Transformer: per-input preprocessing configuration.
+
+    ``inputs`` maps input blob name -> blob shape (N, C, H, W), exactly
+    the pycaffe idiom::
+
+        t = caffe.io.Transformer({'data': net.blobs['data'].shape})
+        t.set_transpose('data', (2, 0, 1))
+        t.set_mean('data', mu)
+        t.set_raw_scale('data', 255)
+        t.set_channel_swap('data', (2, 1, 0))
+        net.blobs['data'].data[...] = t.preprocess('data', img)
+    """
+
+    def __init__(self, inputs: dict):
+        self.inputs = {k: tuple(v) for k, v in inputs.items()}
+        self.transpose: dict = {}
+        self.channel_swap: dict = {}
+        self.raw_scale: dict = {}
+        self.mean: dict = {}
+        self.input_scale: dict = {}
+
+    def _check(self, in_: str) -> None:
+        if in_ not in self.inputs:
+            raise ValueError(
+                f"{in_!r} is not one of the net inputs: "
+                f"{sorted(self.inputs)}")
+
+    def set_transpose(self, in_: str, order) -> None:
+        self._check(in_)
+        if len(order) != len(self.inputs[in_]) - 1:
+            raise ValueError(
+                "Transpose order needs to have the same number of "
+                "dimensions as the input.")
+        self.transpose[in_] = tuple(order)
+
+    def set_channel_swap(self, in_: str, order) -> None:
+        self._check(in_)
+        if len(order) != self.inputs[in_][1]:
+            raise ValueError(
+                "Channel swap needs to have the same number of "
+                "dimensions as the input channels.")
+        self.channel_swap[in_] = tuple(order)
+
+    def set_raw_scale(self, in_: str, scale: float) -> None:
+        self._check(in_)
+        self.raw_scale[in_] = float(scale)
+
+    def set_input_scale(self, in_: str, scale: float) -> None:
+        self._check(in_)
+        self.input_scale[in_] = float(scale)
+
+    def set_mean(self, in_: str, mean: np.ndarray) -> None:
+        """Mean can be a scalar-per-channel vector (C,) or an image
+        (C, H, W) matching the input's spatial dims (io.py set_mean,
+        incl. its shape checks)."""
+        self._check(in_)
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1:
+            if mean.shape[0] != self.inputs[in_][1]:
+                raise ValueError("Mean channels incompatible with input.")
+            mean = mean[:, None, None]
+        else:
+            if mean.shape[0] != self.inputs[in_][1]:
+                raise ValueError("Mean channels incompatible with input.")
+            if mean.shape[1:] != tuple(self.inputs[in_][2:]):
+                raise ValueError(
+                    "Mean shape incompatible with input shape.")
+        self.mean[in_] = mean
+
+    def preprocess(self, in_: str, data: np.ndarray) -> np.ndarray:
+        """io.py Transformer.preprocess order: resize → transpose →
+        channel_swap → raw_scale → mean → input_scale."""
+        self._check(in_)
+        data = np.asarray(data, np.float32)
+        in_dims = self.inputs[in_][2:]
+        if data.ndim == 3 and data.shape[:2] != tuple(in_dims):
+            data = resize_image(data, in_dims)
+        if in_ in self.transpose:
+            data = data.transpose(self.transpose[in_])
+        if in_ in self.channel_swap:
+            data = data[list(self.channel_swap[in_]), :, :]
+        if in_ in self.raw_scale:
+            data = data * self.raw_scale[in_]
+        if in_ in self.mean:
+            data = data - self.mean[in_]
+        if in_ in self.input_scale:
+            data = data * self.input_scale[in_]
+        return data
+
+    def deprocess(self, in_: str, data: np.ndarray) -> np.ndarray:
+        """Invert preprocess (io.py deprocess order)."""
+        self._check(in_)
+        data = np.array(np.squeeze(data), np.float32)
+        if in_ in self.input_scale:
+            data = data / self.input_scale[in_]
+        if in_ in self.mean:
+            data = data + self.mean[in_]
+        if in_ in self.raw_scale:
+            data = data / self.raw_scale[in_]
+        if in_ in self.channel_swap:
+            inv = np.argsort(self.channel_swap[in_])
+            data = data[list(inv), :, :]
+        if in_ in self.transpose:
+            data = data.transpose(np.argsort(self.transpose[in_]))
+        return data

@@ -189,3 +189,141 @@ layer { name: "drop" type: "Dropout" bottom: "ip" top: "ip"
 def test_forward_unknown_end_clear_error(net):
     with pytest.raises(ValueError, match="unknown layer"):
         net.forward(end="nope", data=np.zeros((4, 1, 6, 6), np.float32))
+
+
+def test_io_transformer_matches_reference_order(tmp_path):
+    """caffe.io.Transformer applies resize -> transpose -> channel_swap ->
+    raw_scale -> mean -> input_scale (io.py preprocess), and deprocess
+    inverts it."""
+    io = caffe.io
+    t = io.Transformer({"data": (1, 3, 4, 4)})
+    t.set_transpose("data", (2, 0, 1))
+    t.set_channel_swap("data", (2, 1, 0))
+    t.set_raw_scale("data", 255.0)
+    mu = np.array([10.0, 20.0, 30.0], np.float32)
+    t.set_mean("data", mu)
+    t.set_input_scale("data", 0.5)
+
+    rng = np.random.default_rng(0)
+    img = rng.uniform(size=(4, 4, 3)).astype(np.float32)  # HWC in [0,1]
+    got = t.preprocess("data", img)
+    expect = img.transpose(2, 0, 1)[[2, 1, 0]] * 255.0
+    expect = (expect - mu[:, None, None]) * 0.5
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+    # roundtrip
+    back = t.deprocess("data", got)
+    np.testing.assert_allclose(back, img, rtol=1e-5, atol=1e-6)
+    # resize path: an 8x8 input is resized to the blob's 4x4
+    big = rng.uniform(size=(8, 8, 3)).astype(np.float32)
+    assert t.preprocess("data", big).shape == (3, 4, 4)
+    # validation errors
+    with pytest.raises(ValueError, match="not one of the net inputs"):
+        t.set_raw_scale("nope", 1.0)
+    with pytest.raises(ValueError, match="Mean shape incompatible"):
+        t.set_mean("data", np.zeros((3, 5, 5), np.float32))
+
+
+def test_io_load_and_resize_image(tmp_path):
+    from PIL import Image
+    arr = (np.random.default_rng(0).uniform(size=(6, 5, 3)) * 255
+           ).astype(np.uint8)
+    p = str(tmp_path / "img.png")
+    Image.fromarray(arr).save(p)
+    im = caffe.io.load_image(p)
+    assert im.shape == (6, 5, 3) and im.dtype == np.float32
+    assert 0.0 <= im.min() and im.max() <= 1.0
+    np.testing.assert_allclose(im, arr / 255.0, atol=1e-6)
+    small = caffe.io.resize_image(im, (3, 4))
+    assert small.shape == (3, 4, 3)
+    gray = caffe.io.load_image(p, color=False)
+    assert gray.shape == (6, 5, 1)
+
+
+def test_net_spec_builds_runnable_lenet_style_net():
+    """caffe.net_spec idiom: L.<Type> functions + NetSpec attributes ->
+    NetParameter -> prototxt -> buildable, runnable net."""
+    L, P, NetSpec = caffe.layers, caffe.params, caffe.NetSpec
+    n = NetSpec()
+    n.data = L.Input(input_param=dict(shape=dict(dim=[2, 1, 12, 12])))
+    n.conv1 = L.Convolution(n.data, kernel_size=3, num_output=4,
+                            weight_filler=dict(type="xavier"))
+    n.relu1 = L.ReLU(n.conv1, in_place=True)
+    n.pool1 = L.Pooling(n.relu1, kernel_size=2, stride=2,
+                        pool=P.Pooling.MAX)
+    n.score = L.InnerProduct(n.pool1, num_output=3,
+                             weight_filler=dict(type="xavier"))
+    proto = n.to_proto()
+    text = str(proto)
+    assert 'type: "Convolution"' in text and "xavier" in text
+    assert "pool: MAX" in text
+
+    # the generated prototxt round-trips through the front door and runs
+    net = caffe.Net(text, phase=caffe.TEST)
+    assert net.outputs == ["score"]
+    out = net.forward(data=np.zeros((2, 1, 12, 12), np.float32))
+    assert out["score"].shape == (2, 3)
+    # in-place relu: conv1 blob reused, layer list carries all 5 layers
+    assert net._layer_names == ["data", "conv1", "relu1", "pool1", "score"]
+
+
+def test_net_spec_multi_top_and_loss_weight():
+    L, NetSpec = caffe.layers, caffe.NetSpec
+    n = NetSpec()
+    n.data, n.label = L.DummyData(
+        dummy_data_param=dict(shape=[dict(dim=[4, 1, 6, 6]),
+                                     dict(dim=[4])]), ntop=2)
+    n.ip = L.InnerProduct(n.data, num_output=2,
+                          weight_filler=dict(type="constant", value=0.1))
+    n.loss = L.SoftmaxWithLoss(n.ip, n.label, loss_weight=2.0)
+    text = str(n.to_proto())
+    assert "loss_weight: 2" in text
+    net = caffe.Net(text, phase=caffe.TRAIN)
+    out = net.forward()
+    assert "loss" in out
+
+
+def test_net_spec_errors():
+    L, NetSpec = caffe.layers, caffe.NetSpec
+    with pytest.raises(ValueError, match="no default param"):
+        L.SoftmaxWithLoss(kernel_size=3)  # no default param message
+    with pytest.raises(ValueError, match="unknown LayerParameter field"):
+        L.Convolution(bogus_param=dict(x=1))
+    n = NetSpec()
+    with pytest.raises(TypeError, match="layer Tops"):
+        n.x = 3
+
+
+def test_net_spec_include_rule_and_typo_detection():
+    L, NetSpec = caffe.layers, caffe.NetSpec
+    n = NetSpec()
+    n.data, n.label = L.DummyData(
+        dummy_data_param=dict(shape=[dict(dim=[4, 1, 6, 6]),
+                                     dict(dim=[4])]), ntop=2)
+    n.ip = L.InnerProduct(n.data, num_output=2,
+                          weight_filler=dict(type="xavier"))
+    n.loss = L.SoftmaxWithLoss(n.ip, n.label)
+    n.acc = L.Accuracy(n.ip, n.label, include=dict(phase="TEST"))
+    text = str(n.to_proto())
+    assert "include" in text and "phase: TEST" in text
+    train = caffe.Net(text, phase=caffe.TRAIN)
+    assert "acc" not in train._layer_names  # phase rule honored
+    test = caffe.Net(text, phase=caffe.TEST)
+    assert "acc" in test._layer_names
+    # misspelled field in the default param message fails at BUILD time
+    with pytest.raises(ValueError, match="kernal_size"):
+        L.Convolution(n.data, kernal_size=3, num_output=4)
+
+
+def test_io_oversample_reference_layout():
+    rng = np.random.default_rng(0)
+    img = rng.uniform(size=(8, 10, 3)).astype(np.float32)
+    crops = caffe.io.oversample([img], (4, 6))
+    assert crops.shape == (10, 4, 6, 3)
+    np.testing.assert_array_equal(crops[0], img[:4, :6])       # corner
+    np.testing.assert_array_equal(crops[1], img[:4, :6][:, ::-1])  # mirror
+    np.testing.assert_array_equal(crops[8], img[2:6, 2:8])     # center
+    with pytest.raises(ValueError, match="smaller than crop"):
+        caffe.io.oversample([img], (9, 6))
+    with pytest.raises(ValueError, match="Mean channels"):
+        t = caffe.io.Transformer({"data": (1, 3, 4, 4)})
+        t.set_mean("data", np.zeros(4, np.float32))
