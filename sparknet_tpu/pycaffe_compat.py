@@ -121,7 +121,7 @@ class Net:
     """
 
     def __init__(self, model: str, weights: str | None = None,
-                 phase: int = TEST):
+                 phase: int = TEST, *, initial_params=None):
         import jax
 
         from .graph import Net as GraphNet
@@ -131,10 +131,14 @@ class Net:
         net_param = load_net_prototxt(model)
         self._net = GraphNet(net_param, NetState(
             Phase.TRAIN if self._train else Phase.TEST))
-        # full filler init even when weights are given: layers absent from
-        # the weights file must keep their filler values, exactly like
-        # Net::CopyTrainedLayersFrom over a freshly SetUp net
-        params = self._net.init(jax.random.PRNGKey(0))
+        if initial_params is not None:
+            # pre-built collection (solver views share one init)
+            params = initial_params
+        else:
+            # full filler init even when weights are given: layers absent
+            # from the weights file must keep their filler values, exactly
+            # like Net::CopyTrainedLayersFrom over a freshly SetUp net
+            params = self._net.init(jax.random.PRNGKey(0))
         if weights:
             from .solvers.solver import load_weights_into
             params = load_weights_into(self._net, params, weights)
@@ -332,6 +336,111 @@ class Net:
                         pb.diff = np.zeros_like(pb.data)
             else:
                 self.params[k] = [PyBlob(np.array(b)) for b in v]
+
+
+class _PySolver:
+    """pycaffe solver interface (reference: _caffe.cpp Solver bindings +
+    python/caffe/test/test_solver.py usage): ``solver.net`` (TRAIN view),
+    ``solver.test_nets``, ``step(n)``, ``solve()``, ``iter``, snapshot/
+    restore.  Param semantics match pycaffe's SHARING: one set of host
+    mirrors backs solver.net.params AND every test net (Caffe's
+    ShareTrainedLayersWith); surgery on the mirrors is pushed to the
+    device solver before each step/solve and the trained values pulled
+    back after.  ``solver.net.blobs`` fill on explicit ``net.forward()``
+    (a step's intermediate activations are not retained — functional
+    execution has no persistent blob storage)."""
+
+    def __init__(self, solver: str):
+        import os
+
+        from .data.db import feed_for_net
+        from .data.prefetch import device_feed
+        from .proto import Phase, load_net_prototxt, load_solver_prototxt
+        from .proto.caffe_pb import resolve_net_path
+        from .proto.textformat import serialize
+        from .solvers import Solver as _Solver
+
+        sp = load_solver_prototxt(solver)
+        # the dominant pycaffe format references the train net by path
+        # (`net:`/`train_net:`), resolved relative to the solver file
+        if not (sp.net_param or sp.train_net_param):
+            base = solver if os.path.exists(solver) else "."
+            sp.net_param = load_net_prototxt(resolve_net_path(sp, base))
+        self._solver = _Solver(sp)  # seed honors sp.random_seed
+        net_param = sp.net_param or sp.train_net_param
+        text = serialize(net_param.to_pmsg())
+        self.net = Net(text, phase=TRAIN,
+                       initial_params=self._solver.params)
+        # one mirror set, seeded from the solver's initialized params,
+        # shared by the train view and every test net
+        PyBlob = _pyblob_cls()
+        self.net.params = collections.OrderedDict(
+            (k, [PyBlob(np.array(b)) for b in v])
+            for k, v in self._solver.params.items())
+        self.test_nets = []
+        # dedicated test net definitions win (Solver::InitTestNets);
+        # otherwise the TEST-phase view of the shared net
+        test_params = list(sp.test_net_param) or (
+            [net_param] if sp.test_iter else [])
+        for tp in test_params:
+            tn = Net(serialize(tp.to_pmsg()), phase=TEST,
+                     initial_params=self._solver.params)
+            tn.params = self.net.params
+            self.test_nets.append(tn)
+        # data-layer-backed nets feed themselves (caffe_cli train path);
+        # Input-declared nets train via net.forward/backward or external
+        # feeds instead
+        try:
+            self._solver.set_train_data(device_feed(
+                feed_for_net(net_param, Phase.TRAIN)))
+        except (ValueError, KeyError):
+            pass
+
+    @property
+    def iter(self) -> int:
+        return self._solver.iter
+
+    def _push(self) -> None:
+        self._solver.params = {
+            k: [np.asarray(b.data) for b in v]
+            for k, v in self.net.params.items()}
+
+    def _pull(self) -> None:
+        for k, v in self._solver.params.items():
+            for pb, arr in zip(self.net.params[k], v):
+                pb.data[...] = np.asarray(arr)
+
+    def step(self, n: int) -> float:
+        self._push()
+        loss = self._solver.step(n)
+        self._pull()
+        return loss
+
+    def solve(self) -> None:
+        self._push()
+        self._solver.solve()
+        self._pull()
+
+    def snapshot(self) -> None:
+        self._push()
+        self._solver.snapshot_caffe()
+
+    def restore(self, state_path: str) -> None:
+        self._solver.restore_caffe(state_path)
+        self._pull()
+
+
+def get_solver(path: str) -> _PySolver:
+    """caffe.get_solver — the solver type comes from the prototxt's
+    ``type:`` field (all 6 rules supported by solvers/update_rules)."""
+    return _PySolver(path)
+
+
+# pycaffe's per-type constructors; the type field in the prototxt wins
+# (this framework honors it, unlike the reference wrapper's hardcoded
+# SGDSolver at libccaffe/ccaffe.cpp:72-78)
+SGDSolver = NesterovSolver = AdaGradSolver = RMSPropSolver = \
+    AdaDeltaSolver = AdamSolver = get_solver
 
 
 def install() -> None:

