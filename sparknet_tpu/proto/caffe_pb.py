@@ -754,16 +754,13 @@ def save_net_prototxt(net: NetParameter, path_or_none: str | None = None
     return text
 
 
-def resolve_net_path(sp: "SolverParameter", solver_path: str,
-                     extra_bases: Sequence[str] = ()) -> str:
-    """Resolve a solver's ``net:``/``train_net:`` file reference.  Caffe
-    resolves relative to the process cwd (zoo solvers use paths like
-    examples/cifar10/...); we additionally probe the solver's own
-    directory, its basename there, and any ``extra_bases``."""
+def _resolve_ref_path(net_ref: str, solver_path: str,
+                      extra_bases: Sequence[str] = ()) -> str:
+    """Resolve one net file reference: cwd first (Caffe resolves
+    relative to the process cwd — zoo solvers use paths like
+    examples/cifar10/...), then the solver's own directory, its basename
+    there, and any ``extra_bases``."""
     import os
-    net_ref = sp.net or sp.train_net
-    if net_ref is None:
-        raise FileNotFoundError("solver has no net:/train_net: reference")
     bases = ["", os.path.dirname(os.path.abspath(solver_path)) or "."]
     bases.extend(extra_bases)
     for base in bases:
@@ -774,6 +771,28 @@ def resolve_net_path(sp: "SolverParameter", solver_path: str,
                 return cand
     raise FileNotFoundError(f"cannot resolve net path {net_ref!r} "
                             f"(searched {bases})")
+
+
+def resolve_net_path(sp: "SolverParameter", solver_path: str,
+                     extra_bases: Sequence[str] = ()) -> str:
+    """Resolve a solver's ``net:``/``train_net:`` file reference."""
+    net_ref = sp.net or sp.train_net
+    if net_ref is None:
+        raise FileNotFoundError("solver has no net:/train_net: reference")
+    return _resolve_ref_path(net_ref, solver_path, extra_bases)
+
+
+def resolve_solver_nets(sp: "SolverParameter", solver_path: str) -> None:
+    """Load every net file reference of a solver into its *_net_param
+    fields (Solver::InitTrainNet/InitTestNets path resolution): ``net:``/
+    ``train_net:`` into ``net_param`` and each ``test_net:`` entry into
+    ``test_net_param``.  Embedded definitions win over file references."""
+    if not (sp.net_param or sp.train_net_param):
+        sp.net_param = load_net_prototxt(resolve_net_path(sp, solver_path))
+    if sp.test_net and not sp.test_net_param:
+        sp.test_net_param = [
+            load_net_prototxt(_resolve_ref_path(p, solver_path))
+            for p in sp.test_net]
 
 
 def replace_data_layers(

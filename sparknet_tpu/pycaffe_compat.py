@@ -154,6 +154,13 @@ class Net:
         self._last_rng = self._rng  # mask of the most recent forward
         self._needs_rng = any(n.impl.needs_rng(n.lp, self._train)
                               for n in self._net.nodes)
+        # DB-backed data layers self-feed on forward(), advancing their
+        # cursor each call like the reference's prefetching data layers
+        from .data.db import _FEEDABLE_TYPES
+        self._net_param = net_param
+        self._auto_feed = None
+        self._feedable = any(n.lp.type in _FEEDABLE_TYPES
+                             for n in self._net.nodes)
 
     # -- introspection ----------------------------------------------------
     @property
@@ -219,6 +226,17 @@ class Net:
         if end is not None and end not in self._layer_names:
             raise ValueError(
                 f"unknown layer {end!r} (layers: {self._layer_names})")
+        if self._feedable:
+            # data layers win over mirror contents (their Forward
+            # overwrites the top blobs each call in the reference)
+            if self._auto_feed is None:
+                from .data.db import feed_for_net
+                from .proto import Phase
+                self._auto_feed = feed_for_net(
+                    self._net_param,
+                    Phase.TRAIN if self._train else Phase.TEST)
+            batch = next(self._auto_feed)
+            kwargs = {**batch, **kwargs}
         key = ("fwd", end)
         if key not in self._fwd_cache:
             self._fwd_cache[key] = jax.jit(
@@ -355,28 +373,23 @@ class _PySolver:
 
         from .data.db import feed_for_net
         from .data.prefetch import device_feed
-        from .proto import Phase, load_net_prototxt, load_solver_prototxt
-        from .proto.caffe_pb import resolve_net_path
+        from .proto import NetState, Phase, load_solver_prototxt
         from .proto.textformat import serialize
         from .solvers import Solver as _Solver
 
         sp = load_solver_prototxt(solver)
-        # the dominant pycaffe format references the train net by path
-        # (`net:`/`train_net:`), resolved relative to the solver file
-        if not (sp.net_param or sp.train_net_param):
-            base = solver if os.path.exists(solver) else "."
-            sp.net_param = load_net_prototxt(resolve_net_path(sp, base))
+        # net:/train_net:/test_net: file references (the dominant pycaffe
+        # format), resolved like Solver::InitTrainNet/InitTestNets
+        from .proto.caffe_pb import resolve_solver_nets
+        resolve_solver_nets(sp, solver if os.path.exists(solver) else ".")
         self._solver = _Solver(sp)  # seed honors sp.random_seed
         net_param = sp.net_param or sp.train_net_param
         text = serialize(net_param.to_pmsg())
+        # one mirror set (built once by the Net view from the solver's
+        # initialized params), shared by the train view and every test
+        # net's matching layers (ShareTrainedLayersWith)
         self.net = Net(text, phase=TRAIN,
                        initial_params=self._solver.params)
-        # one mirror set, seeded from the solver's initialized params,
-        # shared by the train view and every test net
-        PyBlob = _pyblob_cls()
-        self.net.params = collections.OrderedDict(
-            (k, [PyBlob(np.array(b)) for b in v])
-            for k, v in self._solver.params.items())
         self.test_nets = []
         # dedicated test net definitions win (Solver::InitTestNets);
         # otherwise the TEST-phase view of the shared net
@@ -384,17 +397,23 @@ class _PySolver:
             [net_param] if sp.test_iter else [])
         for tp in test_params:
             tn = Net(serialize(tp.to_pmsg()), phase=TEST,
-                     initial_params=self._solver.params)
-            tn.params = self.net.params
+                     initial_params={**self._solver._test_extra,
+                                     **self._solver.params})
+            # share the train mirrors for matching layers; test-only
+            # layers keep their own (filler-init) mirrors
+            for k in tn.params:
+                if k in self.net.params:
+                    tn.params[k] = self.net.params[k]
             self.test_nets.append(tn)
-        # data-layer-backed nets feed themselves (caffe_cli train path);
+        # DB-backed nets feed themselves (caffe_cli train path);
         # Input-declared nets train via net.forward/backward or external
-        # feeds instead
-        try:
+        # feeds instead.  Misconfigured data layers must raise, so gate
+        # on feedability rather than swallowing errors.
+        from .data.db import _FEEDABLE_TYPES
+        train_layers = net_param.filtered(NetState(Phase.TRAIN)).layer
+        if any(lp.type in _FEEDABLE_TYPES for lp in train_layers):
             self._solver.set_train_data(device_feed(
                 feed_for_net(net_param, Phase.TRAIN)))
-        except (ValueError, KeyError):
-            pass
 
     @property
     def iter(self) -> int:

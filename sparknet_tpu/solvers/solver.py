@@ -60,15 +60,24 @@ class Solver:
         # a dedicated test net definition wins (Solver::InitTestNets
         # precedence, solver.cpp:104-172: test_net_param > test_net file >
         # shared net); `test_net:` file paths must be resolved into
-        # test_net_param by the caller (caffe_cli does)
+        # test_net_param by the caller (proto.caffe_pb.resolve_solver_nets)
         test_param = (sp.test_net_param[0] if sp.test_net_param
                       else net_param)
         self.test_net = Net(test_param, NetState(Phase.TEST),
                             compute_dtype=compute_dtype)
+        self._dedicated_test_net = test_param is not net_param
         self.rule = make_update_rule(sp)
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_rng = jax.random.split(self._rng)
         self.params: WeightCollection = self.train_net.init(init_rng)
+        # a dedicated test net may own layers the train net lacks; those
+        # keep their filler init while matching layers share trained
+        # params (Net::ShareTrainedLayersWith, net.cpp:737)
+        self._test_extra: WeightCollection = {}
+        if self._dedicated_test_net:
+            full = self.test_net.init(jax.random.fold_in(init_rng, 1))
+            self._test_extra = {k: v for k, v in full.items()
+                                if k not in self.params}
         self.state = self.rule.init(self.params)
         self.iter = 0
         self._lr_mults = self.train_net.lr_mult_tree(self.params)
@@ -253,6 +262,8 @@ class Solver:
         # outputs pass through element-wise (Accuracy's per-class second
         # top stays a vector) — Solver::TestAndStoreResult accumulates
         # every element of every output blob (solver.cpp:413-445)
+        if self._test_extra:  # test-net-only layers keep filler init
+            params = {**self._test_extra, **params}
         out = self.test_net.apply(params, batch, train=False, rng=rng)
         return dict(out.blobs)
 

@@ -107,13 +107,11 @@ def _device_query(args) -> int:
 
 
 def _resolve_solver_net(sp, solver_path: str) -> None:
-    """Load the solver's net:/train_net: reference into net_param."""
-    from ..proto import load_net_prototxt
-    from ..proto.caffe_pb import resolve_net_path
-    if sp.net_param or sp.train_net_param:
-        return
+    """Load the solver's net:/train_net:/test_net: file references into
+    *_net_param (Solver::InitTrainNet/InitTestNets path resolution)."""
+    from ..proto.caffe_pb import resolve_solver_nets
     try:
-        sp.net_param = load_net_prototxt(resolve_net_path(sp, solver_path))
+        resolve_solver_nets(sp, solver_path)
     except FileNotFoundError as e:
         raise SystemExit(str(e))
 

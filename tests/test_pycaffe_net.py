@@ -431,3 +431,72 @@ test_net_param {
     # core Solver's own test() path also uses the dedicated net + rng feed
     scores = s3._solver.test(2)
     assert "loss" in scores
+
+
+def test_data_layer_net_self_feeds(tmp_path):
+    """pycaffe Net over a Data-layer (LMDB) net: forward() pulls batches
+    from the DB automatically, advancing per call (reference data layers
+    overwrite their tops each Forward)."""
+    import sparknet_tpu.data.lmdb_io as lmdb_io
+    from sparknet_tpu.data.db import array_to_datum
+
+    rng = np.random.default_rng(0)
+    records = []
+    for i in range(6):
+        arr = rng.integers(0, 255, size=(1, 4, 4)).astype(np.uint8)
+        records.append((f"{i:08d}".encode(),
+                        array_to_datum(arr, label=i % 3)))
+    db = str(tmp_path / "toy_lmdb")
+    lmdb_io.write_lmdb(db, records)
+
+    net_text = """
+layer { name: "data" type: "Data" top: "data" top: "label"
+  data_param { source: "%s" backend: LMDB batch_size: 2 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+""" % db
+    net = caffe.Net(net_text, phase=caffe.TEST)
+    out1 = net.forward()
+    assert out1["ip"].shape == (2, 3)
+    np.testing.assert_array_equal(net.blobs["label"].data, [0.0, 1.0])
+    net.forward()
+    # the cursor advanced: labels i%3 for i=2,3
+    np.testing.assert_array_equal(net.blobs["label"].data, [2.0, 0.0])
+
+
+def test_get_solver_test_net_file_and_extra_layers(tmp_path):
+    """test_net: file refs resolve (InitTestNets), and a test-net-only
+    param layer keeps its filler init while matching layers share the
+    trained weights (ShareTrainedLayersWith)."""
+    (tmp_path / "train.prototxt").write_text("""
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 4 dim: 3 } shape { dim: 4 }
+    data_filler { type: "gaussian" std: 1.0 }
+    data_filler { type: "constant" value: 0.0 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+""")
+    (tmp_path / "test.prototxt").write_text("""
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 2 dim: 3 } shape { dim: 2 }
+    data_filler { type: "gaussian" std: 1.0 }
+    data_filler { type: "constant" value: 0.0 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "probe" type: "InnerProduct" bottom: "ip" top: "probe"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+""")
+    sf = tmp_path / "solver.prototxt"
+    sf.write_text('train_net: "train.prototxt"\n'
+                  'test_net: "test.prototxt"\nbase_lr: 0.1\ntest_iter: 1\n')
+    solver = caffe.get_solver(str(sf))
+    tn = solver.test_nets[0]
+    # shared mirror for the matching layer, private for the extra one
+    assert tn.params["ip"][0] is solver.net.params["ip"][0]
+    assert "probe" not in solver.net.params and "probe" in tn.params
+    solver.step(3)
+    # core Solver test pass runs the dedicated net incl. the extra layer
+    scores = solver._solver.test(1)
+    assert "loss" in scores and "probe" in scores

@@ -381,3 +381,37 @@ def test_time_net_runs_and_trace_degrades(capsys):
     assert ("Per-layer device time" in out      # TPU/GPU rig
             or "layer scopes" in out            # captured, no device plane
             or "device plane" in out)           # no plane at all
+
+
+def test_caffe_cli_resolves_test_net_files(tmp_path):
+    """`test_net:` file references load into test_net_param (the
+    Solver::InitTestNets path), alongside `net:` resolution."""
+    (tmp_path / "train.prototxt").write_text("""
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 4 dim: 3 } shape { dim: 4 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+""")
+    (tmp_path / "test.prototxt").write_text("""
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 2 dim: 3 } shape { dim: 2 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+""")
+    solver_path = tmp_path / "solver.prototxt"
+    solver_path.write_text('train_net: "train.prototxt"\n'
+                           'test_net: "test.prototxt"\n'
+                           'base_lr: 0.1\ntest_iter: 1\n')
+    from sparknet_tpu.proto import load_solver_prototxt
+    from sparknet_tpu.solvers import Solver
+    from sparknet_tpu.tools.caffe_cli import _resolve_solver_net
+    sp = load_solver_prototxt(str(solver_path))
+    _resolve_solver_net(sp, str(solver_path))
+    assert len(sp.test_net_param) == 1
+    solver = Solver(sp, seed=0)
+    # dedicated test net: batch 2, not the train net's 4
+    scores = solver.test(1)
+    assert "loss" in scores
+    assert solver.test_net.blob_shapes["data"] == (2, 3)
