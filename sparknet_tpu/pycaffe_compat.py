@@ -396,11 +396,10 @@ class _PySolver:
         test_params = list(sp.test_net_param) or (
             [net_param] if sp.test_iter else [])
         for tp in test_params:
-            tn = Net(serialize(tp.to_pmsg()), phase=TEST,
-                     initial_params={**self._solver._test_extra,
-                                     **self._solver.params})
-            # share the train mirrors for matching layers; test-only
-            # layers keep their own (filler-init) mirrors
+            # each test net runs its own filler init (covers layers the
+            # train net lacks — any test net, not just the first), then
+            # matching layers share the train mirrors
+            tn = Net(serialize(tp.to_pmsg()), phase=TEST)
             for k in tn.params:
                 if k in self.net.params:
                     tn.params[k] = self.net.params[k]
@@ -423,6 +422,13 @@ class _PySolver:
         self._solver.params = {
             k: [np.asarray(b.data) for b in v]
             for k, v in self.net.params.items()}
+        # surgery on test-only layers reaches the solver's test pass too
+        if self.test_nets and self._solver._test_extra:
+            tn = self.test_nets[0]
+            for k in list(self._solver._test_extra):
+                if k in tn.params:
+                    self._solver._test_extra[k] = [
+                        np.asarray(b.data) for b in tn.params[k]]
 
     def _pull(self) -> None:
         for k, v in self._solver.params.items():

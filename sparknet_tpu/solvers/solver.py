@@ -72,12 +72,18 @@ class Solver:
         self.params: WeightCollection = self.train_net.init(init_rng)
         # a dedicated test net may own layers the train net lacks; those
         # keep their filler init while matching layers share trained
-        # params (Net::ShareTrainedLayersWith, net.cpp:737)
+        # params (Net::ShareTrainedLayersWith, net.cpp:737).  Probe key
+        # sets shape-only first — the full filler init runs only when the
+        # test net actually has extra layers.
         self._test_extra: WeightCollection = {}
         if self._dedicated_test_net:
-            full = self.test_net.init(jax.random.fold_in(init_rng, 1))
-            self._test_extra = {k: v for k, v in full.items()
-                                if k not in self.params}
+            probe = jax.eval_shape(
+                lambda r: self.test_net.init(r),
+                jax.ShapeDtypeStruct((2,), jnp.uint32))
+            if any(k not in self.params for k in probe):
+                full = self.test_net.init(jax.random.fold_in(init_rng, 1))
+                self._test_extra = {k: v for k, v in full.items()
+                                    if k not in self.params}
         self.state = self.rule.init(self.params)
         self.iter = 0
         self._lr_mults = self.train_net.lr_mult_tree(self.params)
@@ -262,8 +268,6 @@ class Solver:
         # outputs pass through element-wise (Accuracy's per-class second
         # top stays a vector) — Solver::TestAndStoreResult accumulates
         # every element of every output blob (solver.cpp:413-445)
-        if self._test_extra:  # test-net-only layers keep filler init
-            params = {**self._test_extra, **params}
         out = self.test_net.apply(params, batch, train=False, rng=rng)
         return dict(out.blobs)
 
@@ -278,12 +282,16 @@ class Solver:
         it = self._test_iter_factory()
         needs_rng = any(n.impl.needs_rng(n.lp, False)
                         for n in self.test_net.nodes)
+        # test-net-only layers keep filler init; merged as jit ARGUMENTS
+        # (not trace constants) so surgery on them is honored per call
+        params = ({**self._test_extra, **self.params} if self._test_extra
+                  else self.params)
         totals: dict[str, Any] = {}
         for _ in range(num_steps):
             rng = None
             if needs_rng:  # stochastic data layers (gaussian DummyData)
                 self._rng, rng = jax.random.split(self._rng)
-            scores = self._test_fwd(self.params, dict(next(it)), rng)
+            scores = self._test_fwd(params, dict(next(it)), rng)
             for k, v in scores.items():
                 val = float(v) if np.ndim(v) == 0 else np.asarray(v)
                 totals[k] = val if k not in totals else totals[k] + val

@@ -1,0 +1,20 @@
+"""The committed example scripts must stay runnable (they are the
+switching-user's orientation, mirroring the reference's pycaffe
+example notebooks)."""
+
+import os
+import runpy
+
+import pytest
+
+
+def test_pycaffe_workflow_example(capsys):
+    cwd = os.getcwd()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        runpy.run_path(os.path.join(repo, "examples", "pycaffe_workflow.py"),
+                       run_name="__main__")
+    finally:
+        os.chdir(cwd)
+    out = capsys.readouterr().out
+    assert "OK" in out and "class probabilities" in out

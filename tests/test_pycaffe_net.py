@@ -500,3 +500,37 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
     # core Solver test pass runs the dedicated net incl. the extra layer
     scores = solver._solver.test(1)
     assert "loss" in scores and "probe" in scores
+
+
+def test_surgery_on_test_only_layer_reaches_test_pass(tmp_path):
+    """Edits to a test-net-only layer's mirrors are honored by the core
+    solver's test pass (pushed with step/solve, merged as jit args)."""
+    (tmp_path / "train.prototxt").write_text("""
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 4 dim: 3 } shape { dim: 4 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+""")
+    (tmp_path / "test.prototxt").write_text("""
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 4 dim: 3 } shape { dim: 4 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "probe" type: "InnerProduct" bottom: "ip" top: "probe"
+  inner_product_param { num_output: 1
+    weight_filler { type: "constant" value: 1.0 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+""")
+    sf = tmp_path / "solver.prototxt"
+    sf.write_text('train_net: "train.prototxt"\ntest_net: "test.prototxt"\n'
+                  'base_lr: 0.0\ntest_iter: 1\n')
+    solver = caffe.get_solver(str(sf))
+    tn = solver.test_nets[0]
+    # zero the probe layer through the test-net mirrors; base_lr 0 so
+    # nothing else moves
+    tn.params["probe"][0].data[...] = 0.0
+    tn.params["probe"][1].data[...] = 0.0
+    solver.step(1)  # pushes mirrors incl. test-only extras
+    scores = solver._solver.test(1)
+    assert float(np.sum(scores["probe"])) == 0.0
