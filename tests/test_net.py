@@ -211,3 +211,35 @@ def test_bf16_compute_dtype(rng):
     g = jax.grad(loss_fn)(params)
     assert g["conv1"][0].dtype == jnp.float32
     assert float(jnp.max(jnp.abs(g["ip2"][0]))) > 0
+
+
+def test_output_blobs_order_and_inplace_survivors():
+    """output_blobs: Caffe's available-blob walk (in-place tails stay
+    outputs), ordered by first production — Classifier/Detector index
+    output_blobs[-1] expecting the LAST-produced head (classify.py:112)."""
+    from sparknet_tpu.graph import Net as GraphNet
+    from sparknet_tpu.proto import NetState, Phase, load_net_prototxt
+
+    text = """
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer { name: "feat" type: "InnerProduct" bottom: "data" top: "feat"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "feat" top: "prob" }
+layer { name: "featrelu" type: "ReLU" bottom: "feat" top: "feat" }
+"""
+    net = GraphNet(load_net_prototxt(text), NetState(Phase.TEST))
+    # 'feat' survives (the trailing in-place ReLU re-adds it) but 'prob'
+    # is produced last -> output_blobs[-1] stays the classifier head
+    assert net.output_blobs == ["feat", "prob"]
+
+    # a net ENDING with an in-place layer still has an output at all
+    tail = """
+input: "data"
+input_shape { dim: 1 dim: 2 }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "ip" top: "ip" }
+"""
+    net2 = GraphNet(load_net_prototxt(tail), NetState(Phase.TEST))
+    assert net2.output_blobs == ["ip"]
