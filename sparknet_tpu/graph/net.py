@@ -81,7 +81,7 @@ class Net:
     """A phase-filtered, shape-inferred, executable network."""
 
     def __init__(self, net_param: NetParameter, state: NetState | None = None,
-                 *, compute_dtype=None):
+                 *, compute_dtype=None, input_overrides=None):
         if state is None:
             state = net_param.state or NetState()
         self.state = state
@@ -91,10 +91,17 @@ class Net:
         self.nodes: list[_LayerNode] = []
         self.blob_shapes: dict[str, Shape] = {}
         self.input_blobs: dict[str, Shape] = {}
+        # input_overrides: {input blob name: shape} replacing the declared
+        # shape of net-level inputs / Input-layer tops — the pycaffe
+        # Net::Reshape path (net.cpp:Reshape propagates new bottom shapes;
+        # here downstream shapes re-infer from the overridden inputs)
+        overrides = {k: tuple(int(d) for d in v)
+                     for k, v in (input_overrides or {}).items()}
 
         # net-level input declarations (legacy `input:` + `input_shape:`)
         for i, name in enumerate(self.param.input):
-            shape = tuple(self.param.input_shape[i].dim)
+            shape = overrides.get(name,
+                                  tuple(self.param.input_shape[i].dim))
             self.blob_shapes[name] = shape
             self.input_blobs[name] = shape
 
@@ -135,6 +142,11 @@ class Net:
             if taints:
                 tainted.update(tops)
             if getattr(impl, "is_input", lambda: False)():
+                if overrides:
+                    oshapes = [overrides.get(t, tuple(int(d) for d in s))
+                               for t, s in zip(tops, oshapes)]
+                    for t, s in zip(tops, oshapes):
+                        self.blob_shapes[t] = tuple(int(d) for d in s)
                 for t, s in zip(tops, oshapes):
                     self.input_blobs[t] = tuple(int(d) for d in s)
 
@@ -182,6 +194,10 @@ class Net:
                 order[t] = None
         self.output_blobs = [t for t in order
                              if t in available and t not in self.input_blobs]
+        unknown = set(overrides) - set(self.input_blobs)
+        if unknown:
+            raise ValueError(
+                f"input_overrides for non-input blobs: {sorted(unknown)}")
 
     @staticmethod
     def _check_batch_insensitive(lp, impl, bottoms, bshapes, tainted) -> None:

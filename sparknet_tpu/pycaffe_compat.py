@@ -19,10 +19,12 @@ Covers what pycaffe scripts actually touch:
 - ``caffe.Classifier`` / ``caffe.Detector`` / ``caffe.draw`` are
   re-exported from their homes in this package.
 
-Differences by design: shapes are static (XLA compiles per shape), so
-``net.blobs['data'].reshape(...)`` is unsupported — build the net with
-the shapes you need; ``forward(start=...)`` is unsupported (functional
-graphs re-run from the inputs; use ``end=`` truncation).
+Differences by design: shapes are static (XLA compiles per shape).
+``net.blobs['data'].reshape(...)`` + ``net.reshape()`` (the deploy
+batch-size idiom, _caffe.cpp:180-189,227) IS supported — it rebuilds
+shape inference and recompiles on the next forward, shape-keyed.
+``forward(start=...)`` is unsupported (functional graphs re-run from the
+inputs; use ``end=`` truncation).
 
 Usage::
 
@@ -129,8 +131,8 @@ class Net:
 
         self._train = phase == TRAIN
         net_param = load_net_prototxt(model)
-        self._net = GraphNet(net_param, NetState(
-            Phase.TRAIN if self._train else Phase.TEST))
+        self._state = NetState(Phase.TRAIN if self._train else Phase.TEST)
+        self._net = GraphNet(net_param, self._state)
         if initial_params is not None:
             # pre-built collection (solver views share one init)
             params = initial_params
@@ -196,6 +198,55 @@ class Net:
         return list(self._net.output_blobs)
 
     # -- execution --------------------------------------------------------
+    def reshape(self) -> None:
+        """Re-infer every blob shape after input-blob reshapes — pycaffe
+        ``Net.reshape`` (reference: _caffe.cpp:227 bp::def("reshape",
+        &Net::Reshape) with per-blob ``Blob.reshape`` at
+        _caffe.cpp:180-189).  The deploy idiom::
+
+            net.blobs['data'].reshape(1, 3, H, W)
+            net.reshape()          # optional — forward() calls it
+            net.blobs['data'].data[...] = img
+            net.forward()
+
+        Static-shape model underneath: a changed input shape rebuilds the
+        graph net keyed on the new shapes and drops compiled programs (jit
+        recompiles on next forward; the cache is shape-keyed).  Reshapes
+        that would change PARAM shapes (e.g. a different flattened dim
+        into an InnerProduct) are refused, like Caffe, where layer weight
+        shapes are fixed at setup."""
+        import jax
+
+        from .graph import Net as GraphNet
+        overrides = {name: tuple(self.blobs[name].data.shape)
+                     for name in self._net.input_blobs}
+        if all(overrides[n] == tuple(s)
+               for n, s in self._net.input_blobs.items()):
+            return
+        new_net = GraphNet(self._net_param, self._state,
+                           input_overrides=overrides)
+        probe = jax.eval_shape(lambda r: new_net.init(r),
+                               jax.ShapeDtypeStruct((2,), np.uint32))
+        for k, shapes in ((k, [b.shape for b in v])
+                          for k, v in probe.items()):
+            mine = self.params.get(k)
+            if mine is not None and [b.data.shape for b in mine] != shapes:
+                raise ValueError(
+                    f"reshape would change param shapes of layer {k!r} "
+                    f"({[b.data.shape for b in mine]} -> {shapes}); "
+                    f"parameter shapes are fixed at net construction")
+        self._net = new_net
+        self._fwd_cache.clear()
+        self._needs_rng = any(n.impl.needs_rng(n.lp, self._train)
+                              for n in self._net.nodes)
+        PyBlob = _pyblob_cls()
+        for name, shape in self._net.blob_shapes.items():
+            if name in self._net.input_blobs:
+                continue  # mirrors hold user data at the new shape already
+            if (name not in self.blobs
+                    or tuple(self.blobs[name].data.shape) != tuple(shape)):
+                self.blobs[name] = PyBlob(np.zeros(shape, np.float32))
+
     def _device_params(self):
         return {k: [b.data for b in v] for k, v in self.params.items()}
 
@@ -208,9 +259,23 @@ class Net:
                 raise ValueError(
                     f"input {name!r} has shape {arr.shape}, net expects "
                     f"{shape} (static shapes: build the net with the "
-                    f"shapes you need; pycaffe reshape is unsupported)")
-            self.blobs[name].data = arr
-            inputs[name] = arr
+                    f"shapes you need, or reshape the input blob first "
+                    f"— net.blobs[{name!r}].reshape(...))")
+            if name in kwargs:
+                # copy INTO the blob's own buffer: rebinding would alias
+                # the caller's array, so later mirror writes
+                # (net.blobs[n].data[...] = v) would silently mutate it
+                # (reference pycaffe copies into blob storage)
+                mirror = self.blobs[name].data
+                if mirror.shape == arr.shape and mirror.dtype == arr.dtype:
+                    mirror[...] = arr
+                else:
+                    self.blobs[name].data = np.array(arr)
+            else:
+                # mirror-sourced: feed the float32 coercion (no-op unless
+                # the user rebound the mirror to another dtype)
+                self.blobs[name].data = arr
+            inputs[name] = self.blobs[name].data
         unknown = set(kwargs) - set(self._net.input_blobs)
         if unknown:
             raise ValueError(f"not input blobs: {sorted(unknown)}")
@@ -226,6 +291,11 @@ class Net:
         if end is not None and end not in self._layer_names:
             raise ValueError(
                 f"unknown layer {end!r} (layers: {self._layer_names})")
+        for b in blobs or ():
+            if b not in self._net.blob_shapes:
+                raise ValueError(f"unknown blob {b!r} in blobs")
+        self.reshape()  # honor pending input-blob reshapes (Net::Forward
+        #                 reshapes before running, _caffe.cpp forward path)
         if self._feedable:
             # data layers win over mirror contents (their Forward
             # overwrites the top blobs each call in the reference)
@@ -255,6 +325,16 @@ class Net:
         if end is not None:
             node = next(n for n in self._net.nodes if n.lp.name == end)
             wanted = list(node.tops)
+            # blobs produced by layers AFTER the truncation point have
+            # stale mirrors (zeros or a previous forward's values) —
+            # refuse rather than silently return them
+            computed = set(out) | set(self._net.input_blobs)
+            stale = [b for b in blobs or () if b not in computed]
+            if stale:
+                raise ValueError(
+                    f"blobs {stale} are produced after end={end!r}; "
+                    f"their contents would be stale — drop end= or "
+                    f"request blobs computed up to it")
         else:
             wanted = list(self._net.output_blobs)
         for extra in blobs or []:
@@ -423,12 +503,11 @@ class _PySolver:
             k: [np.asarray(b.data) for b in v]
             for k, v in self.net.params.items()}
         # surgery on test-only layers reaches the solver's test pass too
-        if self.test_nets and self._solver._test_extra:
-            tn = self.test_nets[0]
-            for k in list(self._solver._test_extra):
+        # — for EVERY test net (the reference evaluates them all)
+        for tn, extra in zip(self.test_nets, self._solver._test_extras):
+            for k in list(extra):
                 if k in tn.params:
-                    self._solver._test_extra[k] = [
-                        np.asarray(b.data) for b in tn.params[k]]
+                    extra[k] = [np.asarray(b.data) for b in tn.params[k]]
 
     def _pull(self) -> None:
         for k, v in self._solver.params.items():

@@ -32,10 +32,12 @@ def oversample(images, crop_dims) -> np.ndarray:
                              f"{(ch, cw)}")
         starts = [(0, 0), (0, w - cw), (h - ch, 0), (h - ch, w - cw),
                   ((h - ch) // 2, (w - cw) // 2)]
-        for y, x in starts:
-            crop = im[y:y + ch, x:x + cw]
-            out.append(crop)
-            out.append(crop[:, ::-1])
+        # Reference ordering (io.py oversample): the 5 crops first, then
+        # the same 5 mirrored as a block — scripts index positions
+        # (e.g. first 5 = unmirrored).
+        crops = [im[y:y + ch, x:x + cw] for y, x in starts]
+        out.extend(crops)
+        out.extend(c[:, ::-1] for c in crops)
     return np.stack(out)
 
 

@@ -57,15 +57,18 @@ class Solver:
             seed = sp.random_seed if sp.random_seed >= 0 else 0
         self.train_net = Net(net_param, NetState(Phase.TRAIN),
                              compute_dtype=compute_dtype)
-        # a dedicated test net definition wins (Solver::InitTestNets
+        # dedicated test net definitions win (Solver::InitTestNets
         # precedence, solver.cpp:104-172: test_net_param > test_net file >
         # shared net); `test_net:` file paths must be resolved into
-        # test_net_param by the caller (proto.caffe_pb.resolve_solver_nets)
-        test_param = (sp.test_net_param[0] if sp.test_net_param
-                      else net_param)
-        self.test_net = Net(test_param, NetState(Phase.TEST),
-                            compute_dtype=compute_dtype)
-        self._dedicated_test_net = test_param is not net_param
+        # test_net_param by the caller (proto.caffe_pb.resolve_solver_nets).
+        # EVERY test_net entry is instantiated and evaluated, like the
+        # reference's test_nets_ vector (Solver::TestAll loops them all).
+        test_params = list(sp.test_net_param) or [net_param]
+        self.test_nets: list[Net] = [
+            Net(tp, NetState(Phase.TEST), compute_dtype=compute_dtype)
+            for tp in test_params]
+        self.test_net = self.test_nets[0]
+        self._dedicated_test_net = bool(sp.test_net_param)
         self.rule = make_update_rule(sp)
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_rng = jax.random.split(self._rng)
@@ -74,16 +77,20 @@ class Solver:
         # keep their filler init while matching layers share trained
         # params (Net::ShareTrainedLayersWith, net.cpp:737).  Probe key
         # sets shape-only first — the full filler init runs only when the
-        # test net actually has extra layers.
-        self._test_extra: WeightCollection = {}
-        if self._dedicated_test_net:
-            probe = jax.eval_shape(
-                lambda r: self.test_net.init(r),
-                jax.ShapeDtypeStruct((2,), jnp.uint32))
-            if any(k not in self.params for k in probe):
-                full = self.test_net.init(jax.random.fold_in(init_rng, 1))
-                self._test_extra = {k: v for k, v in full.items()
-                                    if k not in self.params}
+        # test net actually has extra layers.  One extra-collection per
+        # test net.
+        self._test_extras: list[WeightCollection] = []
+        for i, tn in enumerate(self.test_nets):
+            extra: WeightCollection = {}
+            if self._dedicated_test_net:
+                probe = jax.eval_shape(
+                    lambda r, tn=tn: tn.init(r),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+                if any(k not in self.params for k in probe):
+                    full = tn.init(jax.random.fold_in(init_rng, i + 1))
+                    extra = {k: v for k, v in full.items()
+                             if k not in self.params}
+            self._test_extras.append(extra)
         self.state = self.rule.init(self.params)
         self.iter = 0
         self._lr_mults = self.train_net.lr_mult_tree(self.params)
@@ -93,11 +100,16 @@ class Solver:
         self._signal_guard = None       # installed by solve(); polled per
         self._stop_requested = False    # iteration inside step()
         self._train_iter: Iterator[Mapping[str, Any]] | None = None
-        self._test_iter_factory: Callable[[], Iterator[Mapping[str, Any]]] | None = None
+        self._test_iter_factories: list[
+            Callable[[], Iterator[Mapping[str, Any]]] | None] = \
+            [None] * len(self.test_nets)
 
         step = self.make_train_step()
         self._step = jax.jit(step, donate_argnums=(0, 1)) if jit else step
-        self._test_fwd = jax.jit(self._test_forward) if jit else self._test_forward
+        self._test_fwds = [
+            (jax.jit(f) if jit else f)
+            for f in (self._make_test_forward(tn) for tn in self.test_nets)]
+        self._test_fwd = self._test_fwds[0]
 
     # -- pure step construction ------------------------------------------
     def make_train_step(self):
@@ -116,18 +128,29 @@ class Solver:
     def set_train_data(self, it: Iterator[Mapping[str, Any]]) -> None:
         self._train_iter = it
 
-    def set_test_data(self, factory: Callable[[], Iterator[Mapping[str, Any]]]) -> None:
-        self._test_iter_factory = factory
+    def set_test_data(self, factory: Callable[[], Iterator[Mapping[str, Any]]],
+                      net_id: int = 0) -> None:
+        self._test_iter_factories[net_id] = factory
 
-    def _ensure_test_factory(self) -> None:
+    @property
+    def _test_iter_factory(self):
+        return self._test_iter_factories[0]
+
+    @property
+    def _test_extra(self) -> WeightCollection:
+        """Test-only params of test net 0 (back-compat alias; per-net
+        collections live in ``_test_extras``)."""
+        return self._test_extras[0]
+
+    def _ensure_test_factory(self, net_id: int = 0) -> None:
         """Self-sourcing test nets (DummyData etc.) evaluate without an
         explicit feed; nets with input blobs still require one."""
-        if self._test_iter_factory is None:
-            if self.test_net.input_blobs:
+        if self._test_iter_factories[net_id] is None:
+            if self.test_nets[net_id].input_blobs:
                 raise RuntimeError(
                     "no test data set; call set_test_data first")
             import itertools
-            self._test_iter_factory = lambda: itertools.repeat({})
+            self._test_iter_factories[net_id] = lambda: itertools.repeat({})
 
     # -- Solver::Step (reference: solver.cpp:193-283) ---------------------
     def step(self, n: int) -> float:
@@ -188,10 +211,12 @@ class Solver:
         from ..utils.signals import SignalGuard
         sp = self.sp
         max_iter = max_iter or sp.max_iter or 100
-        if sp.test_interval and not self.test_net.input_blobs:
-            self._ensure_test_factory()  # self-sourcing test net
+        if sp.test_interval:
+            for i, tn in enumerate(self.test_nets):
+                if not tn.input_blobs:
+                    self._ensure_test_factory(i)  # self-sourcing test net
         interval = sp.test_interval \
-            if (sp.test_interval and self._test_iter_factory) else 0
+            if (sp.test_interval and any(self._test_iter_factories)) else 0
         test_iter = sp.test_iter[0] if sp.test_iter else 50
         can_snapshot = bool(sp.snapshot_prefix)
         if interval and self.iter % interval == 0 and (
@@ -221,14 +246,25 @@ class Solver:
         print("Optimization Done.")
         return loss
 
-    def _print_test_scores(self, test_iter: int) -> None:
-        for k, v in self.test(test_iter).items():
-            arr = np.asarray(v, np.float64) / test_iter
-            if arr.ndim == 0:
-                print(f"    Test net output: {k} = {float(arr):.6f}")
-            else:  # per-element, like Caffe's indexed test outputs
-                for i, x in enumerate(arr.reshape(-1)):
-                    print(f"    Test net output: {k}[{i}] = {float(x):.6f}")
+    def _print_test_scores(self, default_iter: int) -> None:
+        """Evaluate every testable net in turn (Solver::TestAll,
+        solver.cpp:407-411) with its own test_iter."""
+        multi = len(self.test_nets) > 1
+        for n in range(len(self.test_nets)):
+            if (self._test_iter_factories[n] is None
+                    and self.test_nets[n].input_blobs):
+                continue  # this net has no feed; skip rather than raise
+            ti = (self._test_iter_for(n) if self.sp.test_iter
+                  else default_iter)
+            tag = f" #{n}" if multi else ""
+            for k, v in self.test(ti, net_id=n).items():
+                arr = np.asarray(v, np.float64) / ti
+                if arr.ndim == 0:
+                    print(f"    Test net{tag} output: {k} = {float(arr):.6f}")
+                else:  # per-element, like Caffe's indexed test outputs
+                    for i, x in enumerate(arr.reshape(-1)):
+                        print(f"    Test net{tag} output: "
+                              f"{k}[{i}] = {float(x):.6f}")
 
     def _log_debug_info(self, stacked, params_before, rng) -> None:
         """Per-blob/param mean-|x| dumps behind ``sp.debug_info`` — the
@@ -264,38 +300,51 @@ class Solver:
 
     # -- test pass (Solver::TestAndStoreResult; reference:
     #    solver.cpp:413-445 + ccaffe.cpp:179-187) -------------------------
-    def _test_forward(self, params, batch, rng=None):
+    @staticmethod
+    def _make_test_forward(tn: Net):
         # outputs pass through element-wise (Accuracy's per-class second
         # top stays a vector) — Solver::TestAndStoreResult accumulates
         # every element of every output blob (solver.cpp:413-445)
-        out = self.test_net.apply(params, batch, train=False, rng=rng)
-        return dict(out.blobs)
+        def fwd(params, batch, rng=None):
+            out = tn.apply(params, batch, train=False, rng=rng)
+            return dict(out.blobs)
+        return fwd
 
-    def test(self, num_steps: int | None = None) -> dict[str, Any]:
-        """Run the weight-sharing test net ``num_steps`` times, accumulating
-        each output-blob element (the JVM then averages across workers —
-        reference: ImageNetApp.scala:138-140).  Scalar outputs come back
-        as floats; vector outputs (per-class accuracy) as numpy arrays."""
-        self._ensure_test_factory()
+    def test(self, num_steps: int | None = None,
+             net_id: int = 0) -> dict[str, Any]:
+        """Run weight-sharing test net ``net_id`` ``num_steps`` times,
+        accumulating each output-blob element (the JVM then averages
+        across workers — reference: ImageNetApp.scala:138-140).  Scalar
+        outputs come back as floats; vector outputs (per-class accuracy)
+        as numpy arrays.  Solver::Test(test_net_id), solver.cpp:413-445."""
+        self._ensure_test_factory(net_id)
         if num_steps is None:
-            num_steps = self.sp.test_iter[0] if self.sp.test_iter else 1
-        it = self._test_iter_factory()
-        needs_rng = any(n.impl.needs_rng(n.lp, False)
-                        for n in self.test_net.nodes)
+            num_steps = self._test_iter_for(net_id)
+        it = self._test_iter_factories[net_id]()
+        tn = self.test_nets[net_id]
+        needs_rng = any(n.impl.needs_rng(n.lp, False) for n in tn.nodes)
         # test-net-only layers keep filler init; merged as jit ARGUMENTS
         # (not trace constants) so surgery on them is honored per call
-        params = ({**self._test_extra, **self.params} if self._test_extra
-                  else self.params)
+        extra = self._test_extras[net_id]
+        params = {**extra, **self.params} if extra else self.params
         totals: dict[str, Any] = {}
         for _ in range(num_steps):
             rng = None
             if needs_rng:  # stochastic data layers (gaussian DummyData)
                 self._rng, rng = jax.random.split(self._rng)
-            scores = self._test_fwd(params, dict(next(it)), rng)
+            scores = self._test_fwds[net_id](params, dict(next(it)), rng)
             for k, v in scores.items():
                 val = float(v) if np.ndim(v) == 0 else np.asarray(v)
                 totals[k] = val if k not in totals else totals[k] + val
         return totals
+
+    def _test_iter_for(self, net_id: int) -> int:
+        """Per-net test_iter (repeated field, one per test net like the
+        reference's check at solver.cpp:36-44); last value repeats."""
+        ti = self.sp.test_iter
+        if not ti:
+            return 1
+        return ti[net_id] if net_id < len(ti) else ti[-1]
 
     # -- checkpointing (Solver::Snapshot/Restore; reference:
     #    solver.cpp:447-530, sgd_solver.cpp:242-296; FFI surface

@@ -40,15 +40,16 @@ def _train(args) -> int:
 
     net_param = sp.net_param or sp.train_net_param
     solver.set_train_data(device_feed(feed_for_net(net_param, Phase.TRAIN)))
-    # test feed comes from the net the Solver actually evaluates: a
+    # test feeds come from the nets the Solver actually evaluates: every
     # dedicated test_net definition when present, else the shared net
-    test_source = sp.test_net_param[0] if sp.test_net_param else net_param
-    try:
-        test_feed_factory = lambda: feed_for_net(test_source, Phase.TEST)
-        test_feed_factory()  # probe
-        solver.set_test_data(test_feed_factory)
-    except ValueError:
-        test_feed_factory = None
+    test_sources = list(sp.test_net_param) or [net_param]
+    for i, ts in enumerate(test_sources):
+        try:
+            factory = lambda ts=ts: feed_for_net(ts, Phase.TEST)
+            factory()  # probe
+            solver.set_test_data(factory, net_id=i)
+        except ValueError:
+            pass
 
     solver.solve()
     if sp.snapshot_prefix:

@@ -315,13 +315,17 @@ def test_net_spec_include_rule_and_typo_detection():
 
 
 def test_io_oversample_reference_layout():
+    """Reference ordering (io.py oversample): per image, the 4 corners +
+    center first, then the SAME 5 mirrored as a block — scripts index
+    positions (first 5 = unmirrored)."""
     rng = np.random.default_rng(0)
     img = rng.uniform(size=(8, 10, 3)).astype(np.float32)
     crops = caffe.io.oversample([img], (4, 6))
     assert crops.shape == (10, 4, 6, 3)
     np.testing.assert_array_equal(crops[0], img[:4, :6])       # corner
-    np.testing.assert_array_equal(crops[1], img[:4, :6][:, ::-1])  # mirror
-    np.testing.assert_array_equal(crops[8], img[2:6, 2:8])     # center
+    np.testing.assert_array_equal(crops[4], img[2:6, 2:8])     # center
+    for i in range(5):                                         # mirror block
+        np.testing.assert_array_equal(crops[5 + i], crops[i][:, ::-1])
     with pytest.raises(ValueError, match="smaller than crop"):
         caffe.io.oversample([img], (9, 6))
     with pytest.raises(ValueError, match="Mean channels"):
@@ -534,3 +538,92 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
     solver.step(1)  # pushes mirrors incl. test-only extras
     scores = solver._solver.test(1)
     assert float(np.sum(scores["probe"])) == 0.0
+
+
+def test_blob_reshape_deploy_idiom(net):
+    """The single most common pycaffe deploy idiom (reference
+    _caffe.cpp:180-189 Blob.reshape, :227 Net.reshape): reshape the input
+    blob to batch 1, forward at the new shape.  Shape-keyed rebuild +
+    recompile underneath."""
+    rng = np.random.default_rng(3)
+    x4 = rng.normal(size=(4, 1, 6, 6)).astype(np.float32)
+    base = net.forward(data=x4)["ip"].copy()
+    net.blobs["data"].reshape(1, 1, 6, 6)
+    net.blobs["data"].data[...] = x4[:1]
+    out = net.forward()  # implicit net.reshape()
+    assert out["ip"].shape == (1, 3)
+    np.testing.assert_allclose(out["ip"], base[:1], rtol=1e-4, atol=1e-5)
+    assert net.blobs["conv"].data.shape == (1, 2, 4, 4)
+    # explicit net.reshape() propagates downstream shapes immediately
+    net.blobs["data"].reshape(2, 1, 6, 6)
+    net.reshape()
+    assert net.blobs["ip"].data.shape == (2, 3)
+    # back to the original shape, same numbers as the first forward
+    net.blobs["data"].reshape(4, 1, 6, 6)
+    net.blobs["data"].data[...] = x4
+    np.testing.assert_allclose(net.forward()["ip"], base,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_reshape_changing_param_shapes_refused(net):
+    """A reshape that would re-size layer PARAMS (different flattened dim
+    into the InnerProduct) is refused with a clear error — weight shapes
+    are fixed at setup, as in Caffe."""
+    net.blobs["data"].reshape(4, 1, 8, 8)
+    with pytest.raises(ValueError, match="param shapes"):
+        net.reshape()
+
+
+def test_forward_does_not_alias_caller_array(net):
+    """forward(data=x) must copy x into the blob mirror: later mirror
+    writes (net.blobs['data'].data[...] = v) must not mutate the
+    caller's array (reference pycaffe copies into blob storage)."""
+    x = np.random.default_rng(4).normal(size=(4, 1, 6, 6)).astype(np.float32)
+    x0 = x.copy()
+    net.forward(data=x)
+    net.blobs["data"].data[...] = 7.0
+    np.testing.assert_array_equal(x, x0)
+
+
+def test_forward_end_with_downstream_blob_refused(net):
+    """Requesting a blob produced AFTER the end= truncation point would
+    return stale mirror contents; the shim refuses instead."""
+    x = np.zeros((4, 1, 6, 6), np.float32)
+    with pytest.raises(ValueError, match="stale"):
+        net.forward(blobs=["ip"], end="conv", data=x)
+    out = net.forward(blobs=["data"], end="conv", data=x)
+    assert set(out) == {"conv", "data"}
+
+
+def test_multiple_test_nets_all_evaluated():
+    """With several test_net entries every net is instantiated, fed its
+    own test_iter, and evaluated (Solver::TestAll loops test_nets_);
+    surgery on ANY test net's extra layers reaches its test pass."""
+    mk = lambda name, batch: f"""
+name: "{name}"
+layer {{ name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param {{ shape {{ dim: {batch} dim: 3 }} shape {{ dim: {batch} }}
+    data_filler {{ type: "gaussian" std: 1.0 }}
+    data_filler {{ type: "constant" value: 0.0 }} }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 2 weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }}
+"""
+    import textwrap
+    solver_text = ("base_lr: 0.1\ntest_iter: 1\ntest_iter: 2\n"
+                   "net_param {" + textwrap.indent(mk("tr", 8), "  ") + "}\n"
+                   "test_net_param {" + textwrap.indent(mk("t0", 2), "  ")
+                   + "}\n"
+                   "test_net_param {" + textwrap.indent(mk("t1", 3), "  ")
+                   + "}\n")
+    solver = caffe.get_solver(solver_text)
+    assert len(solver.test_nets) == 2
+    assert solver.test_nets[0].blobs["data"].shape == (2, 3)
+    assert solver.test_nets[1].blobs["data"].shape == (3, 3)
+    # both test nets share the train mirrors
+    for tn in solver.test_nets:
+        assert tn.params["ip"][0] is solver.net.params["ip"][0]
+    solver.step(1)
+    s0 = solver._solver.test(net_id=0)   # defaults to test_iter[0] = 1
+    s1 = solver._solver.test(net_id=1)   # defaults to test_iter[1] = 2
+    assert "loss" in s0 and "loss" in s1

@@ -166,7 +166,8 @@ def test_solver_solve_schedule(capsys):
     orig = solver.test
     solver.set_train_data(feed())
     solver.set_test_data(lambda: feed())
-    solver.test = lambda n=None: (calls.append(solver.iter), orig(1))[1]
+    solver.test = lambda n=None, net_id=0: (calls.append(solver.iter),
+                                            orig(1))[1]
     solver.solve()
     assert solver.iter == 4
     # test at iters 0 (test_initialization), 2, 4 (final)
