@@ -152,6 +152,9 @@ class Net:
             (name, PyBlob(np.zeros(shape, np.float32)))
             for name, shape in self._net.blob_shapes.items())
         self._fwd_cache: dict = {}
+        self._shape_sig = tuple(sorted(
+            (k, tuple(v)) for k, v in self._net.input_blobs.items()))
+        self._net_cache: dict = {self._shape_sig: self._net}
         self._rng = jax.random.PRNGKey(0)
         self._last_rng = self._rng  # mask of the most recent forward
         self._needs_rng = any(n.impl.needs_rng(n.lp, self._train)
@@ -209,12 +212,13 @@ class Net:
             net.blobs['data'].data[...] = img
             net.forward()
 
-        Static-shape model underneath: a changed input shape rebuilds the
-        graph net keyed on the new shapes and drops compiled programs (jit
-        recompiles on next forward; the cache is shape-keyed).  Reshapes
-        that would change PARAM shapes (e.g. a different flattened dim
-        into an InnerProduct) are refused, like Caffe, where layer weight
-        shapes are fixed at setup."""
+        Static-shape model underneath: each input-shape signature gets its
+        own graph net + compiled programs, all cached — alternating the
+        deploy batch size switches between cached programs with no rebuild
+        or recompile after the first visit.  Reshapes that would change
+        PARAM shapes (e.g. a different flattened dim into an InnerProduct)
+        are refused, like Caffe, where layer weight shapes are fixed at
+        setup."""
         import jax
 
         from .graph import Net as GraphNet
@@ -223,20 +227,25 @@ class Net:
         if all(overrides[n] == tuple(s)
                for n, s in self._net.input_blobs.items()):
             return
-        new_net = GraphNet(self._net_param, self._state,
-                           input_overrides=overrides)
-        probe = jax.eval_shape(lambda r: new_net.init(r),
-                               jax.ShapeDtypeStruct((2,), np.uint32))
-        for k, shapes in ((k, [b.shape for b in v])
-                          for k, v in probe.items()):
-            mine = self.params.get(k)
-            if mine is not None and [b.data.shape for b in mine] != shapes:
-                raise ValueError(
-                    f"reshape would change param shapes of layer {k!r} "
-                    f"({[b.data.shape for b in mine]} -> {shapes}); "
-                    f"parameter shapes are fixed at net construction")
+        sig = tuple(sorted(overrides.items()))
+        new_net = self._net_cache.get(sig)
+        if new_net is None:
+            new_net = GraphNet(self._net_param, self._state,
+                               input_overrides=overrides)
+            probe = jax.eval_shape(lambda r: new_net.init(r),
+                                   jax.ShapeDtypeStruct((2,), np.uint32))
+            for k, shapes in ((k, [b.shape for b in v])
+                              for k, v in probe.items()):
+                mine = self.params.get(k)
+                if mine is not None and \
+                        [b.data.shape for b in mine] != shapes:
+                    raise ValueError(
+                        f"reshape would change param shapes of layer {k!r} "
+                        f"({[b.data.shape for b in mine]} -> {shapes}); "
+                        f"parameter shapes are fixed at net construction")
+            self._net_cache[sig] = new_net
         self._net = new_net
-        self._fwd_cache.clear()
+        self._shape_sig = sig
         self._needs_rng = any(n.impl.needs_rng(n.lp, self._train)
                               for n in self._net.nodes)
         PyBlob = _pyblob_cls()
@@ -262,15 +271,11 @@ class Net:
                     f"shapes you need, or reshape the input blob first "
                     f"— net.blobs[{name!r}].reshape(...))")
             if name in kwargs:
-                # copy INTO the blob's own buffer: rebinding would alias
-                # the caller's array, so later mirror writes
-                # (net.blobs[n].data[...] = v) would silently mutate it
-                # (reference pycaffe copies into blob storage)
-                mirror = self.blobs[name].data
-                if mirror.shape == arr.shape and mirror.dtype == arr.dtype:
-                    mirror[...] = arr
-                else:
-                    self.blobs[name].data = np.array(arr)
+                # bind an OWN copy, never the caller's array: the mirror
+                # must stay mutation-isolated from user data even if the
+                # forward below raises (reference pycaffe copies into
+                # blob storage)
+                self.blobs[name].data = np.array(arr)
             else:
                 # mirror-sourced: feed the float32 coercion (no-op unless
                 # the user rebound the mirror to another dtype)
@@ -294,6 +299,21 @@ class Net:
         for b in blobs or ():
             if b not in self._net.blob_shapes:
                 raise ValueError(f"unknown blob {b!r} in blobs")
+        if end is not None and blobs:
+            # refuse BEFORE running: blobs produced by layers after the
+            # truncation point would come back stale (zeros or a previous
+            # forward's values)
+            computed = set(self._net.input_blobs)
+            for n in self._net.nodes:
+                computed.update(n.tops)
+                if n.lp.name == end:
+                    break
+            stale = [b for b in blobs if b not in computed]
+            if stale:
+                raise ValueError(
+                    f"blobs {stale} are produced after end={end!r}; "
+                    f"their contents would be stale — drop end= or "
+                    f"request blobs computed up to it")
         self.reshape()  # honor pending input-blob reshapes (Net::Forward
         #                 reshapes before running, _caffe.cpp forward path)
         if self._feedable:
@@ -307,10 +327,11 @@ class Net:
                     Phase.TRAIN if self._train else Phase.TEST)
             batch = next(self._auto_feed)
             kwargs = {**batch, **kwargs}
-        key = ("fwd", end)
+        key = ("fwd", self._shape_sig, end)
         if key not in self._fwd_cache:
+            net = self._net  # bind THIS shape's net into the program
             self._fwd_cache[key] = jax.jit(
-                lambda p, x, r: self._net.apply_all(
+                lambda p, x, r: net.apply_all(
                     p, x, train=self._train, rng=r, upto=end))
         if self._needs_rng:  # fresh masks per forward (Caffe resamples)
             self._rng, self._last_rng = jax.random.split(self._rng)
@@ -325,16 +346,6 @@ class Net:
         if end is not None:
             node = next(n for n in self._net.nodes if n.lp.name == end)
             wanted = list(node.tops)
-            # blobs produced by layers AFTER the truncation point have
-            # stale mirrors (zeros or a previous forward's values) —
-            # refuse rather than silently return them
-            computed = set(out) | set(self._net.input_blobs)
-            stale = [b for b in blobs or () if b not in computed]
-            if stale:
-                raise ValueError(
-                    f"blobs {stale} are produced after end={end!r}; "
-                    f"their contents would be stale — drop end= or "
-                    f"request blobs computed up to it")
         else:
             wanted = list(self._net.output_blobs)
         for extra in blobs or []:
@@ -376,12 +387,13 @@ class Net:
         # only the seed arrays cross host->device; the dense zero
         # cotangents for every other blob materialize as constants
         # INSIDE the compiled program
-        key = ("bwd", extra, tuple(sorted(seeds)))
+        key = ("bwd", self._shape_sig, extra, tuple(sorted(seeds)))
         if key not in self._fwd_cache:
+            bwd_net = self._net  # bind THIS shape's net into the program
             def run_bwd(p, x, eps, seeds, r):
                 def fn(p, x, eps):
-                    return self._net.apply_all(p, x, train=self._train,
-                                               rng=r, eps=eps)
+                    return bwd_net.apply_all(p, x, train=self._train,
+                                             rng=r, eps=eps)
                 out, vjp = jax.vjp(fn, p, x, eps)
                 cts = {k: seeds[k] if k in seeds else jnp.zeros_like(v)
                        for k, v in out.items()}

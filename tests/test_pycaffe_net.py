@@ -563,6 +563,15 @@ def test_blob_reshape_deploy_idiom(net):
     net.blobs["data"].data[...] = x4
     np.testing.assert_allclose(net.forward()["ip"], base,
                                rtol=1e-4, atol=1e-5)
+    # revisiting a shape reuses the cached net + compiled program — the
+    # alternating deploy loop must not rebuild or recompile
+    n_nets, n_progs = len(net._net_cache), len(net._fwd_cache)
+    net.blobs["data"].reshape(1, 1, 6, 6)
+    net.blobs["data"].data[...] = x4[:1]
+    np.testing.assert_allclose(net.forward()["ip"], base[:1],
+                               rtol=1e-4, atol=1e-5)
+    assert len(net._net_cache) == n_nets
+    assert len(net._fwd_cache) == n_progs
 
 
 def test_reshape_changing_param_shapes_refused(net):

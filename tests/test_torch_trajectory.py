@@ -163,10 +163,10 @@ def test_forward_activation_fixture(tmp_path):
 
 
 def test_training_trajectory_tracks_torch(tmp_path):
-    """~100 steps of the full solver loop: per-step losses of the two
+    """~300 steps of the full solver loop: per-step losses of the two
     frameworks track within float32 drift tolerance, and final weights
     agree — same config ⇒ same trajectory."""
-    n_steps = 100
+    n_steps = 300
     solver = _make_solver()
     blobs = _export_initial_weights(solver, tmp_path)
     tq = TorchQuick(blobs)
@@ -190,13 +190,191 @@ def test_training_trajectory_tracks_torch(tmp_path):
     # identical math in different frameworks: tight at the start, f32
     # accumulation drift allowed to grow with steps
     np.testing.assert_allclose(ours[:10], theirs[:10], rtol=1e-4, atol=1e-5)
-    np.testing.assert_allclose(ours, theirs, rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(ours[:100], theirs[:100],
+                               rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-2, atol=2e-3)
     # and the trained weights still agree at the end
-    final = dict(_export_initial_weights(solver, tmp_path))  # iter_100 file
+    final = dict(_export_initial_weights(solver, tmp_path))  # iter_300 file
     for name in TorchQuick.LAYERS:
         np.testing.assert_allclose(
             np.asarray(final[name][0]), tq.p[name + ".w"].detach().numpy(),
-            rtol=5e-3, atol=5e-4)
+            rtol=2e-2, atol=2e-3)
+
+
+def test_multistep_lr_trajectory_tracks_torch(tmp_path):
+    """lr_policy "multistep" crossing TWO boundaries: the per-iteration
+    rate schedule of SGDSolver::GetLearningRate (sgd_solver.cpp:27-79,
+    multistep branch: current_step_ advances when iter_ >= stepvalue)
+    must agree with an independent transcription — rate factor at iter i
+    is gamma^#{v : i >= v}."""
+    n_steps = 75
+    netp = load_net_prototxt(open(REF_NET).read())
+    netp = replace_data_layers(netp, BATCH, BATCH, 3, 32, 32)
+    sp = load_solver_prototxt_with_net(
+        ("base_lr: 0.001\nmomentum: 0.9\nweight_decay: 0.004\n"
+         'lr_policy: "multistep"\ngamma: 0.1\n'
+         "stepvalue: 25\nstepvalue: 50\n"), netp)
+    solver = Solver(sp, seed=0)
+    blobs = _export_initial_weights(solver, tmp_path)
+    tq = TorchQuick(blobs)
+    batches = _batches(n_steps, seed=7)
+
+    solver.set_train_data(iter(batches))
+    ours, wdeltas = [], []
+    prev_w = np.array(np.asarray(solver.params["conv1"][0]))
+    for _ in range(n_steps):
+        solver.step(1)
+        ours.append(solver._smoothed[-1])
+        cur_w = np.asarray(solver.params["conv1"][0])
+        wdeltas.append(float(np.abs(cur_w - prev_w).mean()))
+        prev_w = np.array(cur_w)
+    theirs = []
+    for i, b in enumerate(batches):
+        _, loss = tq.forward(torch.tensor(b["data"]),
+                             torch.tensor(b["label"], dtype=torch.long))
+        rate = 0.001 * (0.1 ** sum(i >= v for v in (25, 50)))
+        tq.sgd_step(loss, base_lr=rate)
+        theirs.append(float(loss))
+    np.testing.assert_allclose(ours, theirs, rtol=5e-3, atol=5e-4)
+    # the boundaries bite: weight motion scales with the rate (modulo the
+    # 0.9^k decay of pre-boundary momentum history) — two drops of 10x
+    # leave late-window motion far below the full-rate window
+    assert np.mean(wdeltas[65:75]) < 0.3 * np.mean(wdeltas[15:25])
+
+
+# -- BN-bearing net (cifar10_full_sigmoid_bn shape) --------------------------
+
+BN_NET = ("/root/reference/caffe/examples/cifar10/"
+          "cifar10_full_sigmoid_train_test_bn.prototxt")
+
+
+class TorchSigmoidBN:
+    """cifar10_full_sigmoid_bn transcribed from the prototxt and
+    batch_norm_layer.cpp, NOT from this repo's graph code:
+    conv(no bias)→maxpool→BN→sigmoid / conv→BN→sigmoid→avepool /
+    conv→BN→sigmoid→avepool / ip1.  Caffe BatchNorm: train-mode
+    normalization by BATCH stats (biased variance), running blobs kept as
+    λ-decayed sums with a scale factor (blobs_[2]), variance stored with
+    the m/(m-1) unbiased correction; eval divides blobs by the scale
+    factor (batch_norm_layer.cpp:Forward_cpu)."""
+
+    CONVS = ["conv1", "conv2", "conv3"]
+    BNS = ["bn1", "bn2", "bn3"]
+    EPS, LAM = 1e-5, 0.999
+
+    def __init__(self, caffemodel_blobs):
+        self.p, self.hist, self.bn = {}, {}, {}
+        for name in self.CONVS:
+            (w,) = caffemodel_blobs[name]  # bias_term: false
+            self.p[name + ".w"] = torch.tensor(np.asarray(w),
+                                               requires_grad=True)
+        w, b = caffemodel_blobs["ip1"]
+        self.p["ip1.w"] = torch.tensor(np.asarray(w), requires_grad=True)
+        self.p["ip1.b"] = torch.tensor(np.asarray(b), requires_grad=True)
+        for k, v in self.p.items():
+            self.hist[k] = torch.zeros_like(v)
+        for name in self.BNS:
+            mean, var, scale = caffemodel_blobs[name]
+            self.bn[name] = [torch.tensor(np.asarray(mean)),
+                             torch.tensor(np.asarray(var)),
+                             torch.tensor(np.asarray(scale))]
+
+    def _bn(self, x, name, training):
+        mean_b, var_b, scale_b = self.bn[name]
+        view = (1, -1, 1, 1)
+        if not training:
+            factor = 0.0 if float(scale_b[0]) == 0 else 1.0 / float(scale_b[0])
+            mean = mean_b * factor
+            var = var_b * factor
+            return (x - mean.view(view)) / torch.sqrt(var.view(view)
+                                                      + self.EPS)
+        mean = x.mean(dim=(0, 2, 3))
+        xc = x - mean.view(view)
+        var = (xc * xc).mean(dim=(0, 2, 3))
+        with torch.no_grad():
+            m = x.numel() // x.shape[1]
+            corr = m / max(m - 1, 1)
+            self.bn[name][0] = self.LAM * mean_b + mean.detach()
+            self.bn[name][1] = self.LAM * var_b + corr * var.detach()
+            self.bn[name][2] = self.LAM * scale_b + 1.0
+        return xc / torch.sqrt(var.view(view) + self.EPS)
+
+    def forward(self, x, y, training=True):
+        p = self.p
+        h = F.conv2d(x, p["conv1.w"], padding=2)
+        h = F.max_pool2d(h, 3, 2, ceil_mode=True)
+        h = torch.sigmoid(self._bn(h, "bn1", training))
+        h = F.conv2d(h, p["conv2.w"], padding=2)
+        h = torch.sigmoid(self._bn(h, "bn2", training))
+        h = F.avg_pool2d(h, 3, 2, ceil_mode=True, count_include_pad=False)
+        h = F.conv2d(h, p["conv3.w"], padding=2)
+        h = torch.sigmoid(self._bn(h, "bn3", training))
+        h = F.avg_pool2d(h, 3, 2, ceil_mode=True, count_include_pad=False)
+        h = F.linear(h.reshape(h.shape[0], -1), p["ip1.w"], p["ip1.b"])
+        return h, F.cross_entropy(h, y)
+
+    def sgd_step(self, loss, base_lr=0.001, momentum=0.9, wd=0.004):
+        # conv params: one ParamSpec {lr_mult: 1}, decay_mult defaults 1;
+        # ip1: w (1, 1), b (1, 0); BN blobs lr_mult 0 -> never updated by
+        # the solver (their only motion is the forward moving average)
+        grads = torch.autograd.grad(loss, list(self.p.values()))
+        with torch.no_grad():
+            for (k, v), g in zip(self.p.items(), grads):
+                decay_mult = 0.0 if k == "ip1.b" else 1.0
+                g = g + wd * decay_mult * v
+                self.hist[k] = base_lr * g + momentum * self.hist[k]
+                v -= self.hist[k]
+
+
+def test_bn_trajectory_and_running_stats_track_torch(tmp_path):
+    """BN-bearing net over the full solver loop: per-step train losses
+    track, the λ-decayed running-stat blobs agree after training, and a
+    TEST-phase (use_global_stats) forward produces the same logits —
+    pinning caffe's BN update semantics end to end
+    (batch_norm_layer.cpp + sgd_solver.cpp)."""
+    n_steps = 60
+    netp = load_net_prototxt(open(BN_NET).read())
+    netp = replace_data_layers(netp, BATCH, BATCH, 3, 32, 32)
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, netp)
+    solver = Solver(sp, seed=0)
+    blobs = _export_initial_weights(solver, tmp_path)
+    tbn = TorchSigmoidBN(blobs)
+    batches = _batches(n_steps, seed=9)
+
+    solver.set_train_data(iter(batches))
+    ours = []
+    for _ in range(n_steps):
+        solver.step(1)
+        ours.append(solver._smoothed[-1])
+    theirs = []
+    for b in batches:
+        _, loss = tbn.forward(torch.tensor(b["data"]),
+                              torch.tensor(b["label"], dtype=torch.long))
+        tbn.sgd_step(loss)
+        theirs.append(float(loss))
+    np.testing.assert_allclose(ours[:10], theirs[:10], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-2, atol=1e-3)
+
+    # running-stat blobs: λ-decayed sums + scale factor agree
+    final = dict(_export_initial_weights(solver, tmp_path))
+    for name in TorchSigmoidBN.BNS:
+        for i in range(3):
+            np.testing.assert_allclose(
+                np.asarray(final[name][i]),
+                tbn.bn[name][i].numpy(), rtol=1e-3, atol=1e-4)
+
+    # TEST-phase forward (use_global_stats) on a held-out batch: same
+    # logits from the accumulated statistics
+    hb = _batches(1, seed=11)[0]
+    out = solver.test_net.apply_all(
+        solver.params, {"data": hb["data"], "label": hb["label"]},
+        train=False)
+    logits, _ = tbn.forward(torch.tensor(hb["data"]),
+                            torch.tensor(hb["label"], dtype=torch.long),
+                            training=False)
+    np.testing.assert_allclose(np.asarray(out["ip1"]),
+                               logits.detach().numpy(),
+                               rtol=1e-3, atol=1e-4)
 
 
 def test_bf16_trajectory_tracks_f32_torch(tmp_path):
