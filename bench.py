@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import json
 import os
+import random
+import signal
 import subprocess
 import sys
 import time
@@ -290,54 +292,192 @@ def run_child() -> None:
 
 # ---------------------------------------------------------------------------
 # Parent: probe/retry orchestration
+#
+# The axon tunnel's outages run HOURS, not minutes (observed 19:59→20:14+
+# and multi-hour stretches); burning full-timeout attempts into one is how
+# round 3's number was lost.  The parent therefore (1) health-probes the
+# backend with a cheap hard-timeout child before each real attempt, waiting
+# at ~2 min jittered cadence while the tunnel is down, (2) spans a
+# multi-hour window overall, (3) persists the last-known-good result with a
+# timestamp and (4) emits it in the failure diagnostic — including on
+# SIGTERM/SIGINT, so a driver-side `timeout` kill still yields a JSON line
+# instead of silence.
 # ---------------------------------------------------------------------------
 
-def _backoff(attempt: int, attempts: int) -> None:
-    if attempt < attempts:  # no pointless sleep after the final attempt
-        time.sleep(min(30 * attempt, 120))
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+LAST_GOOD_PATH = os.path.join(_REPO_DIR, ".bench_last_good.json")
 
 
-def run_parent() -> int:
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", 4))
-    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", 900))
-    failures: list[str] = []
-    for attempt in range(1, attempts + 1):
-        _log(f"attempt {attempt}/{attempts} (timeout {timeout_s:.0f}s)")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                stdout=subprocess.PIPE, stderr=None,
-                timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
-            failures.append(f"attempt {attempt}: timed out after "
-                            f"{timeout_s:.0f}s (axon backend hang?)")
-            _log(failures[-1])
-            _backoff(attempt, attempts)
-            continue
-        lines = proc.stdout.decode().strip().splitlines()
-        if proc.returncode == 0 and lines:
-            try:
-                json.loads(lines[-1])
-            except json.JSONDecodeError:
-                failures.append(
-                    f"attempt {attempt}: rc=0 but no JSON tail: {lines[-1]!r}")
-                _log(failures[-1])
-                _backoff(attempt, attempts)
-                continue
-            print(lines[-1], flush=True)
-            return 0
-        tail = "\n".join(lines[-8:]) if lines else "(no stdout)"
-        failures.append(f"attempt {attempt}: rc={proc.returncode}: {tail}")
-        _log(failures[-1])
-        _backoff(attempt, attempts)
-    print(json.dumps({
+def _load_last_good() -> dict | None:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+# envs that change what the benchmark measures: a run with any of them
+# set is not comparable to the headline record
+_CONFIG_ENVS = ("BENCH_PLATFORM", "BENCH_MODEL", "BENCH_BATCH",
+                "BENCH_ITERS", "BENCH_REPS", "BENCH_WINDOWS",
+                "BENCH_DTYPE", "BENCH_SCAN")
+
+
+def _save_last_good(result: dict) -> None:
+    if any(os.environ.get(k) for k in _CONFIG_ENVS):
+        return  # smoke/alt-config runs must not overwrite the headline
+        #         last-good TPU record
+    try:
+        with open(LAST_GOOD_PATH, "w") as f:
+            json.dump({"captured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                       "result": result}, f, indent=1)
+    except OSError as e:  # diagnostics must never sink a good run
+        _log(f"could not persist last-good result: {e}")
+
+
+def _probe_backend(timeout_s: float) -> tuple[str, str]:
+    """Backend-init-only child under a hard timeout: the axon plugin hangs
+    forever during init when its tunnel is down, so a ~45 s probe is the
+    cheap way to know whether a full attempt is worth burning.  Returns
+    (status, detail): status "ok" | "timeout" | "error" | "fallback".
+    "fallback" = the child came up but on the wrong platform (JAX silently
+    falls back to CPU when the TPU plugin fails fast) — a dead tunnel must
+    not let a CPU run masquerade as the TPU benchmark."""
+    if os.environ.get("BENCH_PLATFORM"):  # forced platform (cpu smoke)
+        return "ok", ""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=timeout_s, cwd=_REPO_DIR)
+    except subprocess.TimeoutExpired:
+        return "timeout", f"init exceeded {timeout_s:.0f}s (tunnel hang)"
+    if p.returncode != 0:
+        tail = p.stderr.decode(errors="replace").strip().splitlines()[-3:]
+        return "error", f"probe rc={p.returncode}: " + " | ".join(tail)
+    platform = p.stdout.decode().strip().splitlines()[-1] if p.stdout else ""
+    if platform != "tpu":
+        return "fallback", f"backend came up as {platform!r}, not tpu"
+    return "ok", ""
+
+
+def _failure_json(failures: list[str], note: str) -> str:
+    return json.dumps({
         "metric": f"{MODEL}_train_images_per_sec",
         "value": 0.0,
         "unit": "img/s",
         "vs_baseline": 0.0,
-        "error": f"benchmark failed after {attempts} attempts",
+        "error": note,
         "attempts": failures,
-    }), flush=True)
+        "last_good": _load_last_good(),
+    })
+
+
+def run_parent() -> int:
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", 8))
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", 900))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 45))
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", 3 * 3600))
+    start = time.monotonic()
+    failures: list[str] = []
+    probe_waits = 0
+    jitter = random.Random(os.getpid())
+    child: subprocess.Popen | None = None
+
+    fired: list[int] = []
+
+    def on_signal(signum, frame):
+        if fired:  # re-entry (e.g. signal during unwind): hard exit
+            os._exit(1)
+        fired.append(signum)
+        _log(f"signal {signum}: emitting diagnostic before exit")
+        if child is not None and child.poll() is None:
+            child.kill()
+        print(_failure_json(
+            failures + [f"killed by signal {signum} after "
+                        f"{(time.monotonic() - start) / 60:.1f} min "
+                        f"({probe_waits} probe waits)"],
+            f"benchmark killed by signal {signum}"), flush=True)
+        sys.stdout.flush()
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    attempt = 0
+    probe_errors = 0  # consecutive fail-fast (rc!=0) probes
+    while attempt < attempts:
+        elapsed = time.monotonic() - start
+        if elapsed > deadline_s:
+            failures.append(
+                f"window exhausted: {elapsed / 60:.0f} min "
+                f"> {deadline_s / 60:.0f} min ({probe_waits} probe waits)")
+            _log(failures[-1])
+            break
+        status, detail = _probe_backend(probe_timeout)
+        if status == "error" and probe_errors >= 1:
+            # two consecutive fail-fast probes: a persistent environment
+            # failure (broken install, import error), not a tunnel hang —
+            # fall through to a real attempt so its rc/stderr surface in
+            # the diagnostic instead of silently burning the window
+            _log(f"probe failed fast twice ({detail}); running a real "
+                 f"attempt to surface the error")
+        elif status != "ok":
+            probe_errors = probe_errors + 1 if status == "error" else 0
+            probe_waits += 1
+            wait = jitter.uniform(60, 150)
+            _log(f"probe: {status} ({detail}); wait {wait:.0f}s "
+                 f"[{elapsed / 60:.0f}m into {deadline_s / 60:.0f}m "
+                 f"window, {probe_waits} waits]")
+            time.sleep(min(wait, max(deadline_s - elapsed, 1)))
+            continue
+        probe_errors = 0
+        attempt += 1
+        _log(f"attempt {attempt}/{attempts} (timeout {timeout_s:.0f}s)")
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            stdout=subprocess.PIPE, stderr=None, cwd=_REPO_DIR)
+        try:
+            stdout, _ = child.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.communicate()
+            failures.append(f"attempt {attempt}: timed out after "
+                            f"{timeout_s:.0f}s (probe passed but run hung "
+                            f"— tunnel died mid-attempt?)")
+            _log(failures[-1])
+            continue
+        lines = stdout.decode().strip().splitlines()
+        if child.returncode == 0 and lines:
+            try:
+                result = json.loads(lines[-1])
+            except json.JSONDecodeError:
+                failures.append(
+                    f"attempt {attempt}: rc=0 but no JSON tail: {lines[-1]!r}")
+                _log(failures[-1])
+                continue
+            if (not os.environ.get("BENCH_PLATFORM")
+                    and not str(result.get("device", "")).startswith("tpu")):
+                # probe passed but the run fell back to CPU mid-attempt —
+                # a CPU number must not pass for the TPU benchmark
+                failures.append(
+                    f"attempt {attempt}: completed on "
+                    f"{result.get('device')!r}, not the TPU; discarding")
+                _log(failures[-1])
+                continue
+            _save_last_good(result)
+            print(lines[-1], flush=True)
+            return 0
+        tail = "\n".join(lines[-8:]) if lines else "(no stdout)"
+        failures.append(f"attempt {attempt}: rc={child.returncode}: {tail}")
+        _log(failures[-1])
+        if attempt < attempts:  # no pointless sleep after the final attempt
+            time.sleep(min(30 * attempt, 120))
+    print(_failure_json(
+        failures,
+        f"benchmark failed: {attempt} attempts, {probe_waits} probe waits "
+        f"over {(time.monotonic() - start) / 60:.0f} min"), flush=True)
     return 1
 
 
