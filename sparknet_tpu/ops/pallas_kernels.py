@@ -145,3 +145,137 @@ def _lrn_vjp_bwd(size, alpha, beta, k, res, dy):
 
 
 lrn_across_channels.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# VMEM-resident MAX-pool backward
+#
+# XLA lowers maxpool backward as select-and-scatter, measured at an HBM
+# traffic floor ~2.5x the minimum on GoogLeNet's 13 pools (5.3 ms of the
+# 26.4 ms bf16 step); two pure-XLA rewrites measured OUT (see
+# RESULTS.md).  This kernel does the whole backward in ONE trip: read x
+# and dy once, recompute each window's FIRST argmax on the VPU (Caffe's
+# tie-break — pooling_layer.cpp Forward_cpu MAX branch scans row-major
+# and keeps the first maximum), route dy through the argmax, write dx
+# once.  The grid tiles (batch, channels) and keeps the full spatial
+# plane per block in VMEM, so no halo exchange is needed.
+# ---------------------------------------------------------------------------
+
+
+def _pool_taps(kh: int, kw: int):
+    """Window taps in Caffe's scan order (row-major; first max wins)."""
+    return [(dh, dw) for dh in range(kh) for dw in range(kw)]
+
+
+def _maxpool_bwd_kernel_s1(x_ref, dy_ref, dx_ref, *, kh, kw, ph, pw,
+                           oh, ow, h, w):
+    """Stride-1 path: every tap is a contiguous static slice."""
+    x = x_ref[:]
+    dy = dy_ref[:]
+    c = x.shape[0]
+    hp, wp = oh + kh - 1, ow + kw - 1
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.full((c, hp, wp), neg, x.dtype)
+    xp = xp.at[:, ph:ph + h, pw:pw + w].set(x)
+    best = None
+    arg = None
+    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
+        v = xp[:, dh:dh + oh, dw:dw + ow]
+        if best is None:
+            best, arg = v, jnp.zeros(v.shape, jnp.int32)
+        else:
+            gt = v > best  # strict: ties keep the EARLIER tap
+            best = jnp.where(gt, v, best)
+            arg = jnp.where(gt, t, arg)
+    acc = jnp.zeros((c, hp, wp), jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
+        acc = acc.at[:, dh:dh + oh, dw:dw + ow].add(
+            jnp.where(arg == t, dyf, 0.0))
+    dx_ref[:] = acc[:, ph:ph + h, pw:pw + w].astype(dx_ref.dtype)
+
+
+def _maxpool_bwd_kernel_strided(x_ref, dy_ref, dx_ref, *, kh, kw, sh, sw,
+                                ph, pw, oh, ow, h, w):
+    """General strided path: the padded plane is viewed as
+    (c, rows, sh, cols, sw) so every tap becomes a unit-stride slice at a
+    fixed (dh%sh, dw%sw) phase."""
+    x = x_ref[:]
+    dy = dy_ref[:]
+    c = x.shape[0]
+    rows = (kh - 1) // sh + oh
+    cols = (kw - 1) // sw + ow
+    hp, wp = rows * sh, cols * sw
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.full((c, hp, wp), neg, x.dtype)
+    xp = xp.at[:, ph:ph + h, pw:pw + w].set(x)
+    x5 = xp.reshape(c, rows, sh, cols, sw)
+    best = None
+    arg = None
+    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
+        v = x5[:, dh // sh:dh // sh + oh, dh % sh,
+               dw // sw:dw // sw + ow, dw % sw]
+        if best is None:
+            best, arg = v, jnp.zeros(v.shape, jnp.int32)
+        else:
+            gt = v > best
+            best = jnp.where(gt, v, best)
+            arg = jnp.where(gt, t, arg)
+    acc = jnp.zeros((c, rows, sh, cols, sw), jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
+        acc = acc.at[:, dh // sh:dh // sh + oh, dh % sh,
+                     dw // sw:dw // sw + ow, dw % sw].add(
+            jnp.where(arg == t, dyf, 0.0))
+    dx_ref[:] = acc.reshape(c, hp, wp)[:, ph:ph + h,
+                                       pw:pw + w].astype(dx_ref.dtype)
+
+
+def _pool_ctile(c: int, h: int, w: int, itemsize: int) -> int:
+    """Channels per block: ~2 MB VMEM across the ~6 resident planes."""
+    per_c = max(h * w * itemsize * 6, 1)
+    t = max(1, min(c, (2 << 20) // per_c))
+    while c % t:
+        t -= 1
+    return t
+
+
+def _maxpool_bwd_call(x, dy, kh, kw, sh, sw, ph, pw, oh, ow):
+    n, c, h, w = x.shape
+    ct = _pool_ctile(c, h, w, x.dtype.itemsize)
+    grid = (n, c // ct)
+    kern = (_maxpool_bwd_kernel_s1 if sh == 1 and sw == 1 else
+            functools.partial(_maxpool_bwd_kernel_strided, sh=sh, sw=sw))
+    return pl.pallas_call(
+        functools.partial(kern, kh=kh, kw=kw, ph=ph, pw=pw,
+                          oh=oh, ow=ow, h=h, w=w),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=grid,
+        in_specs=[pl.BlockSpec((None, ct, h, w), lambda i, j: (i, j, 0, 0)),
+                  pl.BlockSpec((None, ct, oh, ow),
+                               lambda i, j: (i, j, 0, 0))],
+        out_specs=pl.BlockSpec((None, ct, h, w), lambda i, j: (i, j, 0, 0)),
+        interpret=_interpret(),
+    )(x, dy)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def max_pool_vmem_bwd(x, kh: int, kw: int, sh: int, sw: int,
+                      ph: int, pw: int, oh: int, ow: int):
+    """MAX pool whose forward is XLA's reduce_window (fuses with
+    neighbors) and whose BACKWARD is the VMEM-resident Pallas kernel
+    instead of select-and-scatter.  The primal IS ops/vision.max_pool —
+    one home for the Caffe ceil-mode geometry."""
+    from .vision import max_pool
+    return max_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)
+
+
+def _maxpool_vjp_fwd(x, kh, kw, sh, sw, ph, pw, oh, ow):
+    return max_pool_vmem_bwd(x, kh, kw, sh, sw, ph, pw, oh, ow), x
+
+
+def _maxpool_vjp_bwd(kh, kw, sh, sw, ph, pw, oh, ow, x, dy):
+    return (_maxpool_bwd_call(x, dy, kh, kw, sh, sw, ph, pw, oh, ow),)
+
+
+max_pool_vmem_bwd.defvjp(_maxpool_vjp_fwd, _maxpool_vjp_bwd)

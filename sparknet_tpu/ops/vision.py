@@ -325,12 +325,23 @@ class PoolingLayer(LayerImpl):
         oh, ow = pool_output_size(h, w, kh, kw, sh, sw, ph, pw)
         return [(n, c, oh, ow)]
 
+    @staticmethod
+    def _use_pallas_bwd() -> bool:
+        import os
+        return os.environ.get("SPARKNET_PALLAS_MAXPOOL") == "1"
+
     def apply(self, lp, params, bottoms, train, rng):
         x = bottoms[0]
         n, c, h, w = x.shape
         kh, kw, sh, sw, ph, pw, method = _pool_geometry(lp, x.shape)
         oh, ow = pool_output_size(h, w, kh, kw, sh, sw, ph, pw)
         if method == "MAX":
+            if self._use_pallas_bwd():
+                # opt-in VMEM-resident Pallas backward (forward stays
+                # XLA reduce_window); see ops/pallas_kernels.py
+                from .pallas_kernels import max_pool_vmem_bwd
+                return [max_pool_vmem_bwd(x, kh, kw, sh, sw, ph, pw,
+                                          oh, ow)]
             return [max_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)]
         if method == "AVE":
             return [ave_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)]

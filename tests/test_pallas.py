@@ -76,3 +76,114 @@ def test_pallas_lrn_even_window_vjp(np_rng):
     g2 = jax.grad(lambda x: jnp.sum(jnp.sin(_xla_lrn(x, 4, 0.1, 0.5, 2.0))))(x)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# VMEM-resident maxpool backward
+# ---------------------------------------------------------------------------
+
+from sparknet_tpu.ops.pallas_kernels import max_pool_vmem_bwd  # noqa: E402
+from sparknet_tpu.ops.vision import max_pool, pool_output_size  # noqa: E402
+
+POOL_GEOMS = [
+    # (h, w, kh, sh, ph) — GoogLeNet's two pool families + a padded s2
+    (14, 14, 3, 1, 1),   # inception branch pool (SAME, stride 1)
+    (28, 28, 3, 2, 0),   # pool3-style ceil-mode stride 2
+    (13, 13, 3, 2, 1),   # padded + ceil (odd remainder)
+    (7, 7, 5, 3, 2),     # kernel > 2*stride, fat overlap
+]
+
+
+def _np_caffe_maxpool_bwd(x, dy, kh, kw, sh, sw, ph, pw, oh, ow):
+    """Literal transcription of pooling_layer.cpp Backward_cpu MAX: the
+    forward's row-major argmax scan keeps the FIRST maximum; backward
+    adds each dy into its recorded argmax."""
+    n, c, h, w = x.shape
+    dx = np.zeros_like(x, np.float32)
+    for ni in range(n):
+        for ci in range(c):
+            for oi in range(oh):
+                for oj in range(ow):
+                    hs, ws = oi * sh - ph, oj * sw - pw
+                    he, we = min(hs + kh, h), min(ws + kw, w)
+                    hs, ws = max(hs, 0), max(ws, 0)
+                    win = x[ni, ci, hs:he, ws:we]
+                    k = np.argmax(win)  # first max (row-major), like caffe
+                    dx[ni, ci, hs + k // win.shape[1],
+                       ws + k % win.shape[1]] += dy[ni, ci, oi, oj]
+    return dx
+
+
+@pytest.mark.parametrize("h,w,kh,sh,ph", POOL_GEOMS)
+def test_maxpool_vmem_bwd_matches_select_and_scatter(np_rng, h, w, kh, sh, ph):
+    x = jnp.asarray(np_rng.normal(size=(2, 4, h, w)).astype(np.float32))
+    oh, ow = pool_output_size(h, w, kh, kh, sh, sh, ph, ph)
+
+    def f_pallas(x):
+        return jnp.sum(jnp.sin(
+            max_pool_vmem_bwd(x, kh, kh, sh, sh, ph, ph, oh, ow)))
+
+    def f_xla(x):
+        return jnp.sum(jnp.sin(
+            max_pool(x, kh, kh, sh, sh, ph, ph, oh, ow)))
+
+    np.testing.assert_allclose(np.asarray(f_pallas(x)), np.asarray(f_xla(x)),
+                               rtol=1e-6)
+    g1 = jax.grad(f_pallas)(x)
+    g2 = jax.grad(f_xla)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("h,w,kh,sh,ph", POOL_GEOMS)
+def test_maxpool_vmem_bwd_first_max_ties(np_rng, h, w, kh, sh, ph):
+    """Post-ReLU activations tie constantly (zeros); the gradient must go
+    to the FIRST max of each window, exactly like caffe's argmax scan."""
+    x = np.maximum(np_rng.normal(size=(1, 3, h, w)), 0).astype(np.float32)
+    # quantize to force many non-zero ties too
+    x = np.round(x * 2) / 2
+    oh, ow = pool_output_size(h, w, kh, kh, sh, sh, ph, ph)
+    dy = np_rng.normal(size=(1, 3, oh, ow)).astype(np.float32)
+
+    _, vjp = jax.vjp(
+        lambda x: max_pool_vmem_bwd(x, kh, kh, sh, sh, ph, ph, oh, ow),
+        jnp.asarray(x))
+    (dx,) = vjp(jnp.asarray(dy))
+    expect = _np_caffe_maxpool_bwd(x, dy, kh, kh, sh, sh, ph, ph, oh, ow)
+    np.testing.assert_allclose(np.asarray(dx), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_maxpool_layer_pallas_dispatch(np_rng, monkeypatch):
+    """SPARKNET_PALLAS_MAXPOOL=1 routes MAX pooling's backward through
+    the kernel; forward and gradient match the default path."""
+    from sparknet_tpu.ops.registry import get_layer_impl as gli
+    lp = layer("p", "Pooling", ["x"], ["y"],
+               pooling_param={"pool": "MAX", "kernel_size": 3, "stride": 2})
+    impl = gli("Pooling")
+    x = jnp.asarray(np_rng.normal(size=(2, 4, 13, 13)).astype(np.float32))
+    monkeypatch.setenv("SPARKNET_PALLAS_MAXPOOL", "0")
+    ref, gref = jax.value_and_grad(
+        lambda x: jnp.sum(jnp.sin(impl.apply(lp, [], [x], True, None)[0])))(x)
+    monkeypatch.setenv("SPARKNET_PALLAS_MAXPOOL", "1")
+    got, ggot = jax.value_and_grad(
+        lambda x: jnp.sum(jnp.sin(impl.apply(lp, [], [x], True, None)[0])))(x)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ggot), np.asarray(gref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_maxpool_vmem_bwd_bf16(np_rng):
+    """bf16 activations: accumulation stays f32 inside the kernel."""
+    x = jnp.asarray(np_rng.normal(size=(1, 4, 14, 14)), jnp.bfloat16)
+    oh, ow = pool_output_size(14, 14, 3, 3, 1, 1, 1, 1)
+    _, vjp = jax.vjp(
+        lambda x: max_pool_vmem_bwd(x, 3, 3, 1, 1, 1, 1, oh, ow), x)
+    (dx,) = vjp(jnp.ones((1, 4, oh, ow), jnp.bfloat16))
+    _, vjp2 = jax.vjp(
+        lambda x: max_pool(x.astype(jnp.float32), 3, 3, 1, 1, 1, 1, oh, ow),
+        x)
+    (dx2,) = vjp2(jnp.ones((1, 4, oh, ow), jnp.float32))
+    assert dx.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(dx, np.float32),
+                               np.asarray(dx2, np.float32),
+                               rtol=2e-2, atol=1e-2)

@@ -389,6 +389,74 @@ def run_net() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Part B2: GoogLeNet maxpool backward — select-and-scatter vs the
+# VMEM-resident Pallas kernel (VERDICT r3 item 6).  All 13 pools of
+# bvlc_googlenet at PROBE_BATCH, fwd+bwd per pool, both paths.
+# ---------------------------------------------------------------------------
+
+# (name, c, h/w, kernel, stride, pad) — models/googlenet geometry
+GOOGLENET_POOLS = [
+    ("pool1_112", 64, 112, 3, 2, 0),
+    ("pool2_56", 192, 56, 3, 2, 0),
+    ("icp3a_28", 192, 28, 3, 1, 1),
+    ("icp3b_28", 256, 28, 3, 1, 1),
+    ("pool3_28", 480, 28, 3, 2, 0),
+    ("icp4a_14", 480, 14, 3, 1, 1),
+    ("icp4b_14", 512, 14, 3, 1, 1),
+    ("icp4c_14", 512, 14, 3, 1, 1),
+    ("icp4d_14", 512, 14, 3, 1, 1),
+    ("icp4e_14", 528, 14, 3, 1, 1),
+    ("pool4_14", 832, 14, 3, 2, 0),
+    ("icp5a_7", 832, 7, 3, 1, 1),
+    ("icp5b_7", 832, 7, 3, 1, 1),
+]
+
+
+def run_poolbwd() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu.ops.pallas_kernels import max_pool_vmem_bwd
+    from sparknet_tpu.ops.vision import max_pool, pool_output_size
+
+    rng = np.random.default_rng(0)
+    batch = int(os.environ.get("PROBE_POOL_BATCH", 128))
+    dtype = jnp.bfloat16 if os.environ.get(
+        "PROBE_DTYPE", "bf16") == "bf16" else jnp.float32
+    totals = {"s&s": 0.0, "pallas": 0.0}
+    for name, c, hw, k, s, p in GOOGLENET_POOLS:
+        oh, ow = pool_output_size(hw, hw, k, k, s, s, p, p)
+        x = jnp.asarray(
+            np.maximum(rng.normal(size=(batch, c, hw, hw)), 0), dtype)
+
+        def make_iter(fn):
+            def it(sc):
+                # cast the f32 loop scalar BEFORE the add: bf16 + f32
+                # would silently promote the timed tensor to f32
+                xq = x + (sc * 1e-30).astype(dtype)
+
+                def f(xx):
+                    return fn(xx, k, k, s, s, p, p, oh, ow)
+                y, vjp = jax.vjp(f, xq)
+                (dx,) = vjp(jnp.ones_like(y))
+                return (jnp.sum(y) + jnp.sum(dx)).astype(jnp.float32) * 1e-30
+            return it
+
+        for label, fn in (("ss", max_pool), ("pallas", max_pool_vmem_bwd)):
+            ms = time_block(f"poolbwd_{name}_{label}", make_iter(fn), 0,
+                            extra={"c": c, "hw": hw, "stride": s,
+                                   "batch": batch, "dtype": str(dtype.__name__)})
+            totals["s&s" if label == "ss" else "pallas"] += ms
+    emit({"exp": "poolbwd_total_ms_per_step",
+          "select_and_scatter": round(totals["s&s"], 3),
+          "pallas_vmem": round(totals["pallas"], 3),
+          "note": "sum over all 13 GoogLeNet pools, fwd+bwd per iter"})
+    log(f"poolbwd totals: s&s {totals['s&s']:.2f} ms vs pallas "
+        f"{totals['pallas']:.2f} ms per step-equivalent")
+
+
+# ---------------------------------------------------------------------------
 # Part C: HLO transpose census
 # ---------------------------------------------------------------------------
 
@@ -456,4 +524,5 @@ if __name__ == "__main__":
     emit({"exp": "device", "device": f"{dev.platform}/{dev.device_kind}",
           "batch": BATCH})
     for p in parts:
-        {"ops": run_ops, "net": run_net, "hlo": run_hlo}[p]()
+        {"ops": run_ops, "net": run_net, "hlo": run_hlo,
+         "poolbwd": run_poolbwd}[p]()
