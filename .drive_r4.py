@@ -1,0 +1,91 @@
+"""Round-4 verify drive: pycaffe reshape idiom, multi-test-net solver,
+oversample layout, end= stale refusal — through the public surface."""
+import jax
+jax.config.update("jax_platforms", "cpu")  # tunnel-safe (see verify skill)
+
+import numpy as np
+from sparknet_tpu import pycaffe_compat as caffe
+
+NET = """
+name: "deploy"
+input: "data"
+input_shape { dim: 10 dim: 3 dim: 16 dim: 16 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip" type: "InnerProduct" bottom: "pool1" top: "ip"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+"""
+
+net = caffe.Net(NET, phase=caffe.TEST)
+rng = np.random.default_rng(0)
+x10 = rng.normal(size=(10, 3, 16, 16)).astype(np.float32)
+p10 = net.forward(data=x10)["prob"]
+assert p10.shape == (10, 5)
+
+# THE deploy idiom: reshape to batch 1, forward
+net.blobs["data"].reshape(1, 3, 16, 16)
+net.blobs["data"].data[...] = x10[:1]
+p1 = net.forward()["prob"]
+assert p1.shape == (1, 5)
+np.testing.assert_allclose(p1, p10[:1], rtol=1e-4, atol=1e-6)
+print("reshape deploy idiom OK:", p1.argmax())
+
+# caller array not aliased
+x0 = x10.copy()
+net.blobs["data"].reshape(10, 3, 16, 16)
+net.forward(data=x10)
+net.blobs["data"].data[...] = -1
+assert np.array_equal(x10, x0)
+print("no-alias OK")
+
+# stale end= request refused
+try:
+    net.forward(blobs=["prob"], end="conv1", data=x10)
+    raise SystemExit("FAIL: stale blob request not refused")
+except ValueError as e:
+    assert "stale" in str(e)
+print("stale end= refusal OK")
+
+# oversample reference layout
+img = rng.uniform(size=(12, 14, 3)).astype(np.float32)
+crops = caffe.io.oversample([img], (8, 8))
+assert crops.shape == (10, 8, 8, 3)
+assert np.array_equal(crops[5], crops[0][:, ::-1])
+print("oversample layout OK")
+
+# multi test nets through get_solver
+mk = lambda name, b: f"""
+  name: "{name}"
+  layer {{ name: "data" type: "DummyData" top: "data" top: "label"
+    dummy_data_param {{ shape {{ dim: {b} dim: 4 }} shape {{ dim: {b} }}
+      data_filler {{ type: "gaussian" std: 1.0 }}
+      data_filler {{ type: "constant" value: 1.0 }} }} }}
+  layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+    inner_product_param {{ num_output: 2 weight_filler {{ type: "xavier" }} }} }}
+  layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }}
+"""
+solver_text = ("base_lr: 0.1\nmomentum: 0.9\ntest_iter: 2\ntest_iter: 3\n"
+               "test_interval: 5\nmax_iter: 10\n"
+               "net_param {" + mk("tr", 8) + "}\n"
+               "test_net_param {" + mk("t0", 2) + "}\n"
+               "test_net_param {" + mk("t1", 4) + "}\n")
+s = caffe.get_solver(solver_text)
+assert len(s.test_nets) == 2
+l0 = s.step(5)
+s.solve()  # runs TestAll over both nets at intervals + final
+print("multi-test-net solver OK, loss", l0, "->", s._solver.smoothed_loss())
+
+# error probe: reshape that would change param shapes
+net.blobs["data"].reshape(10, 3, 20, 20)
+try:
+    net.reshape()
+    raise SystemExit("FAIL: param-shape-changing reshape not refused")
+except ValueError as e:
+    assert "param shapes" in str(e)
+print("param-shape refusal OK")
+print("ALL DRIVE CHECKS PASSED")

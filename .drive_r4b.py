@@ -1,0 +1,23 @@
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sparknet_tpu.proto import load_net_prototxt, load_solver_prototxt_with_net, replace_data_layers
+from sparknet_tpu.solvers import Solver
+netp = replace_data_layers(load_net_prototxt(open(
+    "/root/reference/caffe/examples/cifar10/cifar10_full_sigmoid_train_test_bn.prototxt").read()),
+    8, 8, 3, 32, 32)
+sp = load_solver_prototxt_with_net(
+    'base_lr: 0.001\nmomentum: 0.9\nlr_policy: "multistep"\ngamma: 0.1\n'
+    'stepvalue: 5\nstepvalue: 10\n', netp)
+s = Solver(sp, seed=0)
+rng = np.random.default_rng(0)
+feed = ({"data": rng.normal(size=(8, 3, 32, 32)).astype(np.float32),
+         "label": rng.integers(0, 10, size=(8,)).astype(np.float32)} for _ in iter(int, 1))
+s.set_train_data(feed)
+l0 = s.step(15)
+assert np.isfinite(l0)
+scale = float(np.asarray(s.params["bn1"][2])[0])
+assert abs(scale - sum(0.999**k for k in range(15))) < 1e-3, scale
+out = s.test_net.apply_all(s.params, {"data": rng.normal(size=(8,3,32,32)).astype(np.float32),
+                                      "label": np.zeros(8, np.float32)}, train=False)
+assert np.isfinite(np.asarray(out["ip1"])).all()
+print("BN solver drive OK: loss", round(l0, 4), "scale_factor", round(scale, 4))

@@ -1,0 +1,42 @@
+"""Drive: SPARKNET_PALLAS_MAXPOOL=1 inside a real Solver train loop."""
+import os
+os.environ["SPARKNET_PALLAS_MAXPOOL"] = "1"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sparknet_tpu.proto import load_net_prototxt, load_solver_prototxt_with_net
+from sparknet_tpu.solvers import Solver
+
+NET = """
+name: "poolnet"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 8 dim: 3 dim: 28 dim: 28 } } }
+layer { name: "label" type: "Input" top: "label"
+  input_param { shape { dim: 8 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "icp_pool" type: "Pooling" bottom: "conv1" top: "icp"
+  pooling_param { pool: MAX kernel_size: 3 stride: 1 pad: 1 } }
+layer { name: "pool2" type: "Pooling" bottom: "icp" top: "p2"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+layer { name: "ip" type: "InnerProduct" bottom: "p2" top: "ip"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+"""
+sp = load_solver_prototxt_with_net(
+    'base_lr: 0.05\nmomentum: 0.9\n', load_net_prototxt(NET))
+s = Solver(sp, seed=0)
+rng = np.random.default_rng(0)
+x = rng.normal(size=(8, 3, 28, 28)).astype(np.float32)
+y = rng.integers(0, 5, size=(8,)).astype(np.float32)
+s.set_train_data(iter([{"data": x, "label": y}] * 30))
+l0 = s.step(5); l1 = s.step(25)
+assert np.isfinite(l1) and l1 < l0, (l0, l1)
+# same trajectory as the select-and-scatter path
+os.environ["SPARKNET_PALLAS_MAXPOOL"] = "0"
+s2 = Solver(sp, seed=0)
+s2.set_train_data(iter([{"data": x, "label": y}] * 30))
+s2.step(5); l1b = s2.step(25)
+assert abs(l1 - l1b) < 1e-4, (l1, l1b)
+print(f"pallas maxpool drive OK: loss {l0:.4f} -> {l1:.4f} (matches s&s path {l1b:.4f})")

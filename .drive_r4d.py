@@ -1,0 +1,25 @@
+"""Drive: forward(start=) mid-net idiom + feed tier at overridden batch."""
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sparknet_tpu import pycaffe_compat as caffe
+
+NET = """
+name: "d"
+input: "data"
+input_shape { dim: 2 dim: 3 dim: 12 dim: 12 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+"""
+net = caffe.Net(NET, phase=caffe.TEST)
+x = np.random.default_rng(0).normal(size=(2, 3, 12, 12)).astype(np.float32)
+p0 = net.forward(data=x)["prob"].copy()
+# the net-surgery idiom: zero the conv activations, re-run from relu1
+net.blobs["conv1"].data[...] = 0.0
+p1 = net.forward(start="relu1")["prob"]
+assert np.allclose(p1, 1.0 / 3, atol=1e-5), p1  # uniform softmax of zeros... 
+print("forward(start=) drive OK:", p0[0].round(3), "->", p1[0].round(3))

@@ -389,19 +389,25 @@ class Net:
     def apply_all(self, params, inputs, *, train=None, rng=None,
                   upto: str | None = None,
                   eps: Mapping[str, jax.Array] | None = None,
+                  start: str | None = None,
                   ) -> dict[str, jax.Array]:
         """Forward returning every intermediate blob (debug; the analog of
         reading arbitrary blobs over the reference's FFI introspection,
         libccaffe/ccaffe.cpp:86-139).  ``upto`` stops execution after the
-        named layer (pycaffe's ``forward(end=...)`` truncation).  ``eps``
-        maps blob names to zero-valued perturbations added at each blob's
-        final assignment — differentiating w.r.t. them yields d(out)/d(blob)
-        for INTERMEDIATE blobs (pycaffe ``backward(diffs=[...])``)."""
-        if upto is not None and upto not in self._node_by_name:
-            raise ValueError(
-                f"unknown layer {upto!r} (layers: {self.layer_names()})")
+        named layer (pycaffe's ``forward(end=...)`` truncation).  ``start``
+        begins execution AT the named layer (pycaffe ``forward(start=...)``,
+        pycaffe.py:105): layers before it are skipped and every bottom they
+        would have produced must be supplied in ``inputs``.  ``eps`` maps
+        blob names to zero-valued perturbations added at each blob's final
+        assignment — differentiating w.r.t. them yields d(out)/d(blob) for
+        INTERMEDIATE blobs (pycaffe ``backward(diffs=[...])``)."""
+        for nm, which in ((upto, "upto"), (start, "start")):
+            if nm is not None and nm not in self._node_by_name:
+                raise ValueError(
+                    f"unknown layer {nm!r} for {which}= "
+                    f"(layers: {self.layer_names()})")
         blobs, _, _ = self._run(params, inputs, train, rng, upto=upto,
-                                eps=eps)
+                                eps=eps, start=start)
         return blobs
 
     def _cast(self, arrs, dtype):
@@ -412,7 +418,8 @@ class Net:
                 for a in arrs]
 
     def _run(self, params, inputs, train, rng, upto: str | None = None,
-             eps: Mapping[str, jax.Array] | None = None):
+             eps: Mapping[str, jax.Array] | None = None,
+             start: str | None = None):
         """The layer-by-layer forward shared by apply/apply_all.
 
         With ``compute_dtype`` set (bf16 on TPU), params and activations
@@ -422,13 +429,25 @@ class Net:
         differentiable, so grads flow back in f32)."""
         if train is None:
             train = self.state.phase == Phase.TRAIN
-        if rng is None and any(n.impl.needs_rng(n.lp, train) for n in self.nodes):
+        active = self.nodes
+        if start is not None:
+            idx = next(i for i, n in enumerate(self.nodes)
+                       if n.lp.name == start)
+            if upto is not None:
+                uidx = next((i for i, n in enumerate(self.nodes)
+                             if n.lp.name == upto), None)
+                if uidx is not None and uidx < idx:
+                    raise ValueError(
+                        f"start={start!r} comes after upto={upto!r}")
+            active = self.nodes[idx:]
+        if rng is None and any(n.impl.needs_rng(n.lp, train) for n in active):
             raise ValueError(
                 f"net {self.name!r} needs an rng in this mode "
                 f"(stochastic layer present)")
-        for name in self.input_blobs:
-            if name not in inputs:
-                raise ValueError(f"missing input blob {name!r}")
+        if start is None:
+            for name in self.input_blobs:
+                if name not in inputs:
+                    raise ValueError(f"missing input blob {name!r}")
         blobs: dict[str, jax.Array] = dict(inputs)
         new_params = dict(params)
         cd = self.compute_dtype
@@ -441,13 +460,24 @@ class Net:
                 for t in n.tops:
                     if t in eps:
                         last_producer[t] = n.lp.name
+        started = start is None
         for node in self.nodes:
+            if not started:
+                if node.lp.name != start:
+                    continue
+                started = True
             if getattr(node.impl, "is_input", lambda: False)():
                 # Input-type layers still honor upto= (their tops are the
                 # bound inputs; nothing to execute)
                 if upto is not None and node.lp.name == upto:
                     break
                 continue
+            missing = [b for b in node.bottoms if b not in blobs]
+            if missing:
+                raise ValueError(
+                    f"layer {node.lp.name!r} needs blobs {missing}; with "
+                    f"start={start!r} every bottom produced before the "
+                    f"start layer must be fed in inputs")
             layer_rng = None
             if rng is not None and node.impl.needs_rng(node.lp, train):
                 rng, layer_rng = jax.random.split(rng)

@@ -23,8 +23,9 @@ Differences by design: shapes are static (XLA compiles per shape).
 ``net.blobs['data'].reshape(...)`` + ``net.reshape()`` (the deploy
 batch-size idiom, _caffe.cpp:180-189,227) IS supported — it rebuilds
 shape inference and recompiles on the next forward, shape-keyed.
-``forward(start=...)`` is unsupported (functional graphs re-run from the
-inputs; use ``end=`` truncation).
+``forward(start=..., end=...)`` is supported (pycaffe.py:105): the
+skipped prefix's outputs are read from the current blob mirrors, so the
+mid-net re-forward idiom works; each (start, end) range compiles once.
 
 Usage::
 
@@ -286,23 +287,77 @@ class Net:
             raise ValueError(f"not input blobs: {sorted(unknown)}")
         return inputs
 
-    def forward(self, blobs=None, end: str | None = None, **kwargs):
+    def _gather_range_inputs(self, start: str, end: str | None,
+                             kwargs) -> dict[str, np.ndarray]:
+        """Seed blobs for forward(start=...): every bottom consumed in
+        [start, end] that is not produced inside the range comes from
+        kwargs (copied) or the current blob mirrors — pycaffe semantics,
+        where a mid-net forward reads whatever the blobs hold."""
+        names = self._layer_names
+        si = names.index(start)
+        ei = names.index(end) + 1 if end is not None else len(names)
+        produced: set[str] = set()
+        needed: list[str] = []
+        for n in self._net.nodes[si:ei]:
+            if getattr(n.impl, "is_input", lambda: False)():
+                # Input-type layers execute nothing — their tops are fed,
+                # not produced, even when the layer sits inside the range
+                for t in n.tops:
+                    if t not in produced and t not in needed:
+                        needed.append(t)
+                continue
+            for b in n.bottoms:
+                if b not in produced and b not in needed:
+                    needed.append(b)
+            produced.update(n.tops)
+        inputs = {}
+        for b in needed:
+            arr = np.asarray(kwargs[b] if b in kwargs
+                             else self.blobs[b].data, np.float32)
+            shape = self._net.blob_shapes[b]
+            if tuple(arr.shape) != tuple(shape):
+                raise ValueError(
+                    f"seed blob {b!r} has shape {arr.shape}, net expects "
+                    f"{shape}")
+            if b in kwargs:
+                self.blobs[b].data = np.array(arr)  # own copy, no alias
+            else:
+                self.blobs[b].data = arr
+            inputs[b] = self.blobs[b].data
+        unknown = set(kwargs) - set(needed)
+        if unknown:
+            raise ValueError(
+                f"not consumed by layers in [{start!r}, {end!r}]: "
+                f"{sorted(unknown)}")
+        return inputs
+
+    def forward(self, blobs=None, start: str | None = None,
+                end: str | None = None, **kwargs):
         """Run forward; returns {output blob: data} (plus any extra blob
         names in ``blobs``), filling every ``net.blobs[...].data`` along
-        the way — pycaffe _Net_forward semantics with ``end=``
-        truncation."""
+        the way — pycaffe _Net_forward semantics (pycaffe.py:105) with
+        ``start=``/``end=`` range control.  With ``start=``, layers
+        before it are skipped and their outputs are read from the current
+        blob mirrors (or kwargs) — the mid-net re-forward idiom."""
         import jax
 
-        if end is not None and end not in self._layer_names:
-            raise ValueError(
-                f"unknown layer {end!r} (layers: {self._layer_names})")
+        for nm, which in ((end, "end"), (start, "start")):
+            if nm is not None and nm not in self._layer_names:
+                raise ValueError(
+                    f"unknown layer {nm!r} for {which}= "
+                    f"(layers: {self._layer_names})")
+        if (start is not None and end is not None
+                and self._layer_names.index(start)
+                > self._layer_names.index(end)):
+            raise ValueError(f"start={start!r} comes after end={end!r}")
         for b in blobs or ():
             if b not in self._net.blob_shapes:
                 raise ValueError(f"unknown blob {b!r} in blobs")
         if end is not None and blobs:
             # refuse BEFORE running: blobs produced by layers after the
             # truncation point would come back stale (zeros or a previous
-            # forward's values)
+            # forward's values); blobs before a start= layer are the
+            # user-seeded mirrors, which are valid by construction
             computed = set(self._net.input_blobs)
             for n in self._net.nodes:
                 computed.update(n.tops)
@@ -316,7 +371,7 @@ class Net:
                     f"request blobs computed up to it")
         self.reshape()  # honor pending input-blob reshapes (Net::Forward
         #                 reshapes before running, _caffe.cpp forward path)
-        if self._feedable:
+        if self._feedable and start is None:
             # data layers win over mirror contents (their Forward
             # overwrites the top blobs each call in the reference)
             if self._auto_feed is None:
@@ -327,16 +382,17 @@ class Net:
                     Phase.TRAIN if self._train else Phase.TEST)
             batch = next(self._auto_feed)
             kwargs = {**batch, **kwargs}
-        key = ("fwd", self._shape_sig, end)
+        key = ("fwd", self._shape_sig, start, end)
         if key not in self._fwd_cache:
             net = self._net  # bind THIS shape's net into the program
             self._fwd_cache[key] = jax.jit(
                 lambda p, x, r: net.apply_all(
-                    p, x, train=self._train, rng=r, upto=end))
+                    p, x, train=self._train, rng=r, upto=end, start=start))
+        inputs = (self._gather_inputs(kwargs) if start is None
+                  else self._gather_range_inputs(start, end, kwargs))
         if self._needs_rng:  # fresh masks per forward (Caffe resamples)
             self._rng, self._last_rng = jax.random.split(self._rng)
-        out = self._fwd_cache[key](self._device_params(),
-                                   self._gather_inputs(kwargs),
+        out = self._fwd_cache[key](self._device_params(), inputs,
                                    self._last_rng if self._needs_rng
                                    else None)
         for name, val in out.items():

@@ -636,3 +636,66 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }}
     s0 = solver._solver.test(net_id=0)   # defaults to test_iter[0] = 1
     s1 = solver._solver.test(net_id=1)   # defaults to test_iter[1] = 2
     assert "loss" in s0 and "loss" in s1
+
+
+def test_forward_start_midnet(net):
+    """pycaffe forward(start=...) (pycaffe.py:105): skip the prefix, read
+    its outputs from the current blob mirrors — the net-surgery idiom of
+    editing an intermediate blob and re-running from there."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 1, 6, 6)).astype(np.float32)
+    base = net.forward(data=x)["ip"].copy()
+    conv_act = net.blobs["conv"].data.copy()  # post-relu (in-place)
+
+    # re-run from the ip layer on the unmodified mirror: same output
+    out = net.forward(start="ip")
+    np.testing.assert_allclose(out["ip"], base, rtol=1e-5, atol=1e-6)
+
+    # edit the intermediate blob, re-forward from ip: ip of edited blob
+    net.blobs["conv"].data[...] = conv_act * 2.0
+    out2 = net.forward(start="ip")["ip"]
+    w = net.params["ip"][0].data
+    b = net.params["ip"][1].data
+    expect = (conv_act * 2.0).reshape(4, -1) @ w.T + b
+    np.testing.assert_allclose(out2, expect, rtol=1e-4, atol=1e-5)
+
+    # seed via kwargs instead of mirror edit; start+end range
+    out3 = net.forward(start="ip", end="ip", conv=conv_act)
+    np.testing.assert_allclose(out3["ip"], base, rtol=1e-5, atol=1e-6)
+
+    # ordering and wrong-kwarg errors
+    with pytest.raises(ValueError, match="comes after"):
+        net.forward(start="ip", end="conv")
+    with pytest.raises(ValueError, match="not consumed"):
+        net.forward(start="ip", data=x)
+
+
+def test_forward_start_with_input_layers():
+    """forward(start=...) on a net declared with Input LAYERS (not the
+    legacy input: fields): Input tops inside the range are seeds from the
+    mirrors, including start at layer 0 — the full-forward-from-the-top
+    idiom."""
+    net_txt = """
+name: "inp"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+"""
+    net = caffe.Net(net_txt, phase=caffe.TEST)
+    x = np.random.default_rng(6).normal(size=(2, 3, 8, 8)).astype(np.float32)
+    base = net.forward(data=x)["ip"].copy()
+    # start at the Input layer itself: data comes from the mirror
+    out = net.forward(start="data")
+    np.testing.assert_allclose(out["ip"], base, rtol=1e-5, atol=1e-6)
+    # start just past it
+    out2 = net.forward(start="conv1")
+    np.testing.assert_allclose(out2["ip"], base, rtol=1e-5, atol=1e-6)
+    # graph-level API rejects upto before start
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="comes after"):
+        net._net.apply_all(net._device_params(), {"conv1": net.blobs[
+            "conv1"].data}, train=False, start="ip", upto="conv1")

@@ -341,6 +341,7 @@ def test_bench_cpu_smoke(tmp_path):
                BENCH_PLATFORM="cpu", BENCH_MODEL="lenet", BENCH_BATCH="4",
                BENCH_ITERS="1", BENCH_REPS="1", BENCH_WINDOWS="1",
                BENCH_DTYPE="f32", BENCH_FEED_ITERS="2",
+               BENCH_FEED_BATCH="8",
                BENCH_ATTEMPTS="1", BENCH_TIMEOUT_S="280")
     env.pop("XLA_FLAGS", None)  # conftest's 8-device flag slows the child
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -356,6 +357,17 @@ def test_bench_cpu_smoke(tmp_path):
     assert result["by_dtype"]["f32"]["images_per_sec"] == result["value"]
     feed = result["feed_in_loop"]
     assert feed["images_per_sec"] > 0 and "overlap_pct" in feed
+    # the three legs are measured at the same (overridden) batch and are
+    # mutually consistent: 0 <= overlap <= 100 and the in-loop step can't
+    # beat a perfect pipeline by more than timer noise
+    assert feed["batch"] == 8
+    assert feed["feed_alone_s_per_batch"] > 0
+    assert feed["compute_s_per_step"] > 0
+    assert 0.0 <= feed["overlap_pct"] <= 100.0
+    assert feed["bound"] in ("feed", "compute")
+    assert feed["feed_compute_ratio"] > 0
+    assert feed["step_s"] > 0.25 * max(feed["feed_alone_s_per_batch"],
+                                       feed["compute_s_per_step"])
 
 
 def test_bench_rejects_bad_dtype():
