@@ -1,0 +1,34 @@
+"""Drive: the DeepDream loop shape — forward to a mid layer, set its diff,
+ranged backward to the input, ascend — through `import caffe`."""
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sparknet_tpu import pycaffe_compat
+pycaffe_compat.install()
+import caffe  # resolves to the shim
+
+NET = """
+name: "dream"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 16 dim: 16 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "conv2" type: "Convolution" bottom: "conv1" top: "conv2"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+"""
+net = caffe.Net(NET, phase=caffe.TEST)
+rng = np.random.default_rng(0)
+img = rng.normal(size=(1, 3, 16, 16)).astype(np.float32) * 0.1
+obj = []
+for step in range(8):  # gradient-ascent loop, deepdream.py make_step shape
+    net.blobs["data"].data[...] = img
+    net.forward(end="conv2")
+    act = net.blobs["conv2"].data
+    obj.append(float((act ** 2).sum()) / 2)
+    net.blobs["conv2"].diff[...] = act          # d(0.5*||a||^2)/da = a
+    g = net.backward(start="conv2")["data"]
+    img = img + 0.5 * g / (np.abs(g).mean() + 1e-8)
+assert obj[-1] > obj[0] * 1.5, obj  # the objective climbs
+print("deepdream-loop drive OK:", [round(o, 2) for o in (obj[0], obj[-1])])

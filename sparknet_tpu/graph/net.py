@@ -461,7 +461,7 @@ class Net:
                     if t in eps:
                         last_producer[t] = n.lp.name
         started = start is None
-        for node in self.nodes:
+        for ni, node in enumerate(self.nodes):
             if not started:
                 if node.lp.name != start:
                     continue
@@ -480,7 +480,11 @@ class Net:
                     f"start layer must be fed in inputs")
             layer_rng = None
             if rng is not None and node.impl.needs_rng(node.lp, train):
-                rng, layer_rng = jax.random.split(rng)
+                # per-node identity fold, NOT sequential splits: a ranged
+                # run (start=/upto=) must give each layer the same stream
+                # the full forward gave it, so ranged backward replays the
+                # masks its forward actually used
+                layer_rng = jax.random.fold_in(rng, ni)
             p = self.node_params(new_params, node)
             bots = [blobs[b] for b in node.bottoms]
             stateful = getattr(node.impl, "has_state", False)

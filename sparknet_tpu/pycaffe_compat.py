@@ -287,12 +287,12 @@ class Net:
             raise ValueError(f"not input blobs: {sorted(unknown)}")
         return inputs
 
-    def _gather_range_inputs(self, start: str, end: str | None,
-                             kwargs) -> dict[str, np.ndarray]:
-        """Seed blobs for forward(start=...): every bottom consumed in
-        [start, end] that is not produced inside the range comes from
-        kwargs (copied) or the current blob mirrors — pycaffe semantics,
-        where a mid-net forward reads whatever the blobs hold."""
+    def _range_sets(self, start: str, end: str | None,
+                    ) -> tuple[list[str], set[str]]:
+        """(needed, produced) blob sets for the layers in [start, end] —
+        the ONE definition of range membership shared by ranged forward
+        and backward.  Input-type layers execute nothing, so their tops
+        are needed (fed), not produced, even inside the range."""
         names = self._layer_names
         si = names.index(start)
         ei = names.index(end) + 1 if end is not None else len(names)
@@ -300,8 +300,6 @@ class Net:
         needed: list[str] = []
         for n in self._net.nodes[si:ei]:
             if getattr(n.impl, "is_input", lambda: False)():
-                # Input-type layers execute nothing — their tops are fed,
-                # not produced, even when the layer sits inside the range
                 for t in n.tops:
                     if t not in produced and t not in needed:
                         needed.append(t)
@@ -310,6 +308,15 @@ class Net:
                 if b not in produced and b not in needed:
                     needed.append(b)
             produced.update(n.tops)
+        return needed, produced
+
+    def _gather_range_inputs(self, start: str, end: str | None,
+                             kwargs) -> dict[str, np.ndarray]:
+        """Seed blobs for forward(start=...): every bottom consumed in
+        [start, end] that is not produced inside the range comes from
+        kwargs (copied) or the current blob mirrors — pycaffe semantics,
+        where a mid-net forward reads whatever the blobs hold."""
+        needed, _ = self._range_sets(start, end)
         inputs = {}
         for b in needed:
             arr = np.asarray(kwargs[b] if b in kwargs
@@ -409,13 +416,19 @@ class Net:
                 wanted.append(extra)
         return {k: self.blobs[k].data for k in wanted}
 
-    def backward(self, diffs=None, **kwargs):
+    def backward(self, diffs=None, start: str | None = None,
+                 end: str | None = None, **kwargs):
         """Back-propagate: cotangents come from ``kwargs`` (np arrays per
         top blob) or, when omitted, from the ``.diff`` mirrors of the net
-        output blobs.  Fills ``.diff`` on params and input blobs and
-        returns {input blob: diff, plus any blob named in ``diffs``} —
-        pycaffe _Net_backward, implemented as one ``jax.vjp`` over the
-        functional forward (there is no per-layer Backward here).
+        output blobs (or of ``start``'s tops when given).  Fills ``.diff``
+        on params and input blobs and returns {input blob: diff, plus any
+        blob named in ``diffs``} — pycaffe _Net_backward (pycaffe.py:141),
+        implemented as one ``jax.vjp`` over the functional forward (there
+        is no per-layer Backward here).  ``start``/``end`` bound the
+        backprop range BACKWARD order: start is the later layer whose top
+        diffs seed the pass (the DeepDream idiom,
+        ``net.backward(start='inception_4c/output')``), end the earlier
+        layer it stops after — its range-input diffs are what comes back.
         Intermediate-blob diffs requested via ``diffs`` come from
         cotangents of zero perturbations injected at each blob's final
         assignment.  Stochastic layers replay the most recent forward's
@@ -423,19 +436,57 @@ class Net:
         import jax
         import jax.numpy as jnp
 
+        names = self._layer_names
+        for nm, which in ((start, "start"), (end, "end")):
+            if nm is not None and nm not in names:
+                raise ValueError(
+                    f"unknown layer {nm!r} for {which}= (layers: {names})")
+        ranged = start is not None or end is not None
+        if ranged:
+            si = names.index(start) if start is not None else len(names) - 1
+            ei = names.index(end) if end is not None else 0
+            if ei > si:
+                raise ValueError(
+                    f"end={end!r} comes after start={start!r} (backward "
+                    f"runs from start back to end)")
+            fstart, fstop = names[ei], names[si]  # forward-order range
+            range_inputs = self._gather_range_inputs(fstart, fstop, {})
+            # strictly in-range tops: an out-of-range seed or diffs entry
+            # (even a net input) must raise, not silently return zeros
+            _, produced = self._range_sets(fstart, fstop)
+        else:
+            fstart = fstop = None
+            range_inputs = {name: self.blobs[name].data
+                            for name in self._net.input_blobs}
+            produced = set(self._net.blob_shapes)
+
         for b in diffs or ():
             if b not in self._net.blob_shapes:
                 raise ValueError(f"unknown blob {b!r} in diffs")
-        # input blobs already get diffs from the vjp inputs cotangent
-        extra = tuple(b for b in diffs or ()
-                      if b not in self._net.input_blobs)
+            if b not in produced and b not in range_inputs:
+                raise ValueError(
+                    f"blob {b!r} is outside the backward range "
+                    f"[{end!r}, {start!r}]")
+        # range-input blobs already get diffs from the vjp inputs
+        # cotangent
+        extra = tuple(b for b in diffs or () if b not in range_inputs)
 
         seeds = dict(kwargs)
         if not seeds:
-            seeds = {k: self.blobs[k].diff for k in self._net.output_blobs}
+            if start is not None:
+                node = next(n for n in self._net.nodes
+                            if n.lp.name == start)
+                seeds = {t: self.blobs[t].diff for t in node.tops}
+            else:
+                seeds = {k: self.blobs[k].diff
+                         for k in self._net.output_blobs}
         for k in seeds:
             if k not in self._net.blob_shapes:
                 raise ValueError(f"unknown top blob {k!r}")
+            if k not in produced:
+                raise ValueError(
+                    f"seed blob {k!r} is not produced in the backward "
+                    f"range [{end!r}, {start!r}]")
         seeds = {k: np.asarray(v, np.float32).reshape(
                      self._net.blob_shapes[k])
                  for k, v in seeds.items()}
@@ -443,25 +494,26 @@ class Net:
         # only the seed arrays cross host->device; the dense zero
         # cotangents for every other blob materialize as constants
         # INSIDE the compiled program
-        key = ("bwd", self._shape_sig, extra, tuple(sorted(seeds)))
+        key = ("bwd", self._shape_sig, fstart, fstop, extra,
+               tuple(sorted(seeds)))
         if key not in self._fwd_cache:
             bwd_net = self._net  # bind THIS shape's net into the program
+
             def run_bwd(p, x, eps, seeds, r):
                 def fn(p, x, eps):
                     return bwd_net.apply_all(p, x, train=self._train,
-                                             rng=r, eps=eps)
+                                             rng=r, eps=eps,
+                                             start=fstart, upto=fstop)
                 out, vjp = jax.vjp(fn, p, x, eps)
                 cts = {k: seeds[k] if k in seeds else jnp.zeros_like(v)
                        for k, v in out.items()}
                 return vjp(cts)
             self._fwd_cache[key] = jax.jit(run_bwd)
 
-        inputs = {name: self.blobs[name].data
-                  for name in self._net.input_blobs}
         eps = {b: jnp.zeros(self._net.blob_shapes[b], jnp.float32)
                for b in extra}
         p_bar, x_bar, e_bar = self._fwd_cache[key](
-            self._device_params(), inputs, eps, seeds,
+            self._device_params(), range_inputs, eps, seeds,
             self._last_rng if self._needs_rng else None)
         for lname, blobs_bar in p_bar.items():
             for pb, bar in zip(self.params[lname], blobs_bar):

@@ -699,3 +699,77 @@ layer { name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
     with _pytest.raises(ValueError, match="comes after"):
         net._net.apply_all(net._device_params(), {"conv1": net.blobs[
             "conv1"].data}, train=False, start="ip", upto="conv1")
+
+
+def test_backward_ranged(net):
+    """pycaffe backward(start=..., end=...): start's top diffs seed the
+    pass (the DeepDream idiom), end bounds how far down it runs and its
+    range-input diffs come back."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(4, 1, 6, 6)).astype(np.float32)
+    net.forward(data=x)
+    dy = rng.normal(size=(4, 3)).astype(np.float32)
+    full = net.backward(ip=dy)
+    dconv_w_full = net.params["conv"][0].diff.copy()
+
+    # single-layer range: d(ip)/d(conv) through the ip weights only
+    out = net.backward(start="ip", end="ip", ip=dy)
+    assert set(out) == {"conv"}
+    w = net.params["ip"][0].data
+    np.testing.assert_allclose(out["conv"],
+                               (dy @ w).reshape(4, 2, 4, 4),
+                               rtol=1e-4, atol=1e-5)
+
+    # DeepDream idiom: seed from the .diff mirror of start's top,
+    # backprop all the way down — identical to the full backward
+    net.blobs["ip"].diff[...] = dy
+    out2 = net.backward(start="ip")
+    np.testing.assert_allclose(out2["data"], full["data"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(net.params["conv"][0].diff, dconv_w_full,
+                               rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="comes after"):
+        net.backward(start="conv", end="ip", ip=dy)
+    with pytest.raises(ValueError, match="not produced in the backward"):
+        net.backward(start="ip", end="ip", conv=np.zeros((4, 2, 4, 4),
+                                                         np.float32))
+
+
+def test_ranged_backward_replays_correct_masks():
+    """A ranged backward whose range EXCLUDES an earlier stochastic layer
+    must still replay the in-range layers' forward masks (per-node rng
+    identity, not sequential splits)."""
+    txt = """
+name: "2drop"
+input: "data"
+input_shape { dim: 8 dim: 6 }
+layer { name: "drop1" type: "Dropout" bottom: "data" top: "d1"
+  dropout_param { dropout_ratio: 0.5 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "d1" top: "h"
+  inner_product_param { num_output: 6 weight_filler { type: "xavier" } } }
+layer { name: "drop2" type: "Dropout" bottom: "h" top: "d2"
+  dropout_param { dropout_ratio: 0.5 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "d2" top: "out"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+"""
+    net = caffe.Net(txt, phase=caffe.TRAIN)
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(8, 6)).astype(np.float32)
+    net.forward(data=x)
+    dy = rng.normal(size=(8, 3)).astype(np.float32)
+    full = net.backward(diffs=["d1"], out=dy)
+    ip1_diff_full = net.params["ip1"][0].diff.copy()
+    # range [ip1..ip2] excludes drop1; drop2 (inside) must replay the
+    # mask the forward used — the diffs must match the full backward
+    ranged = net.backward(start="ip2", end="ip1", out=dy)
+    assert set(ranged) == {"d1"}
+    np.testing.assert_allclose(ranged["d1"], full["d1"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(net.params["ip1"][0].diff, ip1_diff_full,
+                               rtol=1e-5, atol=1e-6)
+    # out-of-range seeds and diffs raise rather than silently zeroing
+    with pytest.raises(ValueError, match="not produced in the backward"):
+        net.backward(start="ip2", end="ip1", data=dy)
+    with pytest.raises(ValueError, match="outside the backward range"):
+        net.backward(start="ip2", end="ip1", out=dy, diffs=["data"])
