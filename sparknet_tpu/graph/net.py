@@ -429,17 +429,22 @@ class Net:
         differentiable, so grads flow back in f32)."""
         if train is None:
             train = self.state.phase == Phase.TRAIN
-        active = self.nodes
+        start_i = 0
         if start is not None:
-            idx = next(i for i, n in enumerate(self.nodes)
-                       if n.lp.name == start)
-            if upto is not None:
-                uidx = next((i for i, n in enumerate(self.nodes)
-                             if n.lp.name == upto), None)
-                if uidx is not None and uidx < idx:
+            start_i = next(i for i, n in enumerate(self.nodes)
+                           if n.lp.name == start)
+        stop_i = len(self.nodes) - 1
+        if upto is not None:
+            ui = next((i for i, n in enumerate(self.nodes)
+                       if n.lp.name == upto), None)
+            if ui is not None:
+                if ui < start_i:
                     raise ValueError(
                         f"start={start!r} comes after upto={upto!r}")
-            active = self.nodes[idx:]
+                stop_i = ui
+        # the nodes this run actually executes — rng validation and eps
+        # placement must see the RANGE, not the whole net
+        active = self.nodes[start_i:stop_i + 1]
         if rng is None and any(n.impl.needs_rng(n.lp, train) for n in active):
             raise ValueError(
                 f"net {self.name!r} needs an rng in this mode "
@@ -452,11 +457,14 @@ class Net:
         new_params = dict(params)
         cd = self.compute_dtype
         loss = jnp.zeros((), jnp.float32)
-        # eps injection point: a blob's FINAL assignment (in-place chains
-        # reassign; Caffe's per-blob diff is the diff at the final value)
+        # eps injection point: a blob's FINAL assignment WITHIN the
+        # executed range (in-place chains reassign; Caffe's per-blob diff
+        # is the diff at the final value the run actually produced — a
+        # producer outside [start, upto] never runs and must not claim
+        # the injection)
         last_producer: dict[str, str] = {}
         if eps:
-            for n in self.nodes:
+            for n in active:
                 for t in n.tops:
                     if t in eps:
                         last_producer[t] = n.lp.name

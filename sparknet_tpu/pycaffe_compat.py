@@ -287,6 +287,15 @@ class Net:
             raise ValueError(f"not input blobs: {sorted(unknown)}")
         return inputs
 
+    def _range_needs_rng(self, start: str | None, end: str | None) -> bool:
+        """Does [start, end] (forward order, None = net edge) contain a
+        stochastic layer in this phase?"""
+        names = self._layer_names
+        si = names.index(start) if start is not None else 0
+        ei = names.index(end) + 1 if end is not None else len(names)
+        return any(n.impl.needs_rng(n.lp, self._train)
+                   for n in self._net.nodes[si:ei])
+
     def _range_sets(self, start: str, end: str | None,
                     ) -> tuple[list[str], set[str]]:
         """(needed, produced) blob sets for the layers in [start, end] —
@@ -397,11 +406,15 @@ class Net:
                     p, x, train=self._train, rng=r, upto=end, start=start))
         inputs = (self._gather_inputs(kwargs) if start is None
                   else self._gather_range_inputs(start, end, kwargs))
-        if self._needs_rng:  # fresh masks per forward (Caffe resamples)
+        # resample only when the EXECUTED range has a stochastic layer: a
+        # ranged forward past the net's dropouts must not advance the
+        # stream a later ranged backward will replay
+        if self._range_needs_rng(start, end):
             self._rng, self._last_rng = jax.random.split(self._rng)
-        out = self._fwd_cache[key](self._device_params(), inputs,
-                                   self._last_rng if self._needs_rng
-                                   else None)
+            rng_arg = self._last_rng
+        else:
+            rng_arg = None
+        out = self._fwd_cache[key](self._device_params(), inputs, rng_arg)
         for name, val in out.items():
             # np.array copies: jax-backed views are read-only, mirrors
             # must stay mutable for the net-surgery idiom
@@ -514,8 +527,17 @@ class Net:
                for b in extra}
         p_bar, x_bar, e_bar = self._fwd_cache[key](
             self._device_params(), range_inputs, eps, seeds,
-            self._last_rng if self._needs_rng else None)
+            self._last_rng if self._range_needs_rng(fstart, fstop)
+            else None)
+        if ranged:
+            # Caffe's ranged Backward leaves out-of-range param diffs
+            # untouched; only layers inside [end, start] get written
+            in_range = set()
+            for n in self._net.nodes[ei:si + 1]:
+                in_range.update(n.owner_keys())
         for lname, blobs_bar in p_bar.items():
+            if ranged and lname not in in_range:
+                continue
             for pb, bar in zip(self.params[lname], blobs_bar):
                 pb.diff = np.array(bar)
         for name, bar in x_bar.items():

@@ -719,6 +719,17 @@ def test_backward_ranged(net):
     np.testing.assert_allclose(out["conv"],
                                (dy @ w).reshape(4, 2, 4, 4),
                                rtol=1e-4, atol=1e-5)
+    # out-of-range param diffs are left untouched (caffe's ranged
+    # Backward never visits those layers), not zeroed
+    np.testing.assert_array_equal(net.params["conv"][0].diff,
+                                  dconv_w_full)
+    # diffs= on a blob whose in-place reassignment (relu) lies OUTSIDE
+    # the range: the injection attaches at the range's own final
+    # assignment (the conv layer), so the cotangent is the seed itself
+    dyc = rng.normal(size=(4, 2, 4, 4)).astype(np.float32)
+    outc = net.backward(start="conv", end="conv", conv=dyc,
+                        diffs=["conv"])
+    np.testing.assert_allclose(outc["conv"], dyc, rtol=1e-6)
 
     # DeepDream idiom: seed from the .diff mirror of start's top,
     # backprop all the way down — identical to the full backward
@@ -773,3 +784,9 @@ layer { name: "ip2" type: "InnerProduct" bottom: "d2" top: "out"
         net.backward(start="ip2", end="ip1", data=dy)
     with pytest.raises(ValueError, match="outside the backward range"):
         net.backward(start="ip2", end="ip1", out=dy, diffs=["data"])
+    # a ranged forward whose range has NO stochastic layer must not
+    # advance the mask stream the ranged backward replays
+    net.forward(start="ip2")
+    again = net.backward(start="ip2", end="ip1", out=dy)
+    np.testing.assert_allclose(again["d1"], full["d1"],
+                               rtol=1e-5, atol=1e-6)
