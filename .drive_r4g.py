@@ -1,0 +1,44 @@
+"""Drive: an unmodified pycaffe-style script front-to-back — mode calls,
+seed, MemoryData binding, batched scoring via forward_all."""
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sparknet_tpu import pycaffe_compat
+pycaffe_compat.install()
+import caffe
+
+caffe.set_mode_gpu()          # line 1 of every pycaffe script
+caffe.set_device(0)
+caffe.set_random_seed(42)
+print("layer types:", len(caffe.layer_type_list()))
+
+NET = """
+name: "mem"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 5 width: 5 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+"""
+net = caffe.Net(NET, phase=caffe.TEST)
+rng = np.random.default_rng(0)
+data = rng.normal(size=(8, 1, 5, 5)).astype(np.float32)
+net.set_input_arrays(data, np.zeros(8, np.float32))
+p1 = net.forward()["prob"]
+p2 = net.forward()["prob"]
+assert p1.shape == (4, 3) and not np.array_equal(p1, p2)
+
+# batched scoring over an Input-declared deploy net
+DEPLOY = """
+name: "deploy"
+input: "data"
+input_shape { dim: 4 dim: 1 dim: 5 dim: 5 }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+"""
+dep = caffe.Net(DEPLOY, phase=caffe.TEST)
+outs = dep.forward_all(data=rng.normal(size=(11, 1, 5, 5)).astype(np.float32))
+assert outs["prob"].shape == (11, 3)
+assert dep.blob_loss_weights["prob"] == 0.0
+caffe._random_seed = None
+print("pycaffe-script drive OK:", outs["prob"].sum(1)[:3].round(3))

@@ -76,6 +76,14 @@ class _LayerNode:
             keys.add(self.param_key)
         return keys
 
+    def loss_weights(self) -> list[float]:
+        """Per-top loss weights — Layer::SetLossWeights resolution
+        (explicit loss_weight, else 1 on a loss layer's first top)."""
+        weights = list(self.lp.loss_weight)
+        if not weights and self.impl.is_loss():
+            weights = [1.0] + [0.0] * (len(self.tops) - 1)
+        return weights
+
 
 class Net:
     """A phase-filtered, shape-inferred, executable network."""
@@ -524,10 +532,7 @@ class Net:
                 blobs[t] = v
             # loss accumulation (reference: Layer::SetLossWeights +
             # Net::Forward summing weighted tops)
-            weights = list(node.lp.loss_weight)
-            if not weights and node.impl.is_loss():
-                weights = [1.0] + [0.0] * (len(node.tops) - 1)
-            for w, v in zip(weights, tops):
+            for w, v in zip(node.loss_weights(), tops):
                 if w:
                     # f32 accumulation even when the top was computed in a
                     # reduced compute_dtype (loss_weight on non-loss layers)

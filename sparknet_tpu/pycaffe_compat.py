@@ -46,6 +46,51 @@ import numpy as np
 TRAIN = 0
 TEST = 1
 
+_random_seed: int | None = None
+
+
+def set_mode_cpu() -> None:
+    """No-op device-mode selector (reference: _caffe.cpp set_mode_cpu).
+    Device placement belongs to JAX here (JAX_PLATFORMS /
+    jax.config.update); the call exists so unmodified pycaffe scripts —
+    which near-universally open with set_mode_cpu()/set_mode_gpu() —
+    run untouched."""
+
+
+def set_mode_gpu() -> None:
+    """No-op accelerator-mode selector (see set_mode_cpu)."""
+
+
+def set_device(device_id: int) -> None:
+    """No-op device selector (reference: _caffe.cpp set_device); JAX
+    owns device placement."""
+
+
+def set_random_seed(seed: int) -> None:
+    """Seed subsequent Net constructions — filler init and the dropout
+    mask stream (reference: _caffe.cpp set_random_seed →
+    Caffe::set_random_seed).  Like Caffe's global RNG, the stream
+    ADVANCES per construction: consecutive nets are reproducible but
+    distinct; re-seed to replay."""
+    global _random_seed
+    _random_seed = int(seed)
+
+
+def _next_seed() -> int:
+    global _random_seed
+    if _random_seed is None:
+        return 0
+    s = _random_seed
+    _random_seed += 1  # the global stream advances per construction
+    return s
+
+
+def layer_type_list() -> list:
+    """Registered layer type names (reference: _caffe.cpp
+    layer_type_list → LayerRegistry::LayerTypeList)."""
+    from .ops.registry import registered_types
+    return registered_types()
+
 
 class Layer:
     """Base class for user Python layers (python_layer.hpp analog).
@@ -134,6 +179,7 @@ class Net:
         net_param = load_net_prototxt(model)
         self._state = NetState(Phase.TRAIN if self._train else Phase.TEST)
         self._net = GraphNet(net_param, self._state)
+        seed0 = _next_seed()
         if initial_params is not None:
             # pre-built collection (solver views share one init)
             params = initial_params
@@ -141,7 +187,7 @@ class Net:
             # full filler init even when weights are given: layers absent
             # from the weights file must keep their filler values, exactly
             # like Net::CopyTrainedLayersFrom over a freshly SetUp net
-            params = self._net.init(jax.random.PRNGKey(0))
+            params = self._net.init(jax.random.PRNGKey(seed0))
         if weights:
             from .solvers.solver import load_weights_into
             params = load_weights_into(self._net, params, weights)
@@ -156,10 +202,8 @@ class Net:
         self._shape_sig = tuple(sorted(
             (k, tuple(v)) for k, v in self._net.input_blobs.items()))
         self._net_cache: dict = {self._shape_sig: self._net}
-        self._rng = jax.random.PRNGKey(0)
+        self._rng = jax.random.PRNGKey(seed0)
         self._last_rng = self._rng  # mask of the most recent forward
-        self._needs_rng = any(n.impl.needs_rng(n.lp, self._train)
-                              for n in self._net.nodes)
         # DB-backed data layers self-feed on forward(), advancing their
         # cursor each call like the reference's prefetching data layers
         from .data.db import _FEEDABLE_TYPES
@@ -167,6 +211,9 @@ class Net:
         self._auto_feed = None
         self._feedable = any(n.lp.type in _FEEDABLE_TYPES
                              for n in self._net.nodes)
+        self._memory_data = None  # set_input_arrays state
+        self._memory_node = None
+        self._memory_pos = 0
 
     # -- introspection ----------------------------------------------------
     @property
@@ -200,6 +247,19 @@ class Net:
     @property
     def outputs(self) -> list[str]:
         return list(self._net.output_blobs)
+
+    @property
+    def blob_loss_weights(self):
+        """{blob name: loss weight} over every blob — pycaffe
+        _Net_blob_loss_weights (pycaffe.py:32; weights assigned per top
+        as in Net::AppendTop: explicit loss_weight, else 1 on a loss
+        layer's first top, else 0)."""
+        out = collections.OrderedDict(
+            (b, 0.0) for b in self._net.blob_shapes)
+        for n in self._net.nodes:
+            for t, w in zip(n.tops, n.loss_weights()):
+                out[t] = float(w)
+        return out
 
     # -- execution --------------------------------------------------------
     def reshape(self) -> None:
@@ -247,8 +307,6 @@ class Net:
             self._net_cache[sig] = new_net
         self._net = new_net
         self._shape_sig = sig
-        self._needs_rng = any(n.impl.needs_rng(n.lp, self._train)
-                              for n in self._net.nodes)
         PyBlob = _pyblob_cls()
         for name, shape in self._net.blob_shapes.items():
             if name in self._net.input_blobs:
@@ -398,6 +456,10 @@ class Net:
                     Phase.TRAIN if self._train else Phase.TEST)
             batch = next(self._auto_feed)
             kwargs = {**batch, **kwargs}
+        if self._memory_data is not None and start is None:
+            # MemoryData: each Forward consumes the next bound batch,
+            # cycling (memory_data_layer.cpp Forward)
+            kwargs = {**self._next_memory_batch(), **kwargs}
         key = ("fwd", self._shape_sig, start, end)
         if key not in self._fwd_cache:
             net = self._net  # bind THIS shape's net into the program
@@ -547,6 +609,123 @@ class Net:
             self.blobs[b].diff = np.array(e_bar[b])
             result[b] = self.blobs[b].diff
         return result
+
+    # -- batched drivers (pycaffe.py:159-278) -----------------------------
+    def _batch(self, blobs):
+        """Split {name: array} into net-batch-size chunks, zero-padding
+        the last (pycaffe _Net_batch)."""
+        if not blobs:
+            return
+        num = len(next(iter(blobs.values())))
+        batch_size = next(iter(self.blobs.values())).num
+        remainder = num % batch_size
+        for b in range(num // batch_size):
+            i = b * batch_size
+            yield {name: blobs[name][i:i + batch_size] for name in blobs}
+        if remainder > 0:
+            padded = {}
+            for name in blobs:
+                arr = np.asarray(blobs[name])
+                padding = np.zeros((batch_size - remainder,) + arr.shape[1:],
+                                   arr.dtype)
+                padded[name] = np.concatenate([arr[-remainder:], padding])
+            yield padded
+
+    @staticmethod
+    def _collect(acc: dict, outs: dict, scalars: set) -> None:
+        """Accumulate one batch's outputs: per-sample blobs extend the
+        list row-wise; scalar blobs (losses) keep one entry PER CHUNK —
+        they have no sample axis to trim or stack."""
+        for out, ob in outs.items():
+            arr = np.array(ob)
+            if arr.ndim == 0:
+                scalars.add(out)
+                acc[out].append(arr)
+            else:
+                acc[out].extend(arr)
+
+    def forward_all(self, blobs=None, **kwargs):
+        """Run forward in net-batch-size chunks over arbitrarily long
+        inputs; returns {blob: stacked outputs} with the tail padding
+        discarded (pycaffe _Net_forward_all).  Scalar outputs (losses)
+        come back as one value per chunk."""
+        all_outs = {out: [] for out in set(self.outputs) | set(blobs or [])}
+        scalars: set = set()
+        for batch in self._batch({k: np.asarray(v)
+                                  for k, v in kwargs.items()}):
+            self._collect(all_outs, self.forward(blobs=blobs, **batch),
+                          scalars)
+        if not kwargs:  # self-feeding nets: a single batch
+            self._collect(all_outs, self.forward(blobs=blobs), scalars)
+        for out in all_outs:
+            all_outs[out] = np.asarray(all_outs[out])
+        if kwargs:
+            n_in = len(next(iter(kwargs.values())))
+            for out in all_outs:
+                if out not in scalars and len(all_outs[out]) > n_in:
+                    all_outs[out] = all_outs[out][:n_in]
+        return all_outs
+
+    def forward_backward_all(self, blobs=None, diffs=None, **kwargs):
+        """Batched forward + backward (pycaffe
+        _Net_forward_backward_all): forward kwargs feed input blobs,
+        backward kwargs seed output-blob diffs; returns (all_outs,
+        all_diffs) with tail padding discarded."""
+        import itertools
+
+        all_outs = {out: [] for out in set(self.outputs) | set(blobs or [])}
+        all_diffs = {d: [] for d in set(self.inputs) | set(diffs or [])}
+        forward_batches = self._batch(
+            {k: np.asarray(kwargs[k]) for k in self.inputs if k in kwargs})
+        backward_batches = self._batch(
+            {k: np.asarray(kwargs[k]) for k in self.outputs if k in kwargs})
+        scalars: set = set()
+        for fb, bb in itertools.zip_longest(forward_batches,
+                                            backward_batches, fillvalue={}):
+            self._collect(all_outs, self.forward(blobs=blobs, **fb),
+                          scalars)
+            self._collect(all_diffs, self.backward(diffs=diffs, **bb),
+                          scalars)
+        for out in all_outs:
+            all_outs[out] = np.asarray(all_outs[out])
+        for d in all_diffs:
+            all_diffs[d] = np.asarray(all_diffs[d])
+        if kwargs:
+            n_in = len(next(iter(kwargs.values())))
+            for acc in (all_outs, all_diffs):
+                for k in acc:
+                    if k not in scalars and len(acc[k]) > n_in:
+                        acc[k] = acc[k][:n_in]
+        return all_outs, all_diffs
+
+    def set_input_arrays(self, data, labels) -> None:
+        """Bind in-memory arrays to the net's MemoryData layer
+        (pycaffe _Net_set_input_arrays / MemoryDataLayer::Reset,
+        memory_data_layer.cpp: size must divide into whole batches;
+        each forward() takes the next batch, cycling)."""
+        node = next((n for n in self._net.nodes
+                     if n.lp.type == "MemoryData"), None)
+        if node is None:
+            raise RuntimeError(
+                "set_input_arrays requires a MemoryData layer")
+        data = np.asarray(data, np.float32)
+        labels = np.asarray(labels, np.float32).reshape(len(data))
+        bs = self._net.blob_shapes[node.tops[0]][0]
+        if len(data) % bs:
+            raise ValueError(
+                f"sample count {len(data)} not divisible by batch size "
+                f"{bs} (MemoryDataLayer::Reset)")
+        self._memory_node = node
+        self._memory_data = (data, labels)
+        self._memory_pos = 0
+
+    def _next_memory_batch(self) -> dict:
+        d, l = self._memory_data
+        bs = self._net.blob_shapes[self._memory_node.tops[0]][0]
+        i = self._memory_pos
+        self._memory_pos = (i + bs) % len(d)
+        tops = self._memory_node.tops
+        return {tops[0]: d[i:i + bs], tops[1]: l[i:i + bs]}
 
     # -- persistence (net surgery round trip) -----------------------------
     def save(self, path: str) -> None:

@@ -790,3 +790,128 @@ layer { name: "ip2" type: "InnerProduct" bottom: "d2" top: "out"
     again = net.backward(start="ip2", end="ip1", out=dy)
     np.testing.assert_allclose(again["d1"], full["d1"],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_module_level_pycaffe_surface():
+    """The functions every pycaffe script calls before touching a net
+    (reference python/caffe/__init__.py + _caffe.cpp): mode/device
+    selectors (no-ops here — JAX owns placement), set_random_seed
+    (drives filler init), layer_type_list."""
+    caffe.set_mode_cpu()
+    caffe.set_mode_gpu()
+    caffe.set_device(0)
+    types = caffe.layer_type_list()
+    assert "Convolution" in types and "Python" in types
+    try:
+        caffe.set_random_seed(1234)
+        a = caffe.Net(NET, phase=caffe.TEST)
+        b = caffe.Net(NET, phase=caffe.TEST)
+        caffe.set_random_seed(1234)
+        a2 = caffe.Net(NET, phase=caffe.TEST)
+    finally:
+        caffe._random_seed = None
+    # the global stream advances per construction (Caffe semantics):
+    # consecutive nets are distinct, re-seeding replays
+    assert not np.array_equal(a.params["conv"][0].data,
+                              b.params["conv"][0].data)
+    np.testing.assert_array_equal(a.params["conv"][0].data,
+                                  a2.params["conv"][0].data)
+
+
+def test_blob_loss_weights(net):
+    # plain net: no loss layers, all zeros
+    assert set(net.blob_loss_weights.values()) == {0.0}
+    n2 = caffe.Net("""
+name: "l"
+input: "data"
+input_shape { dim: 2 dim: 4 }
+input: "label"
+input_shape { dim: 2 }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+layer { name: "aux" type: "InnerProduct" bottom: "data" top: "aux"
+  loss_weight: 0.4
+  inner_product_param { num_output: 1 weight_filler { type: "xavier" } } }
+""", phase=caffe.TRAIN)
+    w = n2.blob_loss_weights
+    assert w["loss"] == 1.0 and w["aux"] == 0.4 and w["ip"] == 0.0
+
+
+def test_forward_all_batches_and_discards_padding(net):
+    """forward_all chunks arbitrary-length inputs into net batches and
+    drops the zero padding from the tail (pycaffe _Net_forward_all)."""
+    rng = np.random.default_rng(9)
+    x10 = rng.normal(size=(10, 1, 6, 6)).astype(np.float32)  # batch is 4
+    outs = net.forward_all(data=x10)
+    assert outs["ip"].shape == (10, 3)
+    # each chunk matches a direct forward on it
+    direct = net.forward(data=x10[:4])["ip"]
+    np.testing.assert_allclose(outs["ip"][:4], direct, rtol=1e-5,
+                               atol=1e-6)
+    # extra blob collection
+    outs2 = net.forward_all(blobs=["conv"], data=x10)
+    assert outs2["conv"].shape == (10, 2, 4, 4)
+
+
+def test_forward_backward_all(net):
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(6, 1, 6, 6)).astype(np.float32)
+    dy = rng.normal(size=(6, 3)).astype(np.float32)
+    outs, diffs = net.forward_backward_all(data=x, ip=dy)
+    assert outs["ip"].shape == (6, 3)
+    assert diffs["data"].shape == (6, 1, 6, 6)
+    # first chunk agrees with the direct calls
+    net.forward(data=x[:4])
+    d = net.backward(ip=dy[:4])
+    np.testing.assert_allclose(diffs["data"][:4], d["data"],
+                               rtol=1e-5, atol=1e-6)
+    # loss-bearing net: scalar outputs come back one-per-chunk, not
+    # per-sample (nothing to trim)
+    lnet = caffe.Net("""
+name: "l"
+input: "data"
+input_shape { dim: 4 dim: 3 }
+input: "label"
+input_shape { dim: 4 }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+""", phase=caffe.TRAIN)
+    rng2 = np.random.default_rng(12)
+    outs2 = lnet.forward_all(
+        data=rng2.normal(size=(10, 3)).astype(np.float32),
+        label=rng2.integers(0, 2, size=(10,)).astype(np.float32))
+    assert outs2["loss"].shape == (3,)  # one loss per chunk (4+4+pad)
+    assert np.isfinite(outs2["loss"]).all()
+
+
+def test_set_input_arrays_memory_data():
+    """MemoryData nets: set_input_arrays binds host arrays; each
+    forward() consumes the next batch, cycling
+    (memory_data_layer.cpp Reset/Forward)."""
+    txt = """
+name: "mem"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 2 channels: 1 height: 3 width: 3 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+"""
+    net = caffe.Net(txt, phase=caffe.TEST)
+    rng = np.random.default_rng(11)
+    data = rng.normal(size=(4, 1, 3, 3)).astype(np.float32)
+    labels = np.arange(4, dtype=np.float32)
+    net.set_input_arrays(data, labels)
+    net.forward()
+    np.testing.assert_array_equal(net.blobs["label"].data, [0, 1])
+    net.forward()
+    np.testing.assert_array_equal(net.blobs["label"].data, [2, 3])
+    net.forward()  # cycles
+    np.testing.assert_array_equal(net.blobs["label"].data, [0, 1])
+    with pytest.raises(ValueError, match="not divisible"):
+        net.set_input_arrays(data[:3], labels[:3])
+    plain = caffe.Net(NET, phase=caffe.TEST)
+    with pytest.raises(RuntimeError, match="MemoryData"):
+        plain.set_input_arrays(data, labels)
