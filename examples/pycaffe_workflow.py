@@ -16,6 +16,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Honor JAX_PLATFORMS=cpu even with a TPU plugin installed: some plugins
+# (the tunneled axon one on this rig) ignore the env var and hang backend
+# init when unreachable; the config route is always respected.
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 from sparknet_tpu import pycaffe_compat  # noqa: E402
 
 pycaffe_compat.install()
@@ -98,6 +105,30 @@ def main() -> None:
     probs = dnet.forward()["prob"]
     print("class probabilities:", np.round(probs[0], 3))
     assert abs(probs.sum() - 1.0) < 1e-4
+
+    # --- deploy-time reshape (the batch-size idiom) ---------------------
+    dnet.blobs["data"].reshape(5, 1, 12, 12)
+    dnet.blobs["data"].data[...] = np.random.default_rng(1).uniform(
+        size=(5, 1, 12, 12)).astype(np.float32)
+    probs5 = dnet.forward()["prob"]
+    print("after reshape to batch 5:", probs5.shape)
+    assert probs5.shape == (5, 3)
+
+    # --- batched scoring over many samples ------------------------------
+    imgs = np.random.default_rng(2).uniform(
+        size=(13, 1, 12, 12)).astype(np.float32)
+    outs = dnet.forward_all(data=imgs)
+    print("forward_all over 13 samples:", outs["prob"].shape)
+    assert outs["prob"].shape == (13, 3)
+
+    # --- saliency via ranged backward (the DeepDream pattern) -----------
+    dnet.blobs["data"].reshape(1, 1, 12, 12)
+    dnet.blobs["data"].data[...] = t.preprocess("data", img)
+    dnet.forward(end="score")
+    dnet.blobs["score"].diff[...] = np.eye(3, dtype=np.float32)[0]
+    sal = dnet.backward(start="score")["data"]
+    print("saliency |grad| for class 0:", round(float(np.abs(sal).sum()), 4))
+    assert sal.shape == (1, 1, 12, 12) and np.any(sal != 0)
     print("OK")
 
 
