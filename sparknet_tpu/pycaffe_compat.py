@@ -144,6 +144,8 @@ def __getattr__(name: str):
     if name == "io":
         from . import pycaffe_io
         return pycaffe_io
+    if name == "proto":
+        return _proto_module()
     if name in ("layers", "params", "NetSpec", "net_spec", "to_proto"):
         from . import pycaffe_netspec
         if name == "net_spec":
@@ -868,10 +870,28 @@ SGDSolver = NesterovSolver = AdaGradSolver = RMSPropSolver = \
     AdaDeltaSolver = AdamSolver = get_solver
 
 
+def _proto_module():
+    """The ONE ``caffe.proto`` module object (caffe_pb2 inside),
+    registered in sys.modules so the canonical import line
+    ``from caffe.proto import caffe_pb2`` resolves."""
+    import types
+
+    from . import pycaffe_pb2
+    mod = sys.modules.get("caffe.proto")
+    if mod is None:
+        mod = types.ModuleType("caffe.proto")
+        mod.caffe_pb2 = pycaffe_pb2
+        sys.modules["caffe.proto"] = mod
+        sys.modules["caffe.proto.caffe_pb2"] = pycaffe_pb2
+    return mod
+
+
 def install() -> None:
     """Make ``import caffe`` resolve to this shim if no real pycaffe is
     installed.  Idempotent; never shadows an importable real caffe."""
     if "caffe" in sys.modules:
+        if sys.modules["caffe"] is sys.modules[__name__]:
+            _proto_module()  # ensure submodule imports resolve
         return
     try:
         import importlib.util
@@ -880,3 +900,4 @@ def install() -> None:
     except (ImportError, ValueError):
         pass
     sys.modules["caffe"] = sys.modules[__name__]
+    _proto_module()

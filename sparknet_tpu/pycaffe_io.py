@@ -14,7 +14,104 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["load_image", "resize_image", "oversample", "Transformer"]
+__all__ = ["load_image", "resize_image", "oversample", "Transformer",
+           "blobproto_to_array", "array_to_blobproto",
+           "arraylist_to_blobprotovecor_str",
+           "blobprotovector_str_to_arraylist",
+           "array_to_datum", "datum_to_array"]
+
+
+# -- proto <-> array converters (reference: io.py:18-95) ---------------------
+
+def _pmsg_of(msg):
+    """Accept a caffe_pb2-shim Message or a raw PMessage."""
+    return getattr(msg, "_p", msg)
+
+
+def blobproto_to_array(blob, return_diff: bool = False) -> np.ndarray:
+    """BlobProto -> ndarray shaped by ``shape`` or the legacy
+    num/channels/height/width dims; ``return_diff`` reads the diff
+    channel (io.py blobproto_to_array — the mean-file loading idiom)."""
+    from .proto.caffe_pb import blob_to_array
+    from .proto.textformat import PMessage
+    pm = _pmsg_of(blob)
+    if not return_diff:
+        return blob_to_array(pm)
+    m = PMessage()  # same shape fields, diff presented as data
+    for k, v in pm.items():
+        if k in ("data", "double_data"):
+            continue
+        key = {"diff": "data", "double_diff": "double_data"}.get(k, k)
+        m.add(key, v)
+    return blob_to_array(m)
+
+
+def array_to_blobproto(arr, diff=None):
+    """ndarray -> BlobProto message (new-style shape + packed data;
+    io.py array_to_blobproto)."""
+    from .proto.caffemodel import array_to_blob
+    from .pycaffe_pb2 import _class_for
+    pm = array_to_blob(np.asarray(arr, np.float32))
+    if diff is not None:
+        pm.set("diff", np.asarray(diff, np.float32).reshape(-1))
+    return _class_for("BlobProto")(pm)
+
+
+def arraylist_to_blobprotovecor_str(arraylist) -> bytes:
+    """[arrays] -> serialized BlobProtoVector (io.py's name, typo and
+    all — the compatibility contract)."""
+    from .proto.caffemodel import array_to_blob
+    from .proto.textformat import PMessage
+    from .proto.wireformat import encode
+    vec = PMessage()
+    for arr in arraylist:
+        vec.add("blobs", array_to_blob(np.asarray(arr, np.float32)))
+    return encode(vec, "BlobProtoVector")
+
+
+def blobprotovector_str_to_arraylist(s: bytes) -> list:
+    """Serialized BlobProtoVector -> [arrays] (io.py)."""
+    from .proto.caffe_pb import blob_to_array
+    from .proto.wireformat import decode
+    vec = decode(s, "BlobProtoVector")
+    return [blob_to_array(b) for b in vec.get_all("blobs")]
+
+
+def array_to_datum(arr: np.ndarray, label=None):
+    """(C, H, W) array -> Datum message: uint8 data goes in the byte
+    string, anything else in float_data (io.py array_to_datum; LMDB
+    builders write datum.SerializeToString())."""
+    from .proto.textformat import PMessage
+    from .pycaffe_pb2 import _class_for
+    arr = np.asarray(arr)
+    if arr.ndim != 3:
+        raise ValueError("Incorrect array shape.")
+    m = PMessage()
+    c, h, w = arr.shape
+    m.set("channels", int(c))
+    m.set("height", int(h))
+    m.set("width", int(w))
+    if arr.dtype == np.uint8:
+        m.set("data", arr.tobytes())
+    else:
+        for v in arr.astype(float).flat:
+            m.add("float_data", float(v))
+    if label is not None:
+        m.set("label", int(label))
+    return _class_for("Datum")(m)
+
+
+def datum_to_array(datum) -> np.ndarray:
+    """Datum message -> (C, H, W) array: byte data as uint8, else
+    float_data (io.py datum_to_array)."""
+    pm = _pmsg_of(datum)
+    shape = (int(pm.get("channels", 1)), int(pm.get("height", 1)),
+             int(pm.get("width", 1)))
+    data = pm.get("data")
+    if data:
+        return np.frombuffer(bytes(data), np.uint8).reshape(shape)
+    return np.asarray(pm.get_all("float_data"),
+                      np.float32).reshape(shape)
 
 
 def oversample(images, crop_dims) -> np.ndarray:
