@@ -36,7 +36,10 @@ def blobproto_to_array(blob, return_diff: bool = False) -> np.ndarray:
     from .proto.textformat import PMessage
     pm = _pmsg_of(blob)
     if not return_diff:
-        return blob_to_array(pm)
+        arr = blob_to_array(pm)
+        # wire-decoded chunks can be read-only frombuffer views; pycaffe
+        # scripts mutate the result in place
+        return arr if arr.flags.writeable else arr.copy()
     m = PMessage()  # same shape fields, diff presented as data
     for k, v in pm.items():
         if k in ("data", "double_data"):
@@ -109,7 +112,10 @@ def datum_to_array(datum) -> np.ndarray:
              int(pm.get("width", 1)))
     data = pm.get("data")
     if data:
-        return np.frombuffer(bytes(data), np.uint8).reshape(shape)
+        # copy: frombuffer over bytes is read-only, but scripts mutate
+        # the decoded image in place (reference fromstring copies)
+        return np.frombuffer(bytes(data),
+                             np.uint8).reshape(shape).copy()
     return np.asarray(pm.get_all("float_data"),
                       np.float32).reshape(shape)
 

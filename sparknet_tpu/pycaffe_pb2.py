@@ -116,7 +116,14 @@ class _RepeatedScalar:
             return vals
         if not vals:
             return np.zeros((0,), _PACKED_DTYPES[self._kind])
-        return np.concatenate([np.atleast_1d(np.asarray(v)) for v in vals])
+        if len(vals) == 1:  # the common case: no copy per read
+            return np.atleast_1d(np.asarray(vals[0]))
+        # consolidate storage so element-wise read loops stay linear
+        # (semantically neutral: readers concatenate chunks anyway)
+        flat = np.concatenate([np.atleast_1d(np.asarray(v)) for v in vals])
+        self._p.clear(self._name)
+        self._p.set(self._name, flat)
+        return flat
 
     def append(self, v) -> None:
         self._mutate()
@@ -176,7 +183,10 @@ class _RepeatedMessage:
     def extend(self, msgs) -> None:
         self._mutate()
         for m in msgs:
-            self._p.add(self._name, m._p)
+            # protobuf extend COPIES: later edits to the source must not
+            # reach into this container (wire round trip = deep copy)
+            self._p.add(self._name,
+                        decode(encode(m._p, self._type), self._type))
 
     def __len__(self) -> int:
         return len(self._p.get_all(self._name))
@@ -204,6 +214,7 @@ class Message:
         object.__setattr__(self, "_p", pmsg if pmsg is not None
                            else PMessage())
         object.__setattr__(self, "_on_mutate", _on_mutate)
+        object.__setattr__(self, "_viv", {})  # vivified children by field
 
     def _mutate(self) -> None:
         cb = self._on_mutate
@@ -247,13 +258,20 @@ class Message:
             sub = self._p.get(name)
             if sub is None:
                 # auto-vivify DETACHED (blob.shape.dim.extend(...)):
-                # attach to self only when the child first mutates
+                # attach to self only when the child first mutates.  The
+                # wrapper is cached so repeated reads of the same unset
+                # field share ONE child, as protobuf does.
+                cached = self._viv.get(name)
+                if cached is not None:
+                    return cached
                 sub_p = PMessage()
 
                 def attach(parent=self, nm=name, sp=sub_p):
                     parent._mutate()
                     parent._p.set(nm, sp)
-                return _class_for(sub_type)(sub_p, _on_mutate=attach)
+                child = _class_for(sub_type)(sub_p, _on_mutate=attach)
+                self._viv[name] = child
+                return child
             return _class_for(sub_type)(sub, _on_mutate=self._mutate)
         if repeated or kind in _PACKED_KINDS:
             return _RepeatedScalar(self._p, name, kind,
@@ -277,14 +295,22 @@ class Message:
                 f".extend()/.append()/.add() or CopyFrom")
         self._mutate()
         if kind.startswith("enum:"):
-            # store the identifier string (the PMessage convention the
-            # text/wire codecs share); accept int or identifier
+            # store an EnumToken identifier (bare in prototxt text, the
+            # convention the text/wire codecs share); accept int or a
+            # VALID identifier
+            from .proto.textformat import EnumToken
             table = ENUMS[kind[5:]]
-            if not isinstance(value, str):
+            if isinstance(value, str):
+                if value not in _ENUM_REV[kind[5:]]:
+                    raise ValueError(
+                        f"{self.TYPE}.{name}: unknown enum identifier "
+                        f"{value!r} (one of {sorted(table.values())})")
+            else:
                 if int(value) not in table:
                     raise ValueError(
                         f"{self.TYPE}.{name}: no enum value {value!r}")
                 value = table[int(value)]
+            value = EnumToken(value)
         self._p.set(name, value)
 
     def HasField(self, name: str) -> bool:

@@ -1072,3 +1072,71 @@ def test_caffe_pb2_protobuf_semantics():
     assert dt < 5.0, f"element-wise append took {dt:.1f}s"
     assert len(big.data) == 20000
     assert float(big.data[19999]) == 19999.0
+
+
+def test_caffe_draw_api(tmp_path):
+    """caffe.draw.draw_net / draw_net_to_file (draw.py:180-208): accepts
+    a caffe_pb2 NetParameter message, emits Graphviz source."""
+    npm = caffe.proto.caffe_pb2.NetParameter()
+    npm.ParseFromString(b"")  # start empty
+    npm.name = "drawn"
+    lp = npm.layer.add()
+    lp.name = "ip"; lp.type = "InnerProduct"
+    lp.bottom.append("data"); lp.top.append("ip")
+    src = caffe.draw.draw_net(npm, "LR", ext="dot").decode()
+    assert "digraph" in src and "InnerProduct" in src
+    out = tmp_path / "net.dot"
+    caffe.draw.draw_net_to_file(npm, str(out))
+    assert "digraph" in out.read_text()
+    import shutil
+    if shutil.which("dot") is None:
+        with pytest.raises(RuntimeError, match="graphviz"):
+            caffe.draw.draw_net(npm, "LR", ext="png")
+
+
+def test_caffe_pb2_review_semantics(tmp_path):
+    """Round-2 review pins: bare enum tokens in text output, shared
+    vivified children, copying extend, writable converter outputs,
+    extensionless draw filenames."""
+    pb2 = caffe.proto.caffe_pb2
+    ns = pb2.NetState()
+    ns.phase = pb2.TEST
+    assert 'phase: TEST' in str(ns)          # bare token, valid prototxt
+    assert '"TEST"' not in str(ns)
+    with pytest.raises(ValueError, match="unknown enum identifier"):
+        ns.phase = "BOGUS"
+
+    # two reads of an unset singular field share ONE child
+    npm = pb2.NetParameter()
+    s1, s2 = npm.state, npm.state
+    s1.stage.append("a")
+    s2.stage.append("b")
+    assert list(npm.state.stage) == ["a", "b"]
+
+    # extend copies: editing the source later must not reach the vector
+    vec = pb2.BlobProtoVector()
+    b = pb2.BlobProto()
+    b.data.extend([1.0])
+    vec.blobs.extend([b])
+    b.data.append(2.0)
+    assert len(vec.blobs[0].data) == 1
+
+    # converter outputs are writable (scripts subtract means in place)
+    d = caffe.io.array_to_datum(
+        np.zeros((1, 2, 2), np.uint8), label=0)
+    d2 = pb2.Datum(); d2.ParseFromString(d.SerializeToString())
+    arr = caffe.io.datum_to_array(d2)
+    arr += 1  # must not raise
+    blob = pb2.BlobProto()
+    blob.ParseFromString(
+        caffe.io.array_to_blobproto(np.ones((2, 2))).SerializeToString())
+    arr2 = caffe.io.blobproto_to_array(blob)
+    arr2 *= 2  # must not raise
+
+    # extensionless filename defaults to dot source
+    npm2 = pb2.NetParameter()
+    lp = npm2.layer.add(); lp.name = "ip"; lp.type = "InnerProduct"
+    lp.bottom.append("x"); lp.top.append("y")
+    out = tmp_path / "run.1"; out.mkdir()
+    caffe.draw.draw_net_to_file(npm2, str(out / "net"))
+    assert "digraph" in (out / "net").read_text()
