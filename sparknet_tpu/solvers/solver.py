@@ -256,6 +256,10 @@ class Solver:
                 continue  # this net has no feed; skip rather than raise
             ti = (self._test_iter_for(n) if self.sp.test_iter
                   else default_iter)
+            # the reference's marker line (solver.cpp Test: "Iteration
+            # %d, Testing net (#%d)") — log parsers key test scores to
+            # the iteration by it, incl. the pre-training pass on resume
+            print(f"Iteration {self.iter}, Testing net (#{n})")
             tag = f" #{n}" if multi else ""
             for k, v in self.test(ti, net_id=n).items():
                 arr = np.asarray(v, np.float64) / ti

@@ -1,0 +1,94 @@
+"""parse_log — split a training log into train/test CSVs (reference:
+caffe/tools/extra/parse_log.py, which greps glog output for
+"Iteration N, loss" and "Test net output" lines; this framework's
+Solver prints the same shapes — solver.py step/solve/_print_test_scores).
+
+Usage:
+  python -m sparknet_tpu.tools.parse_log LOGFILE [OUT_DIR]
+
+Writes LOGFILE.train (NumIters,loss) and LOGFILE.test
+(NumIters,TestNet,<output columns>) into OUT_DIR (default: the log's
+directory), mirroring the reference's <log>.train/<log>.test CSVs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import re
+
+_FLOAT = r"([-+]?(?:[0-9][0-9.]*(?:[eE][-+]?\d+)?|nan|inf))"
+_ITER_RE = re.compile(r"Iteration (\d+), loss = " + _FLOAT)
+_TESTING_RE = re.compile(r"Iteration (\d+), Testing net \(#(\d+)\)")
+_TEST_RE = re.compile(
+    r"Test net(?: #(\d+))? output: (\S+?)(?:\[(\d+)\])? = " + _FLOAT)
+
+
+def parse_log(path: str):
+    """-> (train_rows, test_rows): train [(iter, loss)], test
+    {(iter, net_id): {column: value}} in encounter order."""
+    train: list[tuple[int, float]] = []
+    test: dict[tuple[int, int], dict[str, float]] = {}
+    cur_iter = 0
+    cur_test_net = 0
+    with open(path) as f:
+        for line in f:
+            m = _ITER_RE.search(line)
+            if m:
+                cur_iter = int(m.group(1))
+                train.append((cur_iter, float(m.group(2))))
+                continue
+            m = _TESTING_RE.search(line)
+            if m:  # the authoritative iteration for following scores —
+                #    covers the pre-training pass on resume, where no
+                #    "Iteration N, loss" line has printed yet
+                cur_iter = int(m.group(1))
+                cur_test_net = int(m.group(2))
+                continue
+            m = _TEST_RE.search(line)
+            if m:
+                net_id = int(m.group(1) or cur_test_net)
+                col = m.group(2)
+                if m.group(3) is not None:  # indexed per-class outputs
+                    col = f"{col}[{m.group(3)}]"
+                test.setdefault((cur_iter, net_id), {})[col] = \
+                    float(m.group(4))
+    return train, test
+
+
+def write_csvs(path: str, out_dir: str | None = None) -> tuple[str, str]:
+    train, test = parse_log(path)
+    out_dir = out_dir or (os.path.dirname(os.path.abspath(path)))
+    base = os.path.join(out_dir, os.path.basename(path))
+    train_path, test_path = base + ".train", base + ".test"
+    with open(train_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["NumIters", "loss"])
+        w.writerows(train)
+    cols: list[str] = []
+    for row in test.values():
+        for k in row:
+            if k not in cols:
+                cols.append(k)
+    with open(test_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["NumIters", "TestNet"] + cols)
+        for (it, net_id), row in test.items():
+            w.writerow([it, net_id] + [row.get(c, "") for c in cols])
+    return train_path, test_path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("out_dir", nargs="?", default=None)
+    args = ap.parse_args(argv)
+    train_path, test_path = write_csvs(args.logfile, args.out_dir)
+    print(train_path)
+    print(test_path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

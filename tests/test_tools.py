@@ -427,3 +427,73 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
     scores = solver.test(1)
     assert "loss" in scores
     assert solver.test_net.blob_shapes["data"] == (2, 3)
+
+
+def test_parse_log_roundtrip(tmp_path, capsys):
+    """parse_log (tools/extra/parse_log.py analog) splits a real solve()
+    log into train/test CSVs."""
+    import contextlib
+    import csv
+    import io as _io
+
+    from sparknet_tpu.proto import load_solver_prototxt_with_net, \
+        load_net_prototxt
+    from sparknet_tpu.solvers import Solver
+    from sparknet_tpu.tools.parse_log import parse_log, write_csvs
+
+    netp = load_net_prototxt("""
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 4 dim: 3 } shape { dim: 4 }
+    data_filler { type: "gaussian" std: 1.0 }
+    data_filler { type: "constant" value: 1.0 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+layer { name: "acc" type: "Accuracy" bottom: "ip" bottom: "label"
+  top: "accuracy" include { phase: TEST } }
+""")
+    sp = load_solver_prototxt_with_net(
+        "base_lr: 0.1\nmax_iter: 6\ndisplay: 2\ntest_interval: 3\n"
+        "test_iter: 2\ntest_initialization: true\n", netp)
+    solver = Solver(sp, seed=0)
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        solver.solve()
+    log = tmp_path / "train.log"
+    log.write_text(buf.getvalue())
+
+    train, test = parse_log(str(log))
+    iters = [it for it, _ in train]
+    assert 6 in iters and all(np.isfinite(l) for _, l in train)
+    assert (0, 0) in test          # test_initialization pass at iter 0
+    assert any(it == 6 for it, _ in test)  # final pass
+    assert all("accuracy" in row and "loss" in row
+               for row in test.values())
+
+    tr_path, te_path = write_csvs(str(log), str(tmp_path))
+    rows = list(csv.reader(open(tr_path)))
+    assert rows[0] == ["NumIters", "loss"] and len(rows) > 1
+    te_rows = list(csv.reader(open(te_path)))
+    assert te_rows[0][:2] == ["NumIters", "TestNet"]
+    assert "accuracy" in te_rows[0]
+
+
+def test_parse_log_resume_and_inf(tmp_path):
+    """Scores printed by a pre-training test pass on RESUME key to the
+    solver's iteration (via the 'Testing net' marker), and inf/nan
+    losses parse instead of crashing."""
+    from sparknet_tpu.tools.parse_log import parse_log
+
+    log = tmp_path / "resume.log"
+    log.write_text(
+        "Iteration 300, Testing net (#0)\n"
+        "    Test net output: accuracy = 0.75\n"
+        "Iteration 302, loss = -inf\n"
+        "Iteration 304, loss = nan\n"
+        "Iteration 304, Testing net (#1)\n"
+        "    Test net output: loss = 1e+30\n")
+    train, test = parse_log(str(log))
+    assert train[0] == (302, float("-inf"))
+    assert np.isnan(train[1][1])
+    assert test[(300, 0)]["accuracy"] == 0.75
+    assert test[(304, 1)]["loss"] == 1e30
