@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-4 TPU capture runbook — run when the axon tunnel is back.
+# Each step is independently resumable; logs under .tpu_runbook_logs/.
+set -x
+cd "$(dirname "$0")"
+mkdir -p .tpu_runbook_logs profiles
+
+# 0. sanity probe (fail fast if tunnel died again)
+timeout 120 python -c "import jax; print(jax.devices())" \
+    > .tpu_runbook_logs/probe.log 2>&1 || exit 7
+
+# 1. headline bench (hardened path; persists .bench_last_good.json)
+timeout 2400 python bench.py \
+    > .tpu_runbook_logs/bench.json 2> .tpu_runbook_logs/bench.log
+
+# 2. GoogLeNet per-layer profile regen (VERDICT #2)
+timeout 1800 python tools/profile_step.py --model googlenet --batch 128 \
+    --dtype bf16 --out profiles/googlenet_bf16 \
+    > .tpu_runbook_logs/profile_googlenet.log 2>&1
+
+# 3. time_net --trace TPU validation (VERDICT #2)
+timeout 1200 python -m sparknet_tpu.tools.time_net --model googlenet \
+    --batch 128 --iterations 4 --trace \
+    > .tpu_runbook_logs/time_net_trace.log 2>&1
+
+# 4. maxpool backward microbench: s&s vs Pallas VMEM kernel (VERDICT #6)
+timeout 3600 env PROBE_DTYPE=bf16 PROBE_POOL_BATCH=128 \
+    python tools/perf_probe.py poolbwd \
+    > .tpu_runbook_logs/poolbwd.json 2> .tpu_runbook_logs/poolbwd.log
+
+# 5. non-degenerate feed-overlap regime (VERDICT #3): small batches,
+#    per-step dispatch; record several batch sizes
+for fb in 2 4 8 16; do
+  timeout 1200 env BENCH_DTYPE=bf16 BENCH_SCAN=0 BENCH_REPS=2 \
+      BENCH_WINDOWS=2 BENCH_FEED_BATCH=$fb BENCH_FEED_ITERS=10 \
+      BENCH_ATTEMPTS=2 python bench.py \
+      > .tpu_runbook_logs/feed_b$fb.json 2> .tpu_runbook_logs/feed_b$fb.log
+done
+
+echo DONE
