@@ -1,0 +1,92 @@
+"""plot_training_log — chart a training log (reference:
+caffe/tools/extra/plot_training_log.py.example).
+
+Chart types follow the reference numbering; this framework's logs carry
+iterations but not wall-clock timestamps or per-iter learning rates, so
+the Seconds/LearningRate variants (1, 3, 4, 5, 7) raise with a clear
+message rather than plotting wrong axes.
+
+  0: Test accuracy  vs. Iters        2: Test loss  vs. Iters
+  6: Train loss     vs. Iters
+
+Usage:
+  python -m sparknet_tpu.tools.plot_training_log CHART_TYPE OUT.png \
+      LOG [LOG ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+_SUPPORTED = {
+    0: ("Test accuracy vs. Iters", "accuracy", "test"),
+    2: ("Test loss vs. Iters", "loss", "test"),
+    6: ("Train loss vs. Iters", "loss", "train"),
+}
+_UNSUPPORTED = {
+    1: "Seconds axes need glog timestamps this framework does not emit",
+    3: "Seconds axes need glog timestamps this framework does not emit",
+    4: "learning rate is not logged per iteration here",
+    5: "learning rate is not logged per iteration here",
+    7: "Seconds axes need glog timestamps this framework does not emit",
+}
+
+
+def _series(path: str, field: str, which: str):
+    from .parse_log import parse_log
+    train, test = parse_log(path)
+    if which == "train":
+        return [it for it, _ in train], [loss for _, loss in train]
+    xs, ys = [], []
+    for (it, _net), row in sorted(test.items()):
+        if field in row:
+            xs.append(it)
+            ys.append(row[field])
+    return xs, ys
+
+
+def plot(chart_type: int, out_path: str, logs: list[str]) -> None:
+    if chart_type in _UNSUPPORTED:
+        raise ValueError(
+            f"chart type {chart_type} unsupported: "
+            f"{_UNSUPPORTED[chart_type]} (supported: {sorted(_SUPPORTED)})")
+    if chart_type not in _SUPPORTED:
+        raise ValueError(
+            f"unknown chart type {chart_type} "
+            f"(supported: {sorted(_SUPPORTED)})")
+    title, field, which = _SUPPORTED[chart_type]
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for path in logs:
+        xs, ys = _series(path, field, which)
+        if not xs:
+            raise ValueError(f"{path}: no {which} '{field}' entries found")
+        ax.plot(xs, ys, marker=".", linewidth=1,
+                label=os.path.basename(path))
+    ax.set_xlabel("Iters")
+    ax.set_ylabel(title.split(" vs.")[0])
+    ax.set_title(title)
+    ax.legend(loc="best")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("chart_type", type=int)
+    ap.add_argument("out_path")
+    ap.add_argument("logs", nargs="+")
+    args = ap.parse_args(argv)
+    plot(args.chart_type, args.out_path, args.logs)
+    print(args.out_path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

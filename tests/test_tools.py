@@ -497,3 +497,28 @@ def test_parse_log_resume_and_inf(tmp_path):
     assert np.isnan(train[1][1])
     assert test[(300, 0)]["accuracy"] == 0.75
     assert test[(304, 1)]["loss"] == 1e30
+
+
+def test_plot_training_log(tmp_path):
+    """plot_training_log (tools/extra analog): charts parse_log output;
+    unsupported Seconds/lr chart types refuse clearly."""
+    from sparknet_tpu.tools.plot_training_log import main, plot
+
+    log = tmp_path / "t.log"
+    log.write_text(
+        "Iteration 0, Testing net (#0)\n"
+        "    Test net output: accuracy = 0.1\n"
+        "    Test net output: loss = 2.3\n"
+        "Iteration 2, loss = 2.0\n"
+        "Iteration 4, loss = 1.5\n"
+        "Iteration 4, Testing net (#0)\n"
+        "    Test net output: accuracy = 0.6\n"
+        "    Test net output: loss = 1.4\n")
+    for ct, name in ((0, "acc.png"), (2, "tloss.png"), (6, "loss.png")):
+        out = tmp_path / name
+        assert main([str(ct), str(out), str(log)]) == 0
+        assert out.stat().st_size > 1000  # a real png
+    with pytest.raises(ValueError, match="unsupported"):
+        plot(1, str(tmp_path / "x.png"), [str(log)])
+    with pytest.raises(ValueError, match="unknown chart type"):
+        plot(9, str(tmp_path / "x.png"), [str(log)])
