@@ -45,7 +45,8 @@ class Classifier:
                  image_dims: tuple[int, int] | None = None,
                  mean: np.ndarray | float | None = None,
                  input_scale: float | None = None,
-                 raw_scale: float | None = None):
+                 raw_scale: float | None = None,
+                 channel_swap=None):
         import jax
 
         from .graph import Net
@@ -66,6 +67,10 @@ class Classifier:
         self.mean = mean
         self.input_scale = input_scale
         self.raw_scale = raw_scale
+        # channel permutation applied after HWC->CHW, before raw_scale —
+        # classifier.py's RGB->BGR default path (Transformer
+        # set_channel_swap ordering)
+        self.channel_swap = tuple(channel_swap) if channel_swap else None
         self._fwd = jax.jit(
             lambda p, x: self.net.apply(p, {self.input_name: x},
                                         train=False).blobs)
@@ -80,6 +85,9 @@ class Classifier:
             arr = arr[None]
         elif arr.ndim == 3 and arr.shape[0] not in (1, 3):
             arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+        if self.channel_swap is not None and \
+                arr.shape[0] == len(self.channel_swap):
+            arr = arr[list(self.channel_swap)]
         if self.raw_scale is not None:
             arr = arr * self.raw_scale
         h, w = self.image_dims
@@ -126,9 +134,11 @@ class Detector(Classifier):
                  mean: np.ndarray | float | None = None,
                  input_scale: float | None = None,
                  raw_scale: float | None = None,
+                 channel_swap=None,
                  context_pad: int = 0):
         super().__init__(model_file, pretrained_file, mean=mean,
-                         input_scale=input_scale, raw_scale=raw_scale)
+                         input_scale=input_scale, raw_scale=raw_scale,
+                         channel_swap=channel_swap)
         self.context_pad = context_pad
 
     def detect_windows(self, images_windows: Sequence[tuple[np.ndarray,
@@ -144,6 +154,9 @@ class Detector(Classifier):
                 arr = arr[None]
             elif arr.ndim == 3 and arr.shape[0] not in (1, 3):
                 arr = arr.transpose(2, 0, 1)
+            if self.channel_swap is not None and \
+                    arr.shape[0] == len(self.channel_swap):
+                arr = arr[list(self.channel_swap)]
             if self.raw_scale is not None:
                 arr = arr * self.raw_scale
             for (y1, x1, y2, x2) in windows:

@@ -340,6 +340,11 @@ def _crop_warp_window(img: np.ndarray, x1: int, y1: int, x2: int, y2: int,
     pad_w = pad_h = 0
     crop_w = crop_h = crop
     if context_pad > 0 or use_square:
+        if 2 * context_pad >= crop:
+            raise ValueError(
+                f"context_pad {context_pad} must be less than half the "
+                f"net input size {crop} (window_data_layer.cpp context "
+                f"scale would invert)")
         context_scale = crop / (crop - 2.0 * context_pad)
         half_h = (y2 - y1 + 1) / 2.0
         half_w = (x2 - x1 + 1) / 2.0

@@ -34,16 +34,22 @@ _UNSUPPORTED = {
 
 
 def _series(path: str, field: str, which: str):
+    """-> {label_suffix: (xs, ys)} — one series per test net, so
+    multi-test-net logs don't interleave into a zigzag."""
     from .parse_log import parse_log
     train, test = parse_log(path)
     if which == "train":
-        return [it for it, _ in train], [loss for _, loss in train]
-    xs, ys = [], []
-    for (it, _net), row in sorted(test.items()):
+        return {"": ([it for it, _ in train],
+                     [loss for _, loss in train])}
+    by_net: dict[int, tuple[list, list]] = {}
+    for (it, net), row in sorted(test.items()):
         if field in row:
+            xs, ys = by_net.setdefault(net, ([], []))
             xs.append(it)
             ys.append(row[field])
-    return xs, ys
+    multi = len(by_net) > 1
+    return {(f" (test net #{n})" if multi else ""): s
+            for n, s in sorted(by_net.items())}
 
 
 def plot(chart_type: int, out_path: str, logs: list[str]) -> None:
@@ -63,11 +69,12 @@ def plot(chart_type: int, out_path: str, logs: list[str]) -> None:
 
     fig, ax = plt.subplots(figsize=(8, 5))
     for path in logs:
-        xs, ys = _series(path, field, which)
-        if not xs:
+        series = _series(path, field, which)
+        if not any(xs for xs, _ in series.values()):
             raise ValueError(f"{path}: no {which} '{field}' entries found")
-        ax.plot(xs, ys, marker=".", linewidth=1,
-                label=os.path.basename(path))
+        for suffix, (xs, ys) in series.items():
+            ax.plot(xs, ys, marker=".", linewidth=1,
+                    label=os.path.basename(path) + suffix)
     ax.set_xlabel("Iters")
     ax.set_ylabel(title.split(" vs.")[0])
     ax.set_title(title)
