@@ -158,10 +158,13 @@ def load_image(filename: str, color: bool = True) -> np.ndarray:
 
 def resize_image(im: np.ndarray, new_dims, interp_order: int = 1) -> np.ndarray:
     """Resize HxWxC float image to ``new_dims`` (H, W) — io.py
-    resize_image (bilinear by default)."""
+    resize_image.  ``interp_order`` follows the reference's skimage
+    spline orders: 0 nearest, 1 bilinear (default), >=2 bicubic."""
     from PIL import Image
     h, w = int(new_dims[0]), int(new_dims[1])
-    resample = Image.NEAREST if interp_order == 0 else Image.BILINEAR
+    resample = (Image.NEAREST if interp_order == 0
+                else Image.BILINEAR if interp_order == 1
+                else Image.BICUBIC)
     chans = []
     for c in range(im.shape[2]):
         ch = Image.fromarray(im[:, :, c].astype(np.float32), mode="F")

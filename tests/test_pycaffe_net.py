@@ -1140,3 +1140,18 @@ def test_caffe_pb2_review_semantics(tmp_path):
     out = tmp_path / "run.1"; out.mkdir()
     caffe.draw.draw_net_to_file(npm2, str(out / "net"))
     assert "digraph" in (out / "net").read_text()
+
+
+def test_io_resize_image_interp_orders():
+    """interp_order maps to nearest/bilinear/bicubic like the
+    reference's skimage spline orders."""
+    rng = np.random.default_rng(14)
+    img = rng.uniform(size=(6, 6, 3)).astype(np.float32)
+    out0 = caffe.io.resize_image(img, (12, 12), interp_order=0)
+    out1 = caffe.io.resize_image(img, (12, 12), interp_order=1)
+    out3 = caffe.io.resize_image(img, (12, 12), interp_order=3)
+    assert out0.shape == out1.shape == out3.shape == (12, 12, 3)
+    # nearest preserves the value set exactly; the others interpolate
+    assert set(np.unique(out0)) <= set(np.unique(img))
+    assert not np.array_equal(out1, out0)
+    assert not np.array_equal(out3, out1)
