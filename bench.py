@@ -30,7 +30,9 @@ timing spans first dispatch to final block_until_ready.
 
 Env knobs (for smoke-testing): BENCH_PLATFORM=cpu, BENCH_MODEL=lenet,
 BENCH_BATCH, BENCH_ITERS, BENCH_REPS, BENCH_TIMEOUT_S, BENCH_ATTEMPTS,
-BENCH_DTYPE=f32|bf16 (restrict to one compute dtype).
+BENCH_DTYPE=f32|bf16 (restrict to one compute dtype); feed tier:
+BENCH_FEED_BATCH, BENCH_FEED_ITERS, BENCH_FEED_DELAY_S (per-batch host
+decode stand-in, see measure_feed).
 """
 
 from __future__ import annotations
@@ -207,12 +209,19 @@ def run_child() -> None:
         the batch (default BATCH); on the tunneled rig a small batch
         puts feed and compute in the same order of magnitude (the
         non-degenerate regime — at batch 256 the ~6 MB/s tunnel makes
-        feed 300x compute and the pipeline verdict is vacuous)."""
+        feed 300x compute and the pipeline verdict is vacuous).
+        BENCH_FEED_DELAY_S (default 0) adds a per-batch host delay to
+        the feed leg — a stand-in for decode/augment cost, paid by the
+        producer in BOTH the feed-alone leg and the in-loop source
+        iterator, so a rig whose raw transfer is near-free (CPU
+        platform) can still exercise and assert the non-degenerate
+        overlap regime deterministically."""
         import itertools
 
         from sparknet_tpu.data import device_feed
 
         fbatch = int(os.environ.get("BENCH_FEED_BATCH", BATCH))
+        fdelay = float(os.environ.get("BENCH_FEED_DELAY_S", 0))
         solver = Solver(sp, seed=0,
                         compute_dtype=jnp.bfloat16 if dtype == "bf16" else None)
         m = 4
@@ -235,19 +244,34 @@ def run_child() -> None:
         compute_s = (time.perf_counter() - t0) / feed_iters
         del dev
 
-        # feed-alone: host->HBM transfer time per batch with the transfers
+        # feed-alone: host work (BENCH_FEED_DELAY_S decode stand-in) +
+        # host->HBM transfer time per batch with the transfers
         # dispatched back-to-back (pipelined, like the prefetch thread
         # issues them) — a per-batch synchronous measure would overstate
         # the baseline and inflate the overlap figure
         t0 = time.perf_counter()
-        jax.block_until_ready([jax.device_put(hb) for hb in host])
+        staged = []
+        for hb in host:
+            if fdelay:
+                time.sleep(fdelay)
+            staged.append(jax.device_put(hb))
+        jax.block_until_ready(staged)
         feed_alone = (time.perf_counter() - t0) / m
+        del staged
+
+        def source():
+            # the producer (prefetch thread) pays the same per-batch
+            # host delay as the feed-alone leg
+            for hb in itertools.islice(itertools.cycle(host),
+                                       feed_iters + 4):
+                if fdelay:
+                    time.sleep(fdelay)
+                yield hb
 
         solver2 = Solver(sp, seed=0,
                          compute_dtype=jnp.bfloat16 if dtype == "bf16"
                          else None)
-        solver2.set_train_data(device_feed(iter(
-            itertools.islice(itertools.cycle(host), feed_iters + 4))))
+        solver2.set_train_data(device_feed(source()))
         solver2.step(2)  # warmup/compile
         t0 = time.perf_counter()
         solver2.step(feed_iters)
@@ -341,7 +365,8 @@ def _load_last_good() -> dict | None:
 # set is not comparable to the headline record
 _CONFIG_ENVS = ("BENCH_PLATFORM", "BENCH_MODEL", "BENCH_BATCH",
                 "BENCH_ITERS", "BENCH_REPS", "BENCH_WINDOWS",
-                "BENCH_DTYPE", "BENCH_SCAN")
+                "BENCH_DTYPE", "BENCH_SCAN", "BENCH_FEED_BATCH",
+                "BENCH_FEED_ITERS", "BENCH_FEED_DELAY_S")
 
 
 def _save_last_good(result: dict) -> None:
