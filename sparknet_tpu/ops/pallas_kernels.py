@@ -169,72 +169,156 @@ def _pool_taps(kh: int, kw: int):
 
 def _maxpool_bwd_kernel_s1(x_ref, dy_ref, dx_ref, *, kh, kw, ph, pw,
                            oh, ow, h, w):
-    """Stride-1 path: every tap is a contiguous static slice."""
+    """Stride-1 path: row taps are contiguous sublane slices; column
+    taps ride the MXU as exact one-hot matmuls.  Hard-won Mosaic
+    constraints (each crashes the compiler if violated): no lane-offset
+    pads of compare-derived values, compares in f32 (bf16 cmpf
+    miscompiles at 3-D shapes), and the padded plane widened to >=128
+    lanes (free — vregs are 128 lanes regardless; narrow matmul K-dims
+    crash at 7x7)."""
     x = x_ref[:]
     dy = dy_ref[:]
     c = x.shape[0]
-    hp, wp = oh + kh - 1, ow + kw - 1
-    neg = jnp.finfo(x.dtype).min
-    xp = jnp.full((c, hp, wp), neg, x.dtype)
-    xp = xp.at[:, ph:ph + h, pw:pw + w].set(x)
-    best = None
-    arg = None
-    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
-        v = xp[:, dh:dh + oh, dw:dw + ow]
-        if best is None:
-            best, arg = v, jnp.zeros(v.shape, jnp.int32)
-        else:
-            gt = v > best  # strict: ties keep the EARLIER tap
-            best = jnp.where(gt, v, best)
-            arg = jnp.where(gt, t, arg)
-    acc = jnp.zeros((c, hp, wp), jnp.float32)
+    hp = oh + kh - 1
+    wp = max(ow + kw - 1, 128)
+    # Sentinel must be exactly bf16-representable: the MXU's bf16-pass
+    # f32 matmul turns finfo(f32).min into -inf and the one-hot gather
+    # into NaN (inf*0), silently zeroing every f32-mode gradient.
+    # Domain restriction this buys: f32 activations below bf16 min
+    # (-3.3895e38) would lose the argmax to padding — next stop after
+    # that magnitude is inf, so no practical net is affected.
+    neg = jnp.asarray(jnp.finfo(jnp.bfloat16).min, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (ph, hp - h - ph), (pw, wp - w - pw)),
+                 constant_values=neg)
+    gathers = [_col_onehot(dw, 1, wp, ow, x.dtype) for dw in range(kw)]
+    # True-f32 nets need the exact multi-pass matmul: the default
+    # single bf16 pass rounds the gathered VALUES and corrupts argmax
+    # routing.  bf16 nets are single-pass-exact, and HIGHEST on bf16
+    # inputs crashes Mosaic — so pick per dtype.
+    prec = (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+    def window(dh, dw):
+        # f32 MXU accumulator doubles as the compare domain (exact —
+        # the matmul just selects single bf16 values).
+        slab = xp[:, dh:dh + oh, :]
+        return jax.lax.dot_general(
+            slab.reshape(c * oh, wp), gathers[dw], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec).reshape(c, oh, ow)
+
+    taps = _pool_taps(kh, kw)
+    wins = [window(dh, dw) for dh, dw in taps]  # one gather per tap
+    best = functools.reduce(jnp.maximum, wins)
+    # Route dy to the FIRST tap equal to the max (Caffe's row-major
+    # tie-break).  A boolean "claimed" plane replaces an int argmax
+    # plane: constant-init int planes get a replicated Mosaic layout
+    # that the mask relayout then rejects.
     dyf = dy.astype(jnp.float32)
-    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
-        acc = acc.at[:, dh:dh + oh, dw:dw + ow].add(
-            jnp.where(arg == t, dyf, 0.0))
+    scatters = [_col_onehot(dw, 1, wp, ow, jnp.float32) for dw in range(kw)]
+    acc = None
+    claimed = None
+    for (dh, dw), v in zip(taps, wins):
+        eq = v == best
+        m = eq if claimed is None else eq & ~claimed
+        claimed = eq if claimed is None else claimed | eq
+        cont = jnp.where(m, dyf, 0.0)
+        wide = jax.lax.dot_general(
+            cont.reshape(c * oh, ow), scatters[dw], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec).reshape(c, oh, wp)
+        part = jnp.pad(wide, ((0, 0), (dh, hp - oh - dh), (0, 0)))
+        acc = part if acc is None else acc + part
     dx_ref[:] = acc[:, ph:ph + h, pw:pw + w].astype(dx_ref.dtype)
+
+
+def _col_onehot(dw: int, sw: int, wp: int, ow: int, dtype):
+    """(wp, ow) selection matrix: column s picks padded-plane lane
+    dw + s*sw.  Lane-strided gather/placement isn't lowerable on the
+    VPU, so both directions ride the MXU as exact one-hot matmuls."""
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (wp, ow), 0)
+    coli = jax.lax.broadcasted_iota(jnp.int32, (wp, ow), 1)
+    return (rowi == dw + coli * sw).astype(dtype)
 
 
 def _maxpool_bwd_kernel_strided(x_ref, dy_ref, dx_ref, *, kh, kw, sh, sw,
                                 ph, pw, oh, ow, h, w):
-    """General strided path: the padded plane is viewed as
-    (c, rows, sh, cols, sw) so every tap becomes a unit-stride slice at a
-    fixed (dh%sh, dw%sw) phase."""
+    """General strided path.  Row stride is handled by splitting the
+    sublane dim into (rows, sh) phases (a reshape Mosaic supports);
+    column stride via one-hot selection matmuls (_col_onehot), since
+    lane-dim strided slices and interior pads don't lower."""
     x = x_ref[:]
     dy = dy_ref[:]
     c = x.shape[0]
     rows = (kh - 1) // sh + oh
-    cols = (kw - 1) // sw + ow
-    hp, wp = rows * sh, cols * sw
-    neg = jnp.finfo(x.dtype).min
-    xp = jnp.full((c, hp, wp), neg, x.dtype)
-    xp = xp.at[:, ph:ph + h, pw:pw + w].set(x)
-    x5 = xp.reshape(c, rows, sh, cols, sw)
-    best = None
-    arg = None
-    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
-        v = x5[:, dh // sh:dh // sh + oh, dh % sh,
-               dw // sw:dw // sw + ow, dw % sw]
-        if best is None:
-            best, arg = v, jnp.zeros(v.shape, jnp.int32)
-        else:
-            gt = v > best
-            best = jnp.where(gt, v, best)
-            arg = jnp.where(gt, t, arg)
-    acc = jnp.zeros((c, rows, sh, cols, sw), jnp.float32)
+    hp = rows * sh
+    # >=128-lane widening as in the stride-1 kernel: free (vregs are
+    # 128 lanes regardless) and keeps the matmul K-dim off the narrow
+    # sizes that crash Mosaic.  The w + pw floor covers stride > kernel
+    # under Caffe's ceil-mode clip, where (ow-1)*sw + kw can fall short
+    # of the input width and the pad amount would go negative.
+    wp = max((ow - 1) * sw + kw, w + pw, 128)
+    # bf16-representable sentinel — see the stride-1 kernel's comment.
+    neg = jnp.asarray(jnp.finfo(jnp.bfloat16).min, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (ph, hp - h - ph), (pw, wp - w - pw)),
+                 constant_values=neg)
+    x4 = xp.reshape(c, rows, sh, wp)
+    taps = _pool_taps(kh, kw)
+    gathers = [_col_onehot(dw, sw, wp, ow, x.dtype) for dw in range(kw)]
+    # True-f32 nets need the exact multi-pass matmul: the default
+    # single bf16 pass rounds the gathered VALUES and corrupts argmax
+    # routing.  bf16 nets are single-pass-exact, and HIGHEST on bf16
+    # inputs crashes Mosaic — so pick per dtype.
+    prec = (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+    def window(dh, dw):
+        # One-hot MXU gather; keep the mandatory 32-bit accumulator as
+        # the compare domain too (bf16 cmpf crashes Mosaic; exact both
+        # ways since the matmul just selects single values).
+        slab = x4[:, dh // sh:dh // sh + oh, dh % sh, :]
+        return jax.lax.dot_general(
+            slab.reshape(c * oh, wp), gathers[dw], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec).reshape(c, oh, ow)
+
+    wins = [window(dh, dw) for dh, dw in taps]  # one gather per tap
+    best = functools.reduce(jnp.maximum, wins)
+    # First-equal-claims routing (see the stride-1 kernel's comment).
     dyf = dy.astype(jnp.float32)
-    for t, (dh, dw) in enumerate(_pool_taps(kh, kw)):
-        acc = acc.at[:, dh // sh:dh // sh + oh, dh % sh,
-                     dw // sw:dw // sw + ow, dw % sw].add(
-            jnp.where(arg == t, dyf, 0.0))
-    dx_ref[:] = acc.reshape(c, hp, wp)[:, ph:ph + h,
-                                       pw:pw + w].astype(dx_ref.dtype)
+    scatters = [_col_onehot(dw, sw, wp, ow, jnp.float32) for dw in range(kw)]
+    phase_acc = [None] * sh
+    claimed = None
+    for (dh, dw), v in zip(taps, wins):
+        eq = v == best
+        m = eq if claimed is None else eq & ~claimed
+        claimed = eq if claimed is None else claimed | eq
+        cont = jnp.where(m, dyf, 0.0)
+        wide = jax.lax.dot_general(
+            cont.reshape(c * oh, ow), scatters[dw], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec).reshape(c, oh, wp)
+        q = dh // sh
+        part = jnp.pad(wide, ((0, 0), (q, rows - oh - q), (0, 0)))
+        p = dh % sh
+        phase_acc[p] = part if phase_acc[p] is None else phase_acc[p] + part
+    phase_acc = [a if a is not None else jnp.zeros((c, rows, wp), jnp.float32)
+                 for a in phase_acc]  # kh < sh leaves untouched phases
+    acc = jnp.stack(phase_acc, axis=2).reshape(c, hp, wp)
+    dx_ref[:] = acc[:, ph:ph + h, pw:pw + w].astype(dx_ref.dtype)
 
 
-def _pool_ctile(c: int, h: int, w: int, itemsize: int) -> int:
-    """Channels per block: ~2 MB VMEM across the ~6 resident planes."""
-    per_c = max(h * w * itemsize * 6, 1)
-    t = max(1, min(c, (2 << 20) // per_c))
+def _pool_ctile(c: int, h: int, w: int, kh: int, kw: int) -> int:
+    """Channels per block, capped at 8 — larger channel tiles crash
+    Mosaic on these kernels (empirical: ct=24 dies after 130 s of
+    compile, ct<=8 compiles in seconds; the grid pipelines the extra
+    steps, so small tiles cost nothing measurable).  The VMEM model:
+    kh*kw live f32 window planes (the ``wins`` list) plus ~5 padded
+    >=128-lane input/acc/mask planes, kept under a conservative 64 MB
+    so the ct<=8 Mosaic cap — not memory — binds for every zoo pool
+    shape (~2 MB at ct=8 for 3x3 pools)."""
+    per_c = max(h * max(w, 128) * 4 * (kh * kw + 5), 1)
+    t = max(1, min(c, 8, (64 << 20) // per_c))
     while c % t:
         t -= 1
     return t
@@ -242,7 +326,7 @@ def _pool_ctile(c: int, h: int, w: int, itemsize: int) -> int:
 
 def _maxpool_bwd_call(x, dy, kh, kw, sh, sw, ph, pw, oh, ow):
     n, c, h, w = x.shape
-    ct = _pool_ctile(c, h, w, x.dtype.itemsize)
+    ct = _pool_ctile(c, h, w, kh, kw)
     grid = (n, c // ct)
     kern = (_maxpool_bwd_kernel_s1 if sh == 1 and sw == 1 else
             functools.partial(_maxpool_bwd_kernel_strided, sh=sh, sw=sw))

@@ -91,6 +91,8 @@ POOL_GEOMS = [
     (28, 28, 3, 2, 0),   # pool3-style ceil-mode stride 2
     (13, 13, 3, 2, 1),   # padded + ceil (odd remainder)
     (7, 7, 5, 3, 2),     # kernel > 2*stride, fat overlap
+    (17, 17, 2, 3, 1),   # stride > kernel: ceil-clip can leave
+                         # (ow-1)*sw+kw < w+pw (padded-width floor)
 ]
 
 
@@ -172,16 +174,19 @@ def test_maxpool_layer_pallas_dispatch(np_rng, monkeypatch):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_maxpool_vmem_bwd_bf16(np_rng):
-    """bf16 activations: accumulation stays f32 inside the kernel."""
+@pytest.mark.parametrize("stride,pad", [(1, 1), (2, 0)])
+def test_maxpool_vmem_bwd_bf16(np_rng, stride, pad):
+    """bf16 activations through BOTH kernels (stride-1 and strided):
+    accumulation stays f32 inside, output comes back bf16."""
     x = jnp.asarray(np_rng.normal(size=(1, 4, 14, 14)), jnp.bfloat16)
-    oh, ow = pool_output_size(14, 14, 3, 3, 1, 1, 1, 1)
+    oh, ow = pool_output_size(14, 14, 3, 3, stride, stride, pad, pad)
     _, vjp = jax.vjp(
-        lambda x: max_pool_vmem_bwd(x, 3, 3, 1, 1, 1, 1, oh, ow), x)
+        lambda x: max_pool_vmem_bwd(x, 3, 3, stride, stride, pad, pad,
+                                    oh, ow), x)
     (dx,) = vjp(jnp.ones((1, 4, oh, ow), jnp.bfloat16))
     _, vjp2 = jax.vjp(
-        lambda x: max_pool(x.astype(jnp.float32), 3, 3, 1, 1, 1, 1, oh, ow),
-        x)
+        lambda x: max_pool(x.astype(jnp.float32), 3, 3, stride, stride,
+                           pad, pad, oh, ow), x)
     (dx2,) = vjp2(jnp.ones((1, 4, oh, ow), jnp.float32))
     assert dx.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(dx, np.float32),
