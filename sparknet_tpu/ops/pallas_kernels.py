@@ -54,31 +54,35 @@ def _fwd_window(size: int) -> tuple[int, int]:
 
 
 def _lrn_fwd_kernel(x_ref, y_ref, scale_ref, *, size, alpha, beta, k):
-    x = x_ref[:]
+    # Math in f32 regardless of I/O dtype; bf16 blocks cast at the VMEM
+    # boundary so mixed-precision nets keep f32 window sums.
+    x = x_ref[:].astype(jnp.float32)
     pre, post = _fwd_window(size)
     scale = k + (alpha / size) * _window_sum(x * x, pre, post)
-    scale_ref[:] = scale
-    y_ref[:] = x * scale ** -beta
+    scale_ref[:] = scale.astype(scale_ref.dtype)
+    y_ref[:] = (x * scale ** -beta).astype(y_ref.dtype)
 
 
 def _lrn_infer_kernel(x_ref, y_ref, *, size, alpha, beta, k):
     """Forward without the scale residual — the primal/inference path
     (a pallas output cannot be dead-code-eliminated by XLA, so writing
     scale when nothing consumes it costs a full HBM pass)."""
-    x = x_ref[:]
+    x = x_ref[:].astype(jnp.float32)
     pre, post = _fwd_window(size)
     scale = k + (alpha / size) * _window_sum(x * x, pre, post)
-    y_ref[:] = x * scale ** -beta
+    y_ref[:] = (x * scale ** -beta).astype(y_ref.dtype)
 
 
 def _lrn_bwd_kernel(x_ref, scale_ref, dy_ref, dx_ref, *, size, alpha, beta):
-    x = x_ref[:]
-    scale = scale_ref[:]
-    dy = dy_ref[:]
+    x = x_ref[:].astype(jnp.float32)
+    scale = scale_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
     y = x * scale ** -beta
     pre, post = _fwd_window(size)
     ratio = _window_sum(dy * y / scale, post, pre)  # reflected window
-    dx_ref[:] = dy * scale ** -beta - (2.0 * alpha * beta / size) * x * ratio
+    dx_ref[:] = (dy * scale ** -beta
+                 - (2.0 * alpha * beta / size) * x * ratio).astype(
+                     dx_ref.dtype)
 
 
 def _specs(n, c, s):

@@ -384,7 +384,8 @@ class LRNLayer(LayerImpl):
         region = str(p.get("norm_region", "ACROSS_CHANNELS"))
         x = bottoms[0]
         if (region == "ACROSS_CHANNELS" and x.ndim == 4
-                and x.dtype == jnp.float32 and self._use_pallas()):
+                and x.dtype in (jnp.float32, jnp.bfloat16)
+                and self._use_pallas()):
             from .pallas_kernels import lrn_across_channels
             return [lrn_across_channels(x, size, alpha, beta, k)]
         sq = x * x

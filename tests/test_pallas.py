@@ -192,3 +192,21 @@ def test_maxpool_vmem_bwd_bf16(np_rng, stride, pad):
     np.testing.assert_allclose(np.asarray(dx, np.float32),
                                np.asarray(dx2, np.float32),
                                rtol=2e-2, atol=1e-2)
+
+
+def test_pallas_lrn_bf16(np_rng):
+    """bf16 I/O with f32 in-kernel math: forward and gradient track the
+    f32 reference to bf16 tolerance (the mixed-precision train path)."""
+    xf = np_rng.normal(size=(2, 16, 5, 5)).astype(np.float32)
+    x16 = jnp.asarray(xf, jnp.bfloat16)
+    y = lrn_across_channels(x16, SIZE, ALPHA, BETA, K)
+    assert y.dtype == jnp.bfloat16
+    yref = _xla_lrn(jnp.asarray(xf))
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(yref),
+                               rtol=2e-2, atol=2e-2)
+    g = jax.grad(lambda x: jnp.sum(
+        lrn_across_channels(x, SIZE, ALPHA, BETA, K).astype(jnp.float32)))(x16)
+    gref = jax.grad(lambda x: jnp.sum(_xla_lrn(x)))(jnp.asarray(xf))
+    assert g.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(g, np.float32), np.asarray(gref),
+                               rtol=5e-2, atol=2e-2)
