@@ -619,3 +619,160 @@ def test_rule_trajectory_tracks_torch(rule, tmp_path):
         theirs.append(float(loss))
     np.testing.assert_allclose(ours[:5], theirs[:5], rtol=5e-4, atol=5e-5)
     np.testing.assert_allclose(ours, theirs, rtol=2e-2, atol=2e-3)
+
+
+# -- inception-style branching net with an auxiliary loss head ---------------
+
+INCEPTION_NET = """
+name: "miniception"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 8 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "label" type: "Input" top: "label"
+  input_param { shape { dim: 8 } } }
+layer { name: "stem" type: "Convolution" bottom: "data" top: "stem"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param { num_output: 16 kernel_size: 3 pad: 1
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.1 } } }
+layer { name: "stem/relu" type: "ReLU" bottom: "stem" top: "stem" }
+layer { name: "pool_stem" type: "Pooling" bottom: "stem" top: "pool_stem"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+layer { name: "b1x1" type: "Convolution" bottom: "pool_stem" top: "b1x1"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param { num_output: 8 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "b1x1/relu" type: "ReLU" bottom: "b1x1" top: "b1x1" }
+layer { name: "b3x3_reduce" type: "Convolution" bottom: "pool_stem"
+  top: "b3x3_reduce" param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param { num_output: 8 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "b3x3_reduce/relu" type: "ReLU" bottom: "b3x3_reduce"
+  top: "b3x3_reduce" }
+layer { name: "b3x3" type: "Convolution" bottom: "b3x3_reduce" top: "b3x3"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param { num_output: 12 kernel_size: 3 pad: 1
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "b3x3/relu" type: "ReLU" bottom: "b3x3" top: "b3x3" }
+layer { name: "bpool" type: "Pooling" bottom: "pool_stem" top: "bpool"
+  pooling_param { pool: MAX kernel_size: 3 stride: 1 pad: 1 } }
+layer { name: "pool_proj" type: "Convolution" bottom: "bpool" top: "pool_proj"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param { num_output: 8 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "pool_proj/relu" type: "ReLU" bottom: "pool_proj"
+  top: "pool_proj" }
+layer { name: "concat" type: "Concat" bottom: "b1x1" bottom: "b3x3"
+  bottom: "pool_proj" top: "concat" }
+layer { name: "gpool" type: "Pooling" bottom: "concat" top: "gpool"
+  pooling_param { pool: AVE global_pooling: true } }
+layer { name: "ip_main" type: "InnerProduct" bottom: "gpool" top: "ip_main"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  inner_product_param { num_output: 10
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "loss_main" type: "SoftmaxWithLoss" bottom: "ip_main"
+  bottom: "label" top: "loss_main" loss_weight: 1.0 }
+layer { name: "ip_aux" type: "InnerProduct" bottom: "concat" top: "ip_aux"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  inner_product_param { num_output: 10
+    weight_filler { type: "gaussian" std: 0.05 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "loss_aux" type: "SoftmaxWithLoss" bottom: "ip_aux"
+  bottom: "label" top: "loss_aux" loss_weight: 0.3 }
+"""
+
+
+class TorchMiniception:
+    """GoogLeNet's training-graph mechanics in miniature, transcribed
+    independently of this repo's graph code: concat fan-out (pool_stem
+    feeds THREE branches and concat feeds TWO heads — the InsertSplits
+    gradient-accumulation paths), ceil-mode pooling, global AVE pooling,
+    and two SoftmaxWithLoss heads combined per Caffe's loss_weight
+    semantics (net.cpp: total objective = sum loss_weight_i * loss_i)."""
+
+    LAYERS = ["stem", "b1x1", "b3x3_reduce", "b3x3", "pool_proj",
+              "ip_main", "ip_aux"]
+    LR_MULTS = {n: (1.0, 2.0) for n in LAYERS}
+
+    def __init__(self, blobs):
+        self.p, self.hist = {}, {}
+        for name in self.LAYERS:
+            w, b = blobs[name]
+            self.p[name + ".w"] = torch.tensor(np.asarray(w),
+                                               requires_grad=True)
+            self.p[name + ".b"] = torch.tensor(np.asarray(b),
+                                               requires_grad=True)
+        for k, v in self.p.items():
+            self.hist[k] = torch.zeros_like(v)
+
+    def forward(self, x, y):
+        p = self.p
+        h = F.relu(F.conv2d(x, p["stem.w"], p["stem.b"], padding=1))
+        h = F.max_pool2d(h, 3, 2, ceil_mode=True)
+        b1 = F.relu(F.conv2d(h, p["b1x1.w"], p["b1x1.b"]))
+        b3 = F.relu(F.conv2d(h, p["b3x3_reduce.w"], p["b3x3_reduce.b"]))
+        b3 = F.relu(F.conv2d(b3, p["b3x3.w"], p["b3x3.b"], padding=1))
+        bp = F.max_pool2d(h, 3, 1, padding=1)
+        bp = F.relu(F.conv2d(bp, p["pool_proj.w"], p["pool_proj.b"]))
+        cat = torch.cat([b1, b3, bp], dim=1)
+        g = cat.mean(dim=(2, 3))
+        main = F.linear(g, p["ip_main.w"], p["ip_main.b"])
+        aux = F.linear(cat.reshape(cat.shape[0], -1),
+                       p["ip_aux.w"], p["ip_aux.b"])
+        loss = (F.cross_entropy(main, y)
+                + 0.3 * F.cross_entropy(aux, y))
+        return main, loss
+
+    def sgd_step(self, loss, base_lr=0.001, momentum=0.9, wd=0.004):
+        grads = torch.autograd.grad(loss, list(self.p.values()))
+        with torch.no_grad():
+            for (k, v), g in zip(self.p.items(), grads):
+                layer, kind = k.split(".")
+                lmw, lmb = self.LR_MULTS[layer]
+                local_lr = base_lr * (lmw if kind == "w" else lmb)
+                g = g + wd * v
+                self.hist[k] = local_lr * g + momentum * self.hist[k]
+                v -= self.hist[k]
+
+
+def test_inception_aux_loss_trajectory_tracks_torch(tmp_path):
+    """The GoogLeNet mechanics not pinned by any other trajectory test:
+    branch fan-out gradient accumulation (one blob feeding several
+    consumers), Concat backward slicing, global AVE pooling, and
+    multi-head loss_weight combination — per-step total losses and final
+    stem weights track an independent torch transcription."""
+    n_steps = 60
+    netp = load_net_prototxt(INCEPTION_NET)
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, netp)
+    solver = Solver(sp, seed=0)
+    blobs = _export_initial_weights(solver, tmp_path)
+    tm = TorchMiniception(blobs)
+    rng = np.random.default_rng(23)
+    batches = [{
+        "data": rng.normal(size=(8, 3, 16, 16)).astype(np.float32),
+        "label": rng.integers(0, 10, size=(8,)).astype(np.float32),
+    } for _ in range(n_steps)]
+
+    solver.set_train_data(iter(batches))
+    ours = []
+    for _ in range(n_steps):
+        solver.step(1)
+        ours.append(solver._smoothed[-1])
+    theirs = []
+    for b in batches:
+        _, loss = tm.forward(torch.tensor(b["data"]),
+                             torch.tensor(b["label"], dtype=torch.long))
+        tm.sgd_step(loss)
+        theirs.append(float(loss))
+    np.testing.assert_allclose(ours[:10], theirs[:10], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-2, atol=1e-3)
+    # the stem sits behind BOTH heads and all three branches — its final
+    # weights agreeing pins the whole fan-out/fan-in gradient flow
+    final = dict(_export_initial_weights(solver, tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(final["stem"][0]), tm.p["stem.w"].detach().numpy(),
+        rtol=1e-2, atol=1e-3)
