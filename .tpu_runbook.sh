@@ -18,7 +18,7 @@ timeout 2400 python bench.py \
 
 # 2. per-layer profiles (one per model/dtype the headlines quote)
 for spec in "caffenet 256 f32" "caffenet 256 bf16" \
-            "googlenet 128 f32" "googlenet 128 bf16" "vgg16 64 bf16"; do
+            "googlenet 128 f32" "googlenet 128 bf16" "vgg16 64 f32" "vgg16 64 bf16"; do
   set -- $spec
   out="profiles/$1$([ "$3" = bf16 ] && echo _bf16)"
   timeout 1800 python tools/profile_step.py --model "$1" --batch "$2" \
