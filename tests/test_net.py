@@ -243,3 +243,88 @@ layer { name: "relu" type: "ReLU" bottom: "ip" top: "ip" }
 """
     net2 = GraphNet(load_net_prototxt(tail), NetState(Phase.TEST))
     assert net2.output_blobs == ["ip"]
+
+
+HFUSE_NET = """
+input: "data"
+input_shape { dim: 2 dim: 6 dim: 8 dim: 8 }
+input: "label"
+input_shape { dim: 2 }
+layer { name: "b1x1" type: "Convolution" bottom: "data" top: "b1x1"
+  convolution_param { num_output: 3 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 }
+    bias_filler { type: "constant" value: 0.1 } } }
+layer { name: "b3r" type: "Convolution" bottom: "data" top: "b3r"
+  convolution_param { num_output: 4 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 }
+    bias_filler { type: "constant" value: 0.2 } } }
+layer { name: "b3" type: "Convolution" bottom: "b3r" top: "b3"
+  convolution_param { num_output: 5 kernel_size: 3 pad: 1
+    weight_filler { type: "gaussian" std: 0.1 }
+    bias_filler { type: "constant" value: 0.0 } } }
+layer { name: "b5r" type: "Convolution" bottom: "data" top: "b5r"
+  convolution_param { num_output: 2 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 }
+    bias_filler { type: "constant" value: 0.3 } } }
+layer { name: "cat" type: "Concat" bottom: "b1x1" bottom: "b3"
+  bottom: "b5r" top: "cat" }
+layer { name: "ip" type: "InnerProduct" bottom: "cat" top: "ip"
+  inner_product_param { num_output: 4
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+"""
+
+
+def test_hfuse_sibling_1x1_convs_exact(rng, monkeypatch):
+    """Horizontal fusion (default ON) runs sibling 1x1 convs over the
+    same input as ONE fused conv + split — forward loss, every blob, and
+    gradients must be EXACTLY the unfused values (per-output-channel
+    reductions are untouched by filter concatenation);
+    SPARKNET_NO_HFUSE=1 gives the per-layer reference path."""
+    netp = load_net_prototxt(HFUSE_NET)
+    net = Net(netp, NetState(Phase.TRAIN))
+    # detection: the three data-fed 1x1s group; the 3x3 (b3) stays out
+    assert set(net._hfuse_first) == {"b1x1"}
+    assert [m.lp.name for m in net._hfuse_first["b1x1"]] == \
+        ["b1x1", "b3r", "b5r"]
+    params = net.init(rng)
+    inputs = {"data": jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 6, 8, 8)),
+        jnp.float32), "label": jnp.zeros((2,))}
+
+    def loss_fn(p):
+        return net.apply(p, inputs, rng=rng).loss
+
+    monkeypatch.setenv("SPARKNET_NO_HFUSE", "1")
+    ref_out = net.apply_all(params, inputs, rng=rng)
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params)
+    monkeypatch.delenv("SPARKNET_NO_HFUSE")
+    fused_out = net.apply_all(params, inputs, rng=rng)
+    fused_loss, fused_grads = jax.value_and_grad(loss_fn)(params)
+
+    assert float(fused_loss) == float(ref_loss)
+    for b in ref_out:
+        np.testing.assert_array_equal(np.asarray(fused_out[b]),
+                                      np.asarray(ref_out[b]))
+    for k in ref_grads:
+        for g1, g2 in zip(ref_grads[k], fused_grads[k]):
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_hfuse_inplace_versioning_blocks_cross_version_group():
+    """Two 1x1 convs reading blob 'x' BEFORE and AFTER an in-place ReLU
+    rewrites it read different tensors — they must not fuse."""
+    text = """
+input: "x"
+input_shape { dim: 1 dim: 3 dim: 4 dim: 4 }
+layer { name: "a" type: "Convolution" bottom: "x" top: "a"
+  convolution_param { num_output: 2 kernel_size: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "x" top: "x" }
+layer { name: "b" type: "Convolution" bottom: "x" top: "b"
+  convolution_param { num_output: 2 kernel_size: 1
+    weight_filler { type: "xavier" } } }
+"""
+    net = Net(load_net_prototxt(text), NetState(Phase.TEST))
+    assert net._hfuse_first == {}
