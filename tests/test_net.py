@@ -328,3 +328,25 @@ layer { name: "b" type: "Convolution" bottom: "x" top: "b"
 """
     net = Net(load_net_prototxt(text), NetState(Phase.TEST))
     assert net._hfuse_first == {}
+
+
+def test_hfuse_matches_unfused_under_bf16_compute(rng, monkeypatch):
+    """compute_dtype=bf16: the fused path casts the concatenated filters
+    once where the per-layer path casts each member — same bf16 values
+    either way, so outputs must match exactly."""
+    netp = load_net_prototxt(HFUSE_NET)
+    net = Net(netp, NetState(Phase.TRAIN), compute_dtype=jnp.bfloat16)
+    params = net.init(rng)
+    inputs = {"data": jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 6, 8, 8)), jnp.float32),
+        "label": jnp.zeros((2,))}
+    monkeypatch.setenv("SPARKNET_NO_HFUSE", "1")
+    ref = net.apply_all(params, inputs, rng=rng)
+    ref_loss = net.apply(params, inputs, rng=rng).loss
+    monkeypatch.delenv("SPARKNET_NO_HFUSE")
+    fused = net.apply_all(params, inputs, rng=rng)
+    fused_loss = net.apply(params, inputs, rng=rng).loss
+    assert float(fused_loss) == float(ref_loss)
+    for b in ref:
+        np.testing.assert_array_equal(np.asarray(fused[b]),
+                                      np.asarray(ref[b]))
