@@ -219,8 +219,15 @@ class Net:
         can run ONE wider conv and slice — a TPU-shape optimization with
         no reference analog (the GPU reference gains nothing from it).
         Members must read the same VERSION of the bottom (in-place chains
-        reassign names), hence the producer-version group key."""
+        reassign names), hence the producer-version group key.
+
+        The env toggle is read ONCE here (at Net construction): flipping
+        SPARKNET_NO_HFUSE after the first jitted step can never retrace
+        the cached executable, so a per-trace read would silently ignore
+        the flip.  Per-Net-instance it is at least deterministic."""
+        import os
         from ..ops.vision import conv_geometry
+        self._hfuse_enabled = os.environ.get("SPARKNET_NO_HFUSE") != "1"
         ver: dict[str, int] = {}
         groups: dict[tuple, list[_LayerNode]] = {}
         for node in self.nodes:
@@ -516,13 +523,10 @@ class Net:
         # horizontal 1x1-sibling fusion: full-net runs only (ranged runs
         # and eps injection keep the plain per-layer path); on by
         # default (exact transform, measured -5.6% GoogLeNet step).
-        # SPARKNET_NO_HFUSE=1 restores per-layer execution — read at
-        # TRACE time like SPARKNET_NO_S2D: set it before the first
-        # jitted step; an already-cached executable won't retrace
-        import os as _os
+        # SPARKNET_NO_HFUSE=1 restores per-layer execution — latched at
+        # Net construction (_detect_hfuse_groups), not per trace
         hfuse_on = (bool(self._hfuse_first) and start is None
-                    and upto is None and not eps
-                    and _os.environ.get("SPARKNET_NO_HFUSE") != "1")
+                    and upto is None and not eps and self._hfuse_enabled)
         hstash: dict[str, jax.Array] = {}
         for ni, node in enumerate(self.nodes):
             if not started:
@@ -555,6 +559,13 @@ class Net:
                 tops = [hstash.pop(node.lp.name)]
             elif hfuse_on and node.lp.name in self._hfuse_first:
                 members = self._hfuse_first[node.lp.name]
+                # the fused path passes rng=None and skips stateful/
+                # is_loss handling — sound only while detection admits
+                # nothing but stateless, rng-free Convolution layers
+                assert not stateful and not node.impl.needs_rng(
+                    node.lp, train), (
+                    f"hfuse group admitted a stateful/rng layer "
+                    f"{node.lp.name!r}; fix _detect_hfuse_groups")
                 mp = [self.node_params(new_params, m) for m in members]
                 sizes = [p0[0].shape[0] for p0 in mp]
                 fused = [jnp.concatenate([p0[0] for p0 in mp], axis=0)]

@@ -353,7 +353,14 @@ def max_pool_vmem_bwd(x, kh: int, kw: int, sh: int, sw: int,
     """MAX pool whose forward is XLA's reduce_window (fuses with
     neighbors) and whose BACKWARD is the VMEM-resident Pallas kernel
     instead of select-and-scatter.  The primal IS ops/vision.max_pool —
-    one home for the Caffe ceil-mode geometry."""
+    one home for the Caffe ceil-mode geometry.
+
+    Domain restriction: the backward pads windows with a bf16-min
+    sentinel (-3.3895e38) even in f32 mode (f32-min becomes -inf through
+    the MXU's bf16 pass and NaN-poisons the one-hot gather), so an f32
+    activation below bf16-min would lose its argmax to padding and
+    mis-route the gradient.  No practical activation reaches -3.4e38;
+    the next representable magnitude beyond the sentinel is -inf."""
     from .vision import max_pool
     return max_pool(x, kh, kw, sh, sw, ph, pw, oh, ow)
 

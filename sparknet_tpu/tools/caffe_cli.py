@@ -48,8 +48,13 @@ def _train(args) -> int:
             factory = lambda ts=ts: feed_for_net(ts, Phase.TEST)
             factory()  # probe
             solver.set_test_data(factory, net_id=i)
-        except ValueError:
-            pass
+        except ValueError as e:
+            # the reference fails loudly when a test DB is unreadable
+            # (DataLayer::DataLayerSetUp); we keep training but must not
+            # drop the eval silently — a mis-pathed LMDB otherwise looks
+            # like a clean run with no test scores
+            print(f"WARNING: test net #{i} feed unavailable, skipping "
+                  f"eval for it: {e}", file=sys.stderr)
 
     solver.solve()
     if sp.snapshot_prefix:
