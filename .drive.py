@@ -1,94 +1,60 @@
-"""Round-4 verify drive: train/test/snapshot on TPU through the public
-API with BOTH maxpool layers (strided + stride-1 padded) so the
-VMEM-resident Pallas maxpool backward is exercised inside a real solver
-step when SPARKNET_PALLAS_MAXPOOL=1.  Run twice:
-
-    python .drive.py                                # select-and-scatter
-    SPARKNET_PALLAS_MAXPOOL=1 python .drive.py      # Pallas backward
-
-and compare the printed losses (should match to bf16-level noise; both
-asserted to converge)."""
-import itertools
-import os
+"""Round-5 verify drive: train through the public Solver API, then push
+the captured log through the parse_log and plot_training_log CLIs — the
+surfaces this round's lr/timestamp logging change touched."""
+import contextlib, io, itertools, os, subprocess, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
-
-from sparknet_tpu.proto import (load_net_prototxt,
-                                load_solver_prototxt_with_net,
-                                replace_data_layers)
-from sparknet_tpu.solvers import Solver
-from sparknet_tpu.data import device_feed
-from sparknet_tpu.data.minibatch import batch_feed
-
-MODE = os.environ.get("SPARKNET_PALLAS_MAXPOOL", "0")
+from sparknet_tpu.proto import load_net_prototxt, load_solver_prototxt_with_net
 
 NET = """
-name: "drivenet"
-layer { name: "data" type: "Input" top: "data" top: "label"
-  input_param { shape { dim: 32 dim: 3 dim: 24 dim: 24 }
-                shape { dim: 32 } } }
-layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
-  convolution_param { num_output: 16 kernel_size: 5 stride: 2
-    weight_filler { type: "xavier" } } }
-layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
-layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
-  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
-layer { name: "pool2" type: "Pooling" bottom: "pool1" top: "pool2"
-  pooling_param { pool: MAX kernel_size: 3 stride: 1 pad: 1 } }
-layer { name: "ip" type: "InnerProduct" bottom: "pool2" top: "ip"
-  inner_product_param { num_output: 10 weight_filler { type: "xavier" } } }
-layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
-layer { name: "acc" type: "Accuracy" bottom: "ip" bottom: "label" top: "acc"
-  include { phase: TEST } }
+name: "drive"
+layer { name: "data" type: "DummyData" top: "data" top: "label"
+  dummy_data_param { shape { dim: 8 dim: 3 } shape { dim: 8 }
+    data_filler { type: "gaussian" std: 1.0 }
+    data_filler { type: "constant" value: 1.0 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" }
+layer { name: "acc" type: "Accuracy" bottom: "ip" bottom: "label"
+  top: "accuracy" include { phase: TEST } }
 """
 
-net = load_net_prototxt(NET)
-solver = Solver(load_solver_prototxt_with_net(
-    'base_lr: 0.02\nmomentum: 0.9\n', net), seed=0)
+from sparknet_tpu.solvers import Solver
+sp = load_solver_prototxt_with_net(
+    'base_lr: 0.1\nlr_policy: "step"\ngamma: 0.5\nstepsize: 4\n'
+    'max_iter: 12\ndisplay: 2\ntest_interval: 6\ntest_iter: 2\n'
+    'test_initialization: true\n', load_net_prototxt(NET))
+solver = Solver(sp, seed=0)
 
-# separable synthetic data: class k has mean pattern k
-rng = np.random.default_rng(0)
-protos = rng.normal(size=(10, 3, 24, 24)).astype(np.float32)
-batches = []
-for _ in range(8):
-    lab = rng.integers(0, 10, size=32)
-    img = protos[lab] * 2.0 + rng.normal(size=(32, 3, 24, 24)).astype(np.float32) * 0.3
-    batches.append((img.astype(np.float32), lab.astype(np.float32)))
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    solver.solve()
+log_text = buf.getvalue()
+print(log_text)
+with open("/tmp/drive_train.log", "w") as f:
+    f.write(log_text)
 
-solver.set_train_data(device_feed(batch_feed(itertools.cycle(batches), None)))
-l0 = solver.step(1)
-solver.step(60)
-l1 = float(solver.smoothed_loss())
-print(f"PALLAS_MAXPOOL={MODE} loss {l0:.4f} -> {l1:.4f}")
-assert l1 < 0.5 and l1 < l0, (l0, l1)
+# the lr line must show the step-policy drops: 0.1 -> 0.05 -> 0.025
+assert "lr = 0.1" in log_text and "lr = 0.05" in log_text and \
+    "lr = 0.025" in log_text, "lr schedule lines missing"
+assert log_text.splitlines()[0].startswith("I"), "glog prefix missing"
 
-solver.set_test_data(lambda: batch_feed(iter(batches), None))
-scores = solver.test(8)
-print("test outputs:", scores)
-acc = scores.get("acc", scores.get("accuracy"))
-assert acc is not None and acc > 0.9, scores
-
-solver.snapshot("/tmp/drive_s.npz")
-s2 = Solver(load_solver_prototxt_with_net('base_lr: 0.02\nmomentum: 0.9\n', net), seed=1)
-s2.restore("/tmp/drive_s.npz")
-s2.set_test_data(lambda: batch_feed(iter(batches), None))
-scores2 = s2.test(8)
-assert abs(scores2["acc"] - acc) < 1e-5, (scores, scores2)
-print("snapshot/restore roundtrip OK:", scores2)
-
-# error probes
-for desc, fn in [
-    ("unknown bottom", lambda: Solver(
-        load_solver_prototxt_with_net('base_lr: 0.1\n',
-        load_net_prototxt(NET.replace('bottom: "conv1" top: "pool1"',
-                                      'bottom: "nope" top: "pool1"'))), seed=0)),
-    ("conv w/o kernel_size", lambda: Solver(load_solver_prototxt_with_net(
-        'base_lr: 0.1\n', load_net_prototxt(
-            NET.replace("kernel_size: 5 stride: 2", ""))), seed=0)),
-]:
-    try:
-        fn()
-        print(f"ERROR-PROBE FAIL: {desc} did not raise")
-        raise SystemExit(1)
-    except (ValueError, KeyError) as e:
-        print(f"error probe OK ({desc}): {str(e)[:80]}")
-print(f"DRIVE PASSED (PALLAS_MAXPOOL={MODE}, final loss {l1:.4f}, acc {acc:.3f})")
+# CLI front doors: parse_log then all 8 chart types
+r = subprocess.run([sys.executable, "-m", "sparknet_tpu.tools.parse_log",
+                    "/tmp/drive_train.log", "/tmp"],
+                   capture_output=True, text=True)
+assert r.returncode == 0, r.stderr
+print(open("/tmp/drive_train.log.train").read())
+rows = open("/tmp/drive_train.log.train").read().splitlines()
+assert rows[0] == "NumIters,Seconds,LearningRate,loss"
+assert len(rows) >= 6
+for ct in range(8):
+    r = subprocess.run([sys.executable, "-m",
+                        "sparknet_tpu.tools.plot_training_log",
+                        str(ct), f"/tmp/drive_chart{ct}.png",
+                        "/tmp/drive_train.log"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, (ct, r.stderr)
+    assert os.path.getsize(f"/tmp/drive_chart{ct}.png") > 1000
+print("OK: lr schedule logged, timestamps parsed, 8/8 chart types rendered")

@@ -560,12 +560,15 @@ class Net:
             elif hfuse_on and node.lp.name in self._hfuse_first:
                 members = self._hfuse_first[node.lp.name]
                 # the fused path passes rng=None and skips stateful/
-                # is_loss handling — sound only while detection admits
-                # nothing but stateless, rng-free Convolution layers
-                assert not stateful and not node.impl.needs_rng(
-                    node.lp, train), (
-                    f"hfuse group admitted a stateful/rng layer "
-                    f"{node.lp.name!r}; fix _detect_hfuse_groups")
+                # is_loss handling for EVERY member (non-first members
+                # are served from hstash) — sound only while detection
+                # admits nothing but stateless, rng-free Convolutions
+                assert not any(
+                    getattr(m.impl, "has_state", False)
+                    or m.impl.needs_rng(m.lp, train)
+                    for m in members), (
+                    f"hfuse group of {node.lp.name!r} admitted a "
+                    f"stateful/rng layer; fix _detect_hfuse_groups")
                 mp = [self.node_params(new_params, m) for m in members]
                 sizes = [p0[0].shape[0] for p0 in mp]
                 fused = [jnp.concatenate([p0[0] for p0 in mp], axis=0)]

@@ -23,6 +23,8 @@ import numpy as np
 
 from ..graph.net import Net, WeightCollection
 from ..proto.caffe_pb import NetParameter, NetState, Phase, SolverParameter
+from ..utils.glog import log_line
+from .lr_policies import learning_rate
 from .update_rules import make_update_rule
 
 
@@ -181,7 +183,14 @@ class Solver:
             if debug:
                 self._log_debug_info(stacked, params_before, rng)
             if self.sp.display and self.iter % self.sp.display == 0:
-                print(f"Iteration {self.iter}, loss = {self.smoothed_loss():.6f}")
+                log_line(f"Iteration {self.iter}, "
+                         f"loss = {self.smoothed_loss():.6f}")
+                # the reference logs the rate each display interval
+                # (SGDSolver::ApplyUpdate, sgd_solver.cpp:104-106) — the
+                # rate the NEXT step will apply, which is what caffe's
+                # ApplyUpdate(iter_) prints at the same boundary
+                log_line(f"Iteration {self.iter}, "
+                         f"lr = {float(learning_rate(self.sp, self.iter)):g}")
             # snapshot-on-schedule (reference: solver.cpp:270-277)
             if (self.sp.snapshot and self.sp.snapshot_prefix
                     and self.iter % self.sp.snapshot == 0):
@@ -238,7 +247,7 @@ class Solver:
                         if can_snapshot:
                             self.snapshot_caffe()
                         return loss
-                    print(f"Iteration {self.iter}, loss = {loss:.6f}")
+                    log_line(f"Iteration {self.iter}, loss = {loss:.6f}")
                     if interval:
                         self._print_test_scores(test_iter)
             finally:
@@ -259,16 +268,17 @@ class Solver:
             # the reference's marker line (solver.cpp Test: "Iteration
             # %d, Testing net (#%d)") — log parsers key test scores to
             # the iteration by it, incl. the pre-training pass on resume
-            print(f"Iteration {self.iter}, Testing net (#{n})")
+            log_line(f"Iteration {self.iter}, Testing net (#{n})")
             tag = f" #{n}" if multi else ""
             for k, v in self.test(ti, net_id=n).items():
                 arr = np.asarray(v, np.float64) / ti
                 if arr.ndim == 0:
-                    print(f"    Test net{tag} output: {k} = {float(arr):.6f}")
+                    log_line(
+                        f"    Test net{tag} output: {k} = {float(arr):.6f}")
                 else:  # per-element, like Caffe's indexed test outputs
                     for i, x in enumerate(arr.reshape(-1)):
-                        print(f"    Test net{tag} output: "
-                              f"{k}[{i}] = {float(x):.6f}")
+                        log_line(f"    Test net{tag} output: "
+                                 f"{k}[{i}] = {float(x):.6f}")
 
     def _log_debug_info(self, stacked, params_before, rng) -> None:
         """Per-blob/param mean-|x| dumps behind ``sp.debug_info`` — the

@@ -1,43 +1,93 @@
 """parse_log — split a training log into train/test CSVs (reference:
 caffe/tools/extra/parse_log.py, which greps glog output for
-"Iteration N, loss" and "Test net output" lines; this framework's
-Solver prints the same shapes — solver.py step/solve/_print_test_scores).
+"Iteration N, loss" / "Iteration N, lr" and "Test net output" lines and
+mines the glog timestamp prefix for a Seconds column via
+tools/extra/extract_seconds.py; this framework's Solver prints the same
+shapes — solver.py step/solve/_print_test_scores through
+utils/glog.log_line).
 
 Usage:
   python -m sparknet_tpu.tools.parse_log LOGFILE [OUT_DIR]
 
-Writes LOGFILE.train (NumIters,loss) and LOGFILE.test
-(NumIters,TestNet,<output columns>) into OUT_DIR (default: the log's
-directory), mirroring the reference's <log>.train/<log>.test CSVs.
+Writes LOGFILE.train (NumIters,Seconds,LearningRate,loss) and
+LOGFILE.test (NumIters,Seconds,TestNet,<output columns>) into OUT_DIR
+(default: the log's directory), mirroring the reference's
+<log>.train/<log>.test CSVs.  Logs without glog prefixes (or without lr
+lines) still parse — the Seconds/LearningRate cells are left empty, and
+the plot tool refuses the chart types that would need them.
 """
 
 from __future__ import annotations
 
 import argparse
 import csv
+import datetime
 import os
 import re
 
 _FLOAT = r"([-+]?(?:[0-9][0-9.]*(?:[eE][-+]?\d+)?|nan|inf))"
 _ITER_RE = re.compile(r"Iteration (\d+), loss = " + _FLOAT)
+_LR_RE = re.compile(r"Iteration (\d+), lr = " + _FLOAT)
 _TESTING_RE = re.compile(r"Iteration (\d+), Testing net \(#(\d+)\)")
 _TEST_RE = re.compile(
     r"Test net(?: #(\d+))? output: (\S+?)(?:\[(\d+)\])? = " + _FLOAT)
+# glog prefix: I<mmdd> <HH:MM:SS.ffffff> <pid> <source>]  (the reference's
+# extract_seconds.py format; utils/glog.log_line emits the same shape)
+_GLOG_RE = re.compile(
+    r"^[IWEF](\d{2})(\d{2}) (\d{2}):(\d{2}):(\d{2})\.(\d+)\b")
+
+
+def _glog_seconds(line: str) -> float | None:
+    """Absolute within-year seconds of a glog-prefixed line (year is not
+    in the prefix; extract_seconds.py pulls it from the log's ctime —
+    deltas within one log only wrap at new year, handled in parse_log)."""
+    m = _GLOG_RE.match(line)
+    if not m:
+        return None
+    mo, d, h, mi, s, frac = m.groups()
+    try:
+        # day-of-year via a fixed leap year so Feb 29 logs parse
+        day = datetime.date(2024, int(mo), int(d)).timetuple().tm_yday
+    except ValueError:
+        return None  # regex-shaped but not a date — treat as unprefixed
+    return (((day * 24 + int(h)) * 60 + int(mi)) * 60 + int(s)
+            + int(frac) / 10 ** len(frac))
 
 
 def parse_log(path: str):
-    """-> (train_rows, test_rows): train [(iter, loss)], test
-    {(iter, net_id): {column: value}} in encounter order."""
-    train: list[tuple[int, float]] = []
+    """-> (train_rows, test_rows): train [(iter, loss, seconds|None,
+    lr|None)], test {(iter, net_id): {column: value, "Seconds": s}} in
+    encounter order.  For back-compat, train rows unpack as
+    ``for it, loss in train`` too (see _TrainRow)."""
+    train: list[_TrainRow] = []
     test: dict[tuple[int, int], dict[str, float]] = {}
     cur_iter = 0
     cur_test_net = 0
+    first_ts: float | None = None
+    cur_lr: float | None = None
+    lr_by_iter: dict[int, float] = {}
     with open(path) as f:
         for line in f:
+            ts = _glog_seconds(line)
+            if ts is not None:
+                if first_ts is None:
+                    first_ts = ts
+                if ts < first_ts:  # new-year wrap within one log
+                    ts += 366 * 24 * 3600
+                ts -= first_ts
+            m = _LR_RE.search(line)
+            if m:
+                cur_lr = float(m.group(2))
+                lr_by_iter[int(m.group(1))] = cur_lr
+                continue
             m = _ITER_RE.search(line)
             if m:
                 cur_iter = int(m.group(1))
-                train.append((cur_iter, float(m.group(2))))
+                # lr in effect NOW (last lr line seen so far); a
+                # same-iteration lr line printed just after this loss
+                # line overrides it below
+                train.append(_TrainRow(cur_iter, float(m.group(2)), ts,
+                                       cur_lr))
                 continue
             m = _TESTING_RE.search(line)
             if m:  # the authoritative iteration for following scores —
@@ -45,6 +95,9 @@ def parse_log(path: str):
                 #    "Iteration N, loss" line has printed yet
                 cur_iter = int(m.group(1))
                 cur_test_net = int(m.group(2))
+                if ts is not None:
+                    test.setdefault((cur_iter, cur_test_net), {})[
+                        "Seconds"] = ts
                 continue
             m = _TEST_RE.search(line)
             if m:
@@ -54,7 +107,43 @@ def parse_log(path: str):
                     col = f"{col}[{m.group(3)}]"
                 test.setdefault((cur_iter, net_id), {})[col] = \
                     float(m.group(4))
+    # the lr line prints at the same display boundary as (just after)
+    # the loss line; prefer the exact same-iteration lr over the
+    # scan-time "last seen" value each row was stamped with, so the
+    # display-pair rows get their own boundary's rate and the
+    # solve()-chunk-boundary rows keep the rate in effect at that point
+    for row in train:
+        row.lr = lr_by_iter.get(row.iter, row.lr)
     return train, test
+
+
+class _TrainRow:
+    """(iter, loss) tuple-compatible row carrying seconds + lr."""
+
+    __slots__ = ("iter", "loss", "seconds", "lr")
+
+    def __init__(self, it: int, loss: float, seconds: float | None,
+                 lr: float | None = None):
+        self.iter, self.loss, self.seconds, self.lr = it, loss, seconds, lr
+
+    def __iter__(self):  # back-compat: `for it, loss in train`
+        return iter((self.iter, self.loss))
+
+    def __getitem__(self, i):
+        return (self.iter, self.loss)[i]
+
+    def __eq__(self, other):
+        try:
+            return tuple(self) == tuple(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(tuple(self))
+
+    def __repr__(self):
+        return (f"_TrainRow({self.iter}, {self.loss}, "
+                f"seconds={self.seconds}, lr={self.lr})")
 
 
 def write_csvs(path: str, out_dir: str | None = None) -> tuple[str, str]:
@@ -62,20 +151,24 @@ def write_csvs(path: str, out_dir: str | None = None) -> tuple[str, str]:
     out_dir = out_dir or (os.path.dirname(os.path.abspath(path)))
     base = os.path.join(out_dir, os.path.basename(path))
     train_path, test_path = base + ".train", base + ".test"
+    fmt = lambda v: "" if v is None else v
     with open(train_path, "w", newline="") as f:
         w = csv.writer(f)
-        w.writerow(["NumIters", "loss"])
-        w.writerows(train)
+        # the reference's column set (parse_log.py train_dict_names)
+        w.writerow(["NumIters", "Seconds", "LearningRate", "loss"])
+        for row in train:
+            w.writerow([row.iter, fmt(row.seconds), fmt(row.lr), row.loss])
     cols: list[str] = []
     for row in test.values():
         for k in row:
-            if k not in cols:
+            if k not in cols and k != "Seconds":
                 cols.append(k)
     with open(test_path, "w", newline="") as f:
         w = csv.writer(f)
-        w.writerow(["NumIters", "TestNet"] + cols)
+        w.writerow(["NumIters", "Seconds", "TestNet"] + cols)
         for (it, net_id), row in test.items():
-            w.writerow([it, net_id] + [row.get(c, "") for c in cols])
+            w.writerow([it, fmt(row.get("Seconds")), net_id]
+                       + [row.get(c, "") for c in cols])
     return train_path, test_path
 
 

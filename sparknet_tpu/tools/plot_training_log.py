@@ -1,13 +1,17 @@
 """plot_training_log — chart a training log (reference:
-caffe/tools/extra/plot_training_log.py.example).
+caffe/tools/extra/plot_training_log.py.example, all 8 chart types).
 
-Chart types follow the reference numbering; this framework's logs carry
-iterations but not wall-clock timestamps or per-iter learning rates, so
-the Seconds/LearningRate variants (1, 3, 4, 5, 7) raise with a clear
-message rather than plotting wrong axes.
+  0: Test accuracy  vs. Iters        1: Test accuracy  vs. Seconds
+  2: Test loss      vs. Iters        3: Test loss      vs. Seconds
+  4: Train learning rate vs. Iters   5: Train learning rate vs. Seconds
+  6: Train loss     vs. Iters        7: Train loss     vs. Seconds
 
-  0: Test accuracy  vs. Iters        2: Test loss  vs. Iters
-  6: Train loss     vs. Iters
+Seconds come from the glog timestamp prefix the Solver emits
+(utils/glog.log_line; reference: tools/extra/extract_seconds.py), the
+learning rate from the per-display-interval "Iteration N, lr = R" lines
+(reference: sgd_solver.cpp:104-106).  A log missing those lines (e.g.
+produced before they were emitted) raises a clear error for the chart
+types that need them rather than plotting a wrong axis.
 
 Usage:
   python -m sparknet_tpu.tools.plot_training_log CHART_TYPE OUT.png \
@@ -19,33 +23,48 @@ from __future__ import annotations
 import argparse
 import os
 
-_SUPPORTED = {
-    0: ("Test accuracy vs. Iters", "accuracy", "test"),
-    2: ("Test loss vs. Iters", "loss", "test"),
-    6: ("Train loss vs. Iters", "loss", "train"),
-}
-_UNSUPPORTED = {
-    1: "Seconds axes need glog timestamps this framework does not emit",
-    3: "Seconds axes need glog timestamps this framework does not emit",
-    4: "learning rate is not logged per iteration here",
-    5: "learning rate is not logged per iteration here",
-    7: "Seconds axes need glog timestamps this framework does not emit",
+# chart type -> (title, y field, x field, train|test)
+_CHARTS = {
+    0: ("Test accuracy vs. Iters", "accuracy", "Iters", "test"),
+    1: ("Test accuracy vs. Seconds", "accuracy", "Seconds", "test"),
+    2: ("Test loss vs. Iters", "loss", "Iters", "test"),
+    3: ("Test loss vs. Seconds", "loss", "Seconds", "test"),
+    4: ("Train learning rate vs. Iters", "lr", "Iters", "train"),
+    5: ("Train learning rate vs. Seconds", "lr", "Seconds", "train"),
+    6: ("Train loss vs. Iters", "loss", "Iters", "train"),
+    7: ("Train loss vs. Seconds", "loss", "Seconds", "train"),
 }
 
 
-def _series(path: str, field: str, which: str):
+def _series(path: str, field: str, xfield: str, which: str):
     """-> {label_suffix: (xs, ys)} — one series per test net, so
     multi-test-net logs don't interleave into a zigzag."""
     from .parse_log import parse_log
     train, test = parse_log(path)
     if which == "train":
-        return {"": ([it for it, _ in train],
-                     [loss for _, loss in train])}
+        rows = [(r.seconds if xfield == "Seconds" else r.iter,
+                 r.lr if field == "lr" else r.loss) for r in train]
+        missing = [i for i, (x, y) in enumerate(rows)
+                   if x is None or y is None]
+        if rows and len(missing) == len(rows):
+            what = ("glog timestamps" if xfield == "Seconds"
+                    else "'Iteration N, lr =' lines")
+            raise ValueError(
+                f"{path}: no {what} found — this log predates the "
+                f"Solver emitting them, so chart x/y field "
+                f"{xfield}/{field} cannot be drawn")
+        rows = [(x, y) for x, y in rows if x is not None and y is not None]
+        return {"": ([x for x, _ in rows], [y for _, y in rows])}
     by_net: dict[int, tuple[list, list]] = {}
     for (it, net), row in sorted(test.items()):
         if field in row:
+            x = row.get("Seconds") if xfield == "Seconds" else it
+            if x is None:
+                raise ValueError(
+                    f"{path}: test pass at iter {it} has no glog "
+                    f"timestamp; Seconds charts need timestamped logs")
             xs, ys = by_net.setdefault(net, ([], []))
-            xs.append(it)
+            xs.append(x)
             ys.append(row[field])
     multi = len(by_net) > 1
     return {(f" (test net #{n})" if multi else ""): s
@@ -53,15 +72,11 @@ def _series(path: str, field: str, which: str):
 
 
 def plot(chart_type: int, out_path: str, logs: list[str]) -> None:
-    if chart_type in _UNSUPPORTED:
-        raise ValueError(
-            f"chart type {chart_type} unsupported: "
-            f"{_UNSUPPORTED[chart_type]} (supported: {sorted(_SUPPORTED)})")
-    if chart_type not in _SUPPORTED:
+    if chart_type not in _CHARTS:
         raise ValueError(
             f"unknown chart type {chart_type} "
-            f"(supported: {sorted(_SUPPORTED)})")
-    title, field, which = _SUPPORTED[chart_type]
+            f"(supported: {sorted(_CHARTS)})")
+    title, field, xfield, which = _CHARTS[chart_type]
 
     import matplotlib
     matplotlib.use("Agg")
@@ -69,13 +84,13 @@ def plot(chart_type: int, out_path: str, logs: list[str]) -> None:
 
     fig, ax = plt.subplots(figsize=(8, 5))
     for path in logs:
-        series = _series(path, field, which)
+        series = _series(path, field, xfield, which)
         if not any(xs for xs, _ in series.values()):
             raise ValueError(f"{path}: no {which} '{field}' entries found")
         for suffix, (xs, ys) in series.items():
             ax.plot(xs, ys, marker=".", linewidth=1,
                     label=os.path.basename(path) + suffix)
-    ax.set_xlabel("Iters")
+    ax.set_xlabel(xfield)
     ax.set_ylabel(title.split(" vs.")[0])
     ax.set_title(title)
     ax.legend(loc="best")

@@ -1,0 +1,31 @@
+"""glog-style training-log lines.
+
+The reference logs through glog — `I0416 13:23:03.089758 21823
+solver.cpp:218] Iteration 80, loss = ...` — and its log tooling mines
+the prefix for wall-clock axes (reference:
+caffe/tools/extra/extract_seconds.py, which subtracts the first line's
+timestamp to get a Seconds column).  The Solver routes its training-loop
+prints through ``log_line`` so ``tools/parse_log`` can recover Seconds
+and ``tools/plot_training_log`` can draw the *-vs-Seconds chart types.
+
+Lines keep the reference's field order (level+date, time, pid,
+source]) so the prefix regex in parse_log matches either producer's
+logs.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import sys
+
+_PID = os.getpid()
+
+
+def log_line(msg: str, *, file=None, now: datetime.datetime | None = None,
+             tag: str = "solver.py") -> None:
+    """Print ``msg`` with a glog-'I' prefix (INFO severity; the reference
+    trains at INFO — sgd_solver.cpp logs rate/loss via LOG(INFO))."""
+    now = now or datetime.datetime.now()
+    print(f"{now:I%m%d %H:%M:%S.%f} {_PID} {tag}] {msg}",
+          file=file or sys.stdout)

@@ -508,10 +508,25 @@ layer { name: "acc" type: "Accuracy" bottom: "ip" bottom: "label"
 
     tr_path, te_path = write_csvs(str(log), str(tmp_path))
     rows = list(csv.reader(open(tr_path)))
-    assert rows[0] == ["NumIters", "loss"] and len(rows) > 1
+    assert rows[0] == ["NumIters", "Seconds", "LearningRate", "loss"]
+    assert len(rows) > 1
+    # glog timestamps + lr lines are emitted by the Solver now: every
+    # train row carries Seconds (monotone from 0) and LearningRate
+    secs = [float(r[1]) for r in rows[1:]]
+    assert secs == sorted(secs) and secs[0] >= 0.0
+    assert all(float(r[2]) == 0.1 for r in rows[1:])  # base_lr, fixed
     te_rows = list(csv.reader(open(te_path)))
-    assert te_rows[0][:2] == ["NumIters", "TestNet"]
+    assert te_rows[0][:3] == ["NumIters", "Seconds", "TestNet"]
     assert "accuracy" in te_rows[0]
+    assert all(r[1] != "" for r in te_rows[1:])
+
+    # all 8 reference chart types render from this real log
+    # (plot_training_log.py.example supported_chart_types)
+    from sparknet_tpu.tools.plot_training_log import main as plot_main
+    for ct in range(8):
+        out = tmp_path / f"chart{ct}.png"
+        assert plot_main([str(ct), str(out), str(log)]) == 0
+        assert out.stat().st_size > 1000
 
 
 def test_parse_log_resume_and_inf(tmp_path):
@@ -554,8 +569,12 @@ def test_plot_training_log(tmp_path):
         out = tmp_path / name
         assert main([str(ct), str(out), str(log)]) == 0
         assert out.stat().st_size > 1000  # a real png
-    with pytest.raises(ValueError, match="unsupported"):
+    # a log with no glog timestamps / lr lines refuses the Seconds and
+    # LearningRate chart types with a clear message
+    with pytest.raises(ValueError, match="timestamp"):
         plot(1, str(tmp_path / "x.png"), [str(log)])
+    with pytest.raises(ValueError, match="lr"):
+        plot(4, str(tmp_path / "x.png"), [str(log)])
     with pytest.raises(ValueError, match="unknown chart type"):
         plot(9, str(tmp_path / "x.png"), [str(log)])
 
