@@ -118,6 +118,50 @@ snapshot: 1
     assert "acc =" in out
 
 
+@pytest.mark.parametrize("strategy,tau", [("sync", 1), ("local_sgd", 2)])
+def test_caffe_cli_train_multi_device(db_net, capsys, strategy, tau):
+    """`caffe train --devices N` routes to DistributedTrainer (the
+    `caffe train --gpu 0,1` P2PSync path, caffe/tools/caffe.cpp:81-103,
+    208-211), end to end from the CLI on the virtual CPU mesh: DB-backed
+    feed fanned out one minibatch per device, loss/test logging, npz
+    snapshot."""
+    tmp_path, model = db_net
+    solver = tmp_path / f"solver_{strategy}.prototxt"
+    solver.write_text(f"""
+net: "{model}"
+base_lr: 0.01
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 4
+display: 2
+test_iter: 2
+test_interval: 2
+snapshot_prefix: "{tmp_path / ('multi_' + strategy)}"
+""")
+    rc = caffe_cli.main(["train", "--solver", str(solver),
+                         "--devices", "2", "--strategy", strategy,
+                         "--tau", str(tau)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Multi-device training: 2 devices" in out
+    assert f"strategy={strategy}" in out
+    assert "loss = " in out and "Optimization Done." in out
+    assert "Testing net (#0)" in out and "acc = " in out
+    snap = tmp_path / f"multi_{strategy}_iter_4.npz"
+    assert snap.exists()
+
+    # resume from the snapshot picks up at iter 4 and finishes cleanly
+    solver.write_text(solver.read_text().replace("max_iter: 4",
+                                                 "max_iter: 6"))
+    rc = caffe_cli.main(["train", "--solver", str(solver),
+                         "--devices", "2", "--strategy", strategy,
+                         "--tau", str(tau),
+                         "--snapshot", str(snap)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Resuming from" in out and "(iter 4)" in out
+
+
 def test_extract_features(db_net, tmp_path, capsys):
     tpath, model = db_net
     solver = tpath / "solver.prototxt"
