@@ -1,4 +1,4 @@
-from .mesh import make_mesh, replicated, batch_sharded
+from .mesh import make_mesh, make_pod_mesh, replicated, batch_sharded
 from .trainer import (
     DistributedTrainer,
     TrainerConfig,

@@ -20,6 +20,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+HOST_AXIS = "host"   # the slow tier: DCN / cross-host (τ-averaging)
+CHIP_AXIS = "chip"   # the fast tier: ICI within a host (per-step psum)
 
 
 def make_mesh(n_devices: int | None = None, *, model_parallel: int = 1,
@@ -39,6 +41,36 @@ def make_mesh(n_devices: int | None = None, *, model_parallel: int = 1,
         raise ValueError(f"{n} devices not divisible by mp={model_parallel}")
     arr = np.asarray(devs).reshape(n // model_parallel, model_parallel)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_pod_mesh(n_hosts: int | None = None, chips_per_host: int | None = None,
+                  *, devices=None) -> Mesh:
+    """A (host, chip) mesh — the deployment topology SparkNet's two DP
+    tiers compose onto: per-step gradient psum over the ``chip`` axis
+    (ICI within a host — the reference's intra-node P2PSync,
+    caffe/src/caffe/parallel.cpp:271-360) × τ-step weight averaging over
+    the ``host`` axis (DCN across hosts — the reference's Spark
+    driver rounds, ImageNetApp.scala:100-182).  Device order follows
+    ``jax.devices()``, which groups each process's local devices
+    contiguously — so on a real multi-host pod rows of the mesh ARE
+    hosts and the chip-axis collectives ride ICI."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_hosts is None:
+        n_hosts = max(jax.process_count(), 1)
+    if n_hosts < 1:
+        raise ValueError(f"pod mesh needs n_hosts >= 1, got {n_hosts}")
+    if chips_per_host is None:
+        chips_per_host = len(devs) // n_hosts
+    if chips_per_host < 1:
+        raise ValueError(
+            f"pod mesh needs chips_per_host >= 1, got {chips_per_host}")
+    need = n_hosts * chips_per_host
+    if need > len(devs):
+        raise ValueError(
+            f"pod mesh {n_hosts}x{chips_per_host} needs {need} devices, "
+            f"have {len(devs)}")
+    arr = np.asarray(devs[:need]).reshape(n_hosts, chips_per_host)
+    return Mesh(arr, (HOST_AXIS, CHIP_AXIS))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

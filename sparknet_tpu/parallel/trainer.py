@@ -21,6 +21,18 @@ XLA collectives inside one ``shard_map``-compiled round:
    tree-reduce over CUDA P2P; ``on_gradients_ready`` hook at solver.cpp:260).
    Here the tree is ``lax.pmean`` on the gradients inside the step.
 
+3. **"hierarchical"** — the two tiers COMPOSED on a (host, chip) mesh,
+   the way a real TPU pod would deploy SparkNet's semantics: per-step
+   gradient pmean over the ``chip`` axis (ICI within a host — P2PSync's
+   role) and τ-step weight averaging over the ``host`` axis (DCN across
+   hosts — the Spark driver round's role).  The reference never composed
+   its two tiers (SparkNet pinned one GPU per worker, Net.scala:95);
+   this is the completion of that design.  Optimizer state is per-HOST
+   (all chips of a host apply identical chip-mean updates, so the state
+   is replicated within the host and distinct across hosts between
+   averaging boundaries).  Collapses to flat "sync" at n_hosts=1 and to
+   flat "local_sgd" at chips_per_host=1 (tested equivalences).
+
 τ=1 local_sgd and sync differ exactly as in the reference: sync averages
 gradients before the momentum update (one shared optimizer state), local_sgd
 averages weights after it (per-worker optimizer states).
@@ -43,8 +55,8 @@ from ..solvers.lr_policies import learning_rate
 from ..solvers.step import make_step_fns
 from ..solvers.update_rules import make_update_rule, preprocess_grads
 from .mesh import (
-    DATA_AXIS, batch_sharded, make_mesh, put_global_tree, replicated,
-    stage_local,
+    CHIP_AXIS, DATA_AXIS, HOST_AXIS, make_mesh, make_pod_mesh,
+    put_global_tree, replicated, stage_local,
 )
 
 try:  # jax >= 0.6 exposes shard_map at top level
@@ -55,7 +67,7 @@ except AttributeError:  # pragma: no cover
 
 @dataclasses.dataclass(frozen=True)
 class TrainerConfig:
-    strategy: str = "local_sgd"   # "local_sgd" | "sync"
+    strategy: str = "local_sgd"   # "local_sgd" | "sync" | "hierarchical"
     tau: int = 1                  # steps per round (local steps for local_sgd)
     donate: bool = True
     # Optional pure-JAX augmentation applied to each micro-batch INSIDE the
@@ -127,10 +139,24 @@ class DistributedTrainer:
                  config: TrainerConfig | None = None, *, seed: int = 0):
         self.sp = sp
         self.config = config or TrainerConfig()
-        if self.config.strategy not in ("local_sgd", "sync"):
+        if self.config.strategy not in ("local_sgd", "sync", "hierarchical"):
             raise ValueError(f"unknown strategy {self.config.strategy!r}")
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_workers = self.mesh.shape[DATA_AXIS]
+        if self.config.strategy == "hierarchical":
+            self.mesh = mesh if mesh is not None else make_pod_mesh()
+            if (HOST_AXIS not in self.mesh.shape
+                    or CHIP_AXIS not in self.mesh.shape):
+                raise ValueError(
+                    "hierarchical strategy needs a (host, chip) mesh — "
+                    "build it with make_pod_mesh()")
+            self.n_hosts = self.mesh.shape[HOST_AXIS]
+            self.n_chips = self.mesh.shape[CHIP_AXIS]
+            self.n_workers = self.n_hosts * self.n_chips
+            # batch rows shard over BOTH tiers; weights average over host
+            self._batch_axes: tuple[str, ...] = (HOST_AXIS, CHIP_AXIS)
+        else:
+            self.mesh = mesh if mesh is not None else make_mesh()
+            self.n_workers = self.mesh.shape[DATA_AXIS]
+            self._batch_axes = (DATA_AXIS,)
         net_param = sp.net_param or sp.train_net_param
         if net_param is None:
             raise ValueError("SolverParameter carries no net definition")
@@ -147,15 +173,17 @@ class DistributedTrainer:
         self.params: WeightCollection = put_global_tree(
             self.train_net.init(init_rng), rep)
         state0 = self.rule.init(self.params)
-        if self.config.strategy == "local_sgd":
-            # per-worker optimizer state: leading device axis, sharded
-            stacked = jax.tree_util.tree_map(
-                lambda x: jnp.broadcast_to(x[None], (self.n_workers,) + x.shape),
-                state0)
-            self.state = put_global_tree(
-                stacked, NamedSharding(self.mesh, P(DATA_AXIS)))
-        else:
+        if self.config.strategy == "sync":
             self.state = put_global_tree(state0, rep)
+        else:
+            # per-worker (local_sgd) / per-host (hierarchical) optimizer
+            # state: leading axis sharded over that tier, so each update
+            # domain keeps its own momentum history between averages
+            n, spec = self._state_tier()
+            stacked = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), state0)
+            self.state = put_global_tree(
+                stacked, NamedSharding(self.mesh, spec))
         self._lr_mults = put_global_tree(
             self.train_net.lr_mult_tree(self.params), rep)
         self._decay_mults = put_global_tree(
@@ -163,6 +191,13 @@ class DistributedTrainer:
 
         self._round = self._build_round()
         self._test_fwd = None
+
+    def _state_tier(self) -> tuple[int, P]:
+        """(leading-axis length, PartitionSpec) of the stacked optimizer
+        state for the strategies that keep one state per update domain."""
+        if self.config.strategy == "hierarchical":
+            return self.n_hosts, P(HOST_AXIS)
+        return self.n_workers, P(DATA_AXIS)
 
     # -- compiled round ---------------------------------------------------
     def _build_round(self):
@@ -200,24 +235,26 @@ class DistributedTrainer:
                 return micro
             return device_pre(micro, rng)
 
-        def sync_body(params, state, it, batches, rng):
-            """Per-step grad pmean (P2PSync semantics)."""
+        def make_psum_step(axis):
+            """One per-step-gradient-averaged update over ``axis`` — the
+            P2PSync step, shared verbatim by "sync" (over the flat data
+            axis) and "hierarchical" (over the chip axis within a host)."""
             def step(carry, micro):
                 params, state, it, rng = carry
                 rng, sub, pre_rng = jax.random.split(rng, 3)
-                sub = jax.random.fold_in(sub, lax.axis_index(DATA_AXIS))
+                ai = lax.axis_index(axis)
+                sub = jax.random.fold_in(sub, ai)
                 micro = maybe_preprocess(
-                    micro, jax.random.fold_in(pre_rng,
-                                              lax.axis_index(DATA_AXIS)))
+                    micro, jax.random.fold_in(pre_rng, ai))
                 loss, params, grads = accum_grads(params, micro, sub)
-                grads = lax.pmean(grads, DATA_AXIS)
-                loss = lax.pmean(loss, DATA_AXIS)
+                grads = lax.pmean(grads, axis)
+                loss = lax.pmean(loss, axis)
                 if state_keys:
                     # BN running stats diverge per shard; re-average those
-                    # blobs (and only those) so the replicated out_spec
-                    # stays truthful
+                    # blobs (and only those) so the replication the
+                    # out_spec claims over ``axis`` stays truthful
                     params = {
-                        k: (lax.pmean(v, DATA_AXIS) if k in state_keys else v)
+                        k: (lax.pmean(v, axis) if k in state_keys else v)
                         for k, v in params.items()}
                 grads = preprocess_grads(sp, params, grads, lr_mults,
                                          decay_mults)
@@ -225,9 +262,13 @@ class DistributedTrainer:
                 params, state = rule.apply(params, grads, state, rate, it,
                                            lr_mults=lr_mults)
                 return (params, state, it + 1, rng), loss
+            return step
 
+        def sync_body(params, state, it, batches, rng):
+            """Per-step grad pmean (P2PSync semantics)."""
             (params, state, it, _), losses = lax.scan(
-                step, (params, state, it, rng), split_micro(batches))
+                make_psum_step(DATA_AXIS), (params, state, it, rng),
+                split_micro(batches))
             return params, state, jnp.mean(losses)
 
         def local_sgd_body(params, state, it, batches, rng):
@@ -251,10 +292,34 @@ class DistributedTrainer:
             state = jax.tree_util.tree_map(lambda x: x[None], state)
             return params, state, loss
 
-        body = local_sgd_body if strategy == "local_sgd" else sync_body
-        state_spec = P(DATA_AXIS) if strategy == "local_sgd" else P()
+        def hierarchical_body(params, state, it, batches, rng):
+            """Per-step grad pmean over chips (the P2PSync step over the
+            fast tier), τ-boundary weight pmean over hosts (the Spark
+            round) — the two reference tiers composed on the
+            (host, chip) mesh.  BN running stats follow both tiers'
+            semantics: re-averaged per step over chips inside the psum
+            step, averaged with the weights at the τ boundary over
+            hosts."""
+            state = jax.tree_util.tree_map(lambda x: x[0], state)
+            rng = jax.random.fold_in(rng, lax.axis_index(HOST_AXIS))
+            (params, state, it, _), losses = lax.scan(
+                make_psum_step(CHIP_AXIS), (params, state, it, rng),
+                split_micro(batches))
+            # the cross-host averaging rides DCN once per τ steps — the
+            # broadcast → reduce → scalarDivide of the reference's outer
+            # loop (ImageNetApp.scala:102,178-179)
+            params = lax.pmean(params, HOST_AXIS)
+            loss = lax.pmean(jnp.mean(losses), HOST_AXIS)
+            state = jax.tree_util.tree_map(lambda x: x[None], state)
+            return params, state, loss
+
+        bodies = {"local_sgd": local_sgd_body, "sync": sync_body,
+                  "hierarchical": hierarchical_body}
+        body = bodies[strategy]
+        state_spec = (P() if strategy == "sync"
+                      else self._state_tier()[1])
         # batches: [tau, global_batch, ...] sharded on the batch axis
-        batch_spec = P(None, DATA_AXIS)
+        batch_spec = P(None, self._batch_axes)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -271,7 +336,7 @@ class DistributedTrainer:
         """Sharding for [τ, global_batch, ...] round feeds — batch axis over
         the mesh.  Feeds staged with this (e.g. via ``data.prefetch.
         device_feed``) make ``train_round``'s own device_put a no-op."""
-        return NamedSharding(self.mesh, P(None, DATA_AXIS))
+        return NamedSharding(self.mesh, P(None, self._batch_axes))
 
     @property
     def batches_per_round(self) -> int:
@@ -360,13 +425,13 @@ class DistributedTrainer:
                 scores = {k: reduce(k, val) for k, val in out.blobs.items()}
                 scores["__test_batches__"] = v
                 return jax.tree_util.tree_map(
-                    lambda t: lax.psum(t, DATA_AXIS), scores)
+                    lambda t: lax.psum(t, self._batch_axes), scores)
 
             self._test_fwd = jax.jit(shard_map(
                 worker, mesh=self.mesh,
-                in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+                in_specs=(P(), P(self._batch_axes), P(self._batch_axes)),
                 out_specs=P(), check_vma=False))
-        sharding = batch_sharded(self.mesh)
+        sharding = NamedSharding(self.mesh, P(self._batch_axes))
         local_workers = max(self.n_workers // jax.process_count(), 1)
         totals: dict[str, Any] = {}
         last_raw: dict[str, Any] | None = None
@@ -408,13 +473,16 @@ class DistributedTrainer:
     #    parity target per SURVEY.md §5 checkpoint/resume) ----------------
     def snapshot(self, path: str) -> None:
         from ..utils.checkpoint import save_checkpoint
-        save_checkpoint(path, {
+        blob = {
             "params": self.params,
             "state": self.state,
             "iter": self.iter,
             "strategy": self.config.strategy,
             "n_workers": self.n_workers,
-        })
+        }
+        if self.config.strategy == "hierarchical":
+            blob["n_hosts"] = self.n_hosts  # state is per-host
+        save_checkpoint(path, blob)
 
     def restore(self, path: str) -> None:
         from ..utils.checkpoint import load_checkpoint
@@ -430,11 +498,19 @@ class DistributedTrainer:
             raise ValueError(
                 f"checkpoint has {saved_workers} workers, mesh has "
                 f"{self.n_workers}")
+        if self.config.strategy == "hierarchical" and "n_hosts" in blob:
+            saved_hosts = int(blob["n_hosts"])
+            if saved_hosts != self.n_hosts:
+                raise ValueError(
+                    f"checkpoint has {saved_hosts} hosts, mesh has "
+                    f"{self.n_hosts} (per-host optimizer state does not "
+                    f"re-tile)")
         rep = replicated(self.mesh)
         self.params = put_global_tree(blob["params"], rep)
-        if self.config.strategy == "local_sgd":
-            self.state = put_global_tree(
-                blob["state"], NamedSharding(self.mesh, P(DATA_AXIS)))
-        else:
+        if self.config.strategy == "sync":
             self.state = put_global_tree(blob["state"], rep)
+        else:
+            self.state = put_global_tree(
+                blob["state"],
+                NamedSharding(self.mesh, self._state_tier()[1]))
         self.iter = int(blob["iter"])

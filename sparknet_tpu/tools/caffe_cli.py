@@ -146,7 +146,9 @@ def _train_multi(args, sp) -> int:
 
     # prefetch + async device_put with the trainer's round sharding, so
     # host DB reads for round R+1 overlap round R's device compute (the
-    # same device_feed path the single-device _train uses)
+    # same device_feed path the single-device _train uses); closed after
+    # the loop — the producer thread over the endless generator must not
+    # outlive training holding staged rounds in HBM
     from ..data.prefetch import device_feed
     rounds = device_feed(host_rounds(), sharding=trainer.input_sharding)
 
@@ -194,15 +196,17 @@ def _train_multi(args, sp) -> int:
               f"to the next round boundary "
               f"({math.ceil((max_iter - trainer.iter) / args.tau) * args.tau + trainer.iter})",
               file=sys.stderr)
-    while trainer.iter < max_iter:
-        prev = trainer.iter
-        loss = trainer.train_round(next(rounds))
-        if sp.display and prev // sp.display != trainer.iter // sp.display:
-            log_line(f"Iteration {trainer.iter}, loss = {loss:.6f}")
-        if (test_feed_src is not None and sp.test_interval
-                and prev // sp.test_interval
-                != trainer.iter // sp.test_interval):
-            eval_pass()
+    with rounds:
+        while trainer.iter < max_iter:
+            prev = trainer.iter
+            loss = trainer.train_round(next(rounds))
+            if (sp.display
+                    and prev // sp.display != trainer.iter // sp.display):
+                log_line(f"Iteration {trainer.iter}, loss = {loss:.6f}")
+            if (test_feed_src is not None and sp.test_interval
+                    and prev // sp.test_interval
+                    != trainer.iter // sp.test_interval):
+                eval_pass()
     if sp.snapshot_prefix:
         path = f"{sp.snapshot_prefix}_iter_{trainer.iter}.npz"
         trainer.snapshot(path)

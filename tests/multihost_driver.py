@@ -48,8 +48,17 @@ def main() -> None:
     from sparknet_tpu.proto import load_solver_prototxt_with_net
 
     distributed = init_cluster_from_env()
-    mesh = make_mesh()
-    n_devices = mesh.shape["data"]
+    if args.strategy == "hierarchical":
+        # host axis = real processes when distributed (so the weight
+        # averaging crosses the process boundary like DCN would); a
+        # single-process run folds the same 2-host topology virtually
+        from sparknet_tpu.parallel import make_pod_mesh
+        n_hosts = jax.process_count() if jax.process_count() > 1 else 2
+        mesh = make_pod_mesh(n_hosts)
+        n_devices = mesh.shape["host"] * mesh.shape["chip"]
+    else:
+        mesh = make_mesh()
+        n_devices = mesh.shape["data"]
     assert n_devices == args.expect_devices, (
         f"expected {args.expect_devices} global devices, got {n_devices}")
 

@@ -36,8 +36,12 @@ def _run_single(out, strategy):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
 
 
-@pytest.mark.parametrize("strategy", ["sync", "local_sgd"])
+@pytest.mark.parametrize("strategy", ["sync", "local_sgd", "hierarchical"])
 def test_two_process_matches_single_process(tmp_path, strategy):
+    """For "hierarchical" the two REAL processes are the two hosts of the
+    2x2 pod mesh — per-step chip psum stays process-local, the tau-boundary
+    weight average crosses the process boundary (the DCN tier), and the
+    result must equal the single-process 2x2 virtual pod."""
     from sparknet_tpu.tools.launch import launch_local
 
     single = str(tmp_path / f"single_{strategy}.npz")

@@ -489,3 +489,174 @@ def test_local_sgd_averages_bn_running_stats(np_rng):
             np.testing.assert_allclose(np.asarray(tr.params[k][i]), avg,
                                        rtol=2e-4, atol=2e-5,
                                        err_msg=f"{k}[{i}]")
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical two-level DP: (host, chip) mesh — per-step grad psum over
+# chips (P2PSync tier, parallel.cpp:271-360) x tau-step weight averaging
+# over hosts (Spark round tier, ImageNetApp.scala:100-182), composed.
+# ---------------------------------------------------------------------------
+
+from sparknet_tpu.parallel import make_pod_mesh
+
+
+def _tree_allclose(a, b, rtol=2e-4, atol=2e-5):
+    for k in a:
+        for i, blob in enumerate(a[k]):
+            np.testing.assert_allclose(
+                np.asarray(blob), np.asarray(b[k][i]), rtol=rtol, atol=atol,
+                err_msg=f"{k}[{i}]")
+
+
+def test_pod_mesh_shapes():
+    mesh = make_pod_mesh(2, 4)
+    assert mesh.shape == {"host": 2, "chip": 4}
+    with pytest.raises(ValueError):
+        make_pod_mesh(3, 4)  # 12 > 8 devices
+    with pytest.raises(ValueError, match="hierarchical"):
+        DistributedTrainer(
+            load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8)),
+            make_mesh(8), TrainerConfig(strategy="hierarchical"))
+
+
+def test_hierarchical_loss_decreases(np_rng):
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.01\nmomentum: 0.9\nlr_policy: "fixed"\n', lenet(32, 32))
+    tr = DistributedTrainer(sp, make_pod_mesh(2, 4),
+                            TrainerConfig(strategy="hierarchical", tau=5),
+                            seed=0)
+    assert tr.n_workers == 8 and tr.n_hosts == 2 and tr.n_chips == 4
+    losses = [tr.train_round(round_batches(np_rng, 5, 32)) for _ in range(6)]
+    assert losses[0] == pytest.approx(np.log(10), rel=0.3)
+    assert losses[-1] < 0.5 * losses[0]
+    assert tr.iter == 30
+
+
+def test_hierarchical_one_host_collapses_to_sync(np_rng):
+    """A 1xN pod has no host tier to average over: every round must match
+    the flat per-step-gradient strategy exactly (momentum included — the
+    single host owns the one optimizer state, like sync's)."""
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(16, 16))
+    hier = DistributedTrainer(sp, make_pod_mesh(1, 4),
+                              TrainerConfig(strategy="hierarchical", tau=2),
+                              seed=0)
+    flat = DistributedTrainer(sp, make_mesh(4),
+                              TrainerConfig(strategy="sync", tau=2), seed=0)
+    for _ in range(3):
+        batches = round_batches(np_rng, 2, 16)
+        lh = hier.train_round(batches)
+        lf = flat.train_round(batches)
+        assert lh == pytest.approx(lf, rel=1e-5)
+    _tree_allclose(hier.params, flat.params)
+
+
+def test_hierarchical_one_chip_collapses_to_local_sgd(np_rng):
+    """An Nx1 pod has no chip tier to psum over: every round must match
+    flat tau-step weight averaging exactly (per-worker == per-host
+    optimizer states)."""
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8))
+    hier = DistributedTrainer(sp, make_pod_mesh(4, 1),
+                              TrainerConfig(strategy="hierarchical", tau=3),
+                              seed=0)
+    flat = DistributedTrainer(sp, make_mesh(4),
+                              TrainerConfig(strategy="local_sgd", tau=3),
+                              seed=0)
+    for _ in range(2):
+        batches = round_batches(np_rng, 3, 16)
+        lh = hier.train_round(batches)
+        lf = flat.train_round(batches)
+        assert lh == pytest.approx(lf, rel=1e-5)
+    _tree_allclose(hier.params, flat.params)
+
+
+def test_hierarchical_tau1_plain_sgd_collapses_to_flat_sync(np_rng):
+    """With tau=1 and a stateless rule (momentum 0), averaging per-host
+    UPDATES equals updating with the all-device mean gradient, so a 2x4
+    pod matches flat 8-way sync across rounds (the update is linear in
+    the gradient)."""
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.05\nlr_policy: "fixed"\nweight_decay: 0.001\n',
+        lenet(16, 16))
+    hier = DistributedTrainer(sp, make_pod_mesh(2, 4),
+                              TrainerConfig(strategy="hierarchical", tau=1),
+                              seed=0)
+    flat = DistributedTrainer(sp, make_mesh(8),
+                              TrainerConfig(strategy="sync", tau=1), seed=0)
+    for _ in range(3):
+        batches = round_batches(np_rng, 1, 16)
+        hier.train_round(batches)
+        flat.train_round(batches)
+    _tree_allclose(hier.params, flat.params)
+
+
+def test_hierarchical_composition_replay(np_rng):
+    """The definitional test: a 2x2 tau=2 hierarchical round equals, per
+    host, a flat 2-chip sync trainer run on that host's rows for tau
+    rounds, with the two hosts' results then averaged by hand."""
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8))
+    tau = 2
+    hier = DistributedTrainer(sp, make_pod_mesh(2, 2),
+                              TrainerConfig(strategy="hierarchical",
+                                            tau=tau), seed=0)
+    init = jax.tree_util.tree_map(np.asarray, hier.params)
+    batches = round_batches(np_rng, tau, 16)  # [tau, 16, ...]
+    hier.train_round(batches)
+
+    host_params = []
+    for h in range(2):
+        sub = DistributedTrainer(sp, make_mesh(2),
+                                 TrainerConfig(strategy="sync", tau=1),
+                                 seed=0)
+        sub.params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x), init)
+        rows = {k: v[:, 8 * h:8 * (h + 1)] for k, v in batches.items()}
+        for t in range(tau):
+            sub.train_round({k: v[t][None] for k, v in rows.items()})
+        host_params.append(jax.tree_util.tree_map(np.asarray, sub.params))
+
+    avg = jax.tree_util.tree_map(
+        lambda a, b: (a + b) / 2, host_params[0], host_params[1])
+    _tree_allclose(hier.params, avg)
+
+
+def test_hierarchical_bn_one_host_matches_sync(np_rng):
+    """BatchNorm running stats under the chip tier follow sync's
+    per-step re-averaging (state_keys pmean over chips)."""
+    from sparknet_tpu.proto import load_net_prototxt
+    sp = load_solver_prototxt_with_net(SOLVER_TXT,
+                                       load_net_prototxt(BN_DP_NET))
+    hier = DistributedTrainer(sp, make_pod_mesh(1, 2),
+                              TrainerConfig(strategy="hierarchical", tau=2),
+                              seed=0)
+    flat = DistributedTrainer(sp, make_mesh(2),
+                              TrainerConfig(strategy="sync", tau=2), seed=0)
+    batches = {
+        "data": np_rng.normal(size=(2, 16, 1, 12, 12)).astype(np.float32),
+        "label": np_rng.integers(0, 5, size=(2, 16)).astype(np.float32),
+    }
+    hier.train_round(batches)
+    flat.train_round(batches)
+    _tree_allclose(hier.params, flat.params)
+
+
+def test_hierarchical_snapshot_restore(tmp_path, np_rng):
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(8, 8))
+    cfg = TrainerConfig(strategy="hierarchical", tau=2)
+    tr = DistributedTrainer(sp, make_pod_mesh(2, 2), cfg, seed=0)
+    tr.train_round(round_batches(np_rng, 2, 16))
+    path = str(tmp_path / "hier.npz")
+    tr.snapshot(path)
+
+    tr2 = DistributedTrainer(sp, make_pod_mesh(2, 2), cfg, seed=1)
+    tr2.restore(path)
+    assert tr2.iter == tr.iter
+    _tree_allclose(tr2.params, tr.params, rtol=0, atol=0)
+    # deterministic net: the next round from restored state matches
+    batches = round_batches(np_rng, 2, 16)
+    assert tr.train_round(batches) == pytest.approx(
+        tr2.train_round(batches), rel=1e-6)
+
+    # a different host tiling must be refused (per-host optimizer state)
+    tr41 = DistributedTrainer(sp, make_pod_mesh(4, 1), cfg, seed=0)
+    with pytest.raises(ValueError, match="hosts"):
+        tr41.restore(path)
