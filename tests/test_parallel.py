@@ -660,3 +660,48 @@ def test_hierarchical_snapshot_restore(tmp_path, np_rng):
     tr41 = DistributedTrainer(sp, make_pod_mesh(4, 1), cfg, seed=0)
     with pytest.raises(ValueError, match="hosts"):
         tr41.restore(path)
+
+
+def test_vmap_local_sgd_matches_mesh_trainer(np_rng):
+    """tools/learning_proxy.py runs 8-way local SGD on ONE chip by
+    vmapping the per-worker update over a stacked param/state axis and
+    averaging at the tau boundary; this pins that form against the mesh
+    trainer's local_sgd round (deterministic net, identical data
+    assignment), so the proxy's 8-way numbers speak for the mesh
+    implementation."""
+    from sparknet_tpu.graph.net import Net
+    from sparknet_tpu.proto import NetState, Phase
+    from sparknet_tpu.solvers.step import make_step_fns
+    from sparknet_tpu.solvers.update_rules import make_update_rule
+
+    W, tau, b = 2, 3, 8
+    sp = load_solver_prototxt_with_net(SOLVER_TXT, lenet(W * b, W * b))
+    tr = DistributedTrainer(sp, make_mesh(W),
+                            TrainerConfig(strategy="local_sgd", tau=tau),
+                            seed=0)
+    batches = round_batches(np_rng, tau, W * b)
+    tr.train_round(batches)
+
+    # the vmap form, exactly as the proxy builds it
+    net = Net(sp.net_param or sp.train_net_param, NetState(Phase.TRAIN))
+    rule = make_update_rule(sp)
+    rng0 = jax.random.PRNGKey(0)
+    _, init_rng = jax.random.split(rng0)     # the trainer's init chain
+    params0 = net.init(init_rng)
+    state0 = rule.init(params0)
+    _, local_update, _ = make_step_fns(
+        sp, net, rule, net.lr_mult_tree(params0),
+        net.decay_mult_tree(params0), in_scan=True)
+    vm = jax.vmap(local_update, in_axes=(0, 0, None, 0, 0))
+
+    stack = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), t)
+    wparams, wstate = stack(params0), stack(state0)
+    for t in range(tau):
+        # worker w sees rows [w*b:(w+1)*b] — the shard_map row split
+        micro = {k: jnp.asarray(v[t]).reshape((W, 1, b) + v[t].shape[1:])
+                 for k, v in batches.items()}
+        wparams, wstate, _ = vm(wparams, wstate, t,
+                                micro, jax.random.split(rng0, W))
+    avg = jax.tree_util.tree_map(lambda x: x.mean(0), wparams)
+    _tree_allclose(tr.params, avg)

@@ -776,3 +776,152 @@ def test_inception_aux_loss_trajectory_tracks_torch(tmp_path):
     np.testing.assert_allclose(
         np.asarray(final["stem"][0]), tm.p["stem.w"].detach().numpy(),
         rtol=1e-2, atol=1e-3)
+
+
+# -- siamese: shared weights + ContrastiveLoss end-to-end --------------------
+
+SIAMESE_NET = """
+name: "mini_siamese"
+input: "pair_data"
+input_shape { dim: 16 dim: 2 dim: 12 dim: 12 }
+input: "sim"
+input_shape { dim: 16 }
+layer { name: "slice_pair" type: "Slice" bottom: "pair_data"
+  top: "data" top: "data_p" slice_param { slice_dim: 1 slice_point: 1 } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  param { name: "conv1_w" lr_mult: 1 } param { name: "conv1_b" lr_mult: 2 }
+  convolution_param { num_output: 8 kernel_size: 3 stride: 1
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } } }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  param { name: "ip1_w" lr_mult: 1 } param { name: "ip1_b" lr_mult: 2 }
+  inner_product_param { num_output: 16 weight_filler { type: "xavier" }
+    bias_filler { type: "constant" } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "feat" type: "InnerProduct" bottom: "ip1" top: "feat"
+  param { name: "feat_w" lr_mult: 1 } param { name: "feat_b" lr_mult: 2 }
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" }
+    bias_filler { type: "constant" } } }
+layer { name: "conv1_p" type: "Convolution" bottom: "data_p" top: "conv1_p"
+  param { name: "conv1_w" lr_mult: 1 } param { name: "conv1_b" lr_mult: 2 }
+  convolution_param { num_output: 8 kernel_size: 3 stride: 1
+    weight_filler { type: "xavier" } bias_filler { type: "constant" } } }
+layer { name: "pool1_p" type: "Pooling" bottom: "conv1_p" top: "pool1_p"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1_p" type: "InnerProduct" bottom: "pool1_p" top: "ip1_p"
+  param { name: "ip1_w" lr_mult: 1 } param { name: "ip1_b" lr_mult: 2 }
+  inner_product_param { num_output: 16 weight_filler { type: "xavier" }
+    bias_filler { type: "constant" } } }
+layer { name: "relu1_p" type: "ReLU" bottom: "ip1_p" top: "ip1_p" }
+layer { name: "feat_p" type: "InnerProduct" bottom: "ip1_p" top: "feat_p"
+  param { name: "feat_w" lr_mult: 1 } param { name: "feat_b" lr_mult: 2 }
+  inner_product_param { num_output: 2 weight_filler { type: "xavier" }
+    bias_filler { type: "constant" } } }
+layer { name: "loss" type: "ContrastiveLoss"
+  bottom: "feat" bottom: "feat_p" bottom: "sim" top: "loss"
+  contrastive_loss_param { margin: 1.0 } }
+"""
+
+
+class TorchSiamese:
+    """mnist_siamese transcribed from the reference prototxt
+    (examples/siamese/mnist_siamese_train_test.prototxt, shrunk): ONE
+    set of weights applied to both slices of the pair — torch autograd
+    then sums the two branches' gradients into the shared tensors, which
+    is exactly Caffe's AppendParam owner-accumulation (net.cpp) that the
+    solver-side trajectory must reproduce."""
+
+    LAYERS = ["conv1", "ip1", "feat"]
+    LR_MULTS = {n: (1.0, 2.0) for n in LAYERS}
+
+    def __init__(self, caffemodel_blobs):
+        self.p = {}
+        self.hist = {}
+        for name in self.LAYERS:
+            # sharer layers (conv1_p, ...) carry the same blobs; owners
+            # are enough
+            w, b = caffemodel_blobs[name]
+            self.p[name + ".w"] = torch.tensor(np.asarray(w),
+                                               requires_grad=True)
+            self.p[name + ".b"] = torch.tensor(np.asarray(b),
+                                               requires_grad=True)
+        for k, v in self.p.items():
+            self.hist[k] = torch.zeros_like(v)
+
+    def branch(self, x):
+        p = self.p
+        h = F.conv2d(x, p["conv1.w"], p["conv1.b"])
+        h = F.max_pool2d(h, 2, 2, ceil_mode=True)
+        h = F.relu(F.linear(h.reshape(h.shape[0], -1),
+                            p["ip1.w"], p["ip1.b"]))
+        return F.linear(h, p["feat.w"], p["feat.b"])
+
+    def forward(self, pair, sim):
+        a = self.branch(pair[:, :1])
+        b = self.branch(pair[:, 1:])
+        # contrastive_loss_layer.cpp (non-legacy): y*d^2 +
+        # (1-y)*max(margin - d, 0)^2 over 2N; the +1e-12 inside the
+        # sqrt mirrors ops/loss.py's guard so gradients match exactly
+        d2 = ((a - b) ** 2).sum(dim=1)
+        dist = torch.clamp(1.0 - torch.sqrt(d2 + 1e-12), min=0.0)
+        loss = (sim * d2 + (1.0 - sim) * dist * dist).sum() / (2.0 * a.shape[0])
+        return loss
+
+    def sgd_step(self, loss, base_lr=0.01, momentum=0.9, wd=0.0005):
+        grads = torch.autograd.grad(loss, list(self.p.values()))
+        with torch.no_grad():
+            for (k, v), g in zip(self.p.items(), grads):
+                layer, kind = k.split(".")
+                lmw, lmb = self.LR_MULTS[layer]
+                local_lr = base_lr * (lmw if kind == "w" else lmb)
+                g = g + wd * v
+                self.hist[k] = local_lr * g + momentum * self.hist[k]
+                v -= self.hist[k]
+
+
+def test_siamese_shared_weight_trajectory_tracks_torch(tmp_path):
+    """End-to-end siamese training pin (examples/siamese/): the solver's
+    gradient ACCUMULATION through shared blobs — both branches' grads
+    summed into the owner before Regularize/momentum, Caffe's
+    AppendParam semantics — tracked against torch for 60 steps, weights
+    compared at the end."""
+    netp = load_net_prototxt(SIAMESE_NET)
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.01\nmomentum: 0.9\nweight_decay: 0.0005\n'
+        'lr_policy: "fixed"\n', netp)
+    solver = Solver(sp, seed=0)
+    tm = TorchSiamese(_export_initial_weights(solver, tmp_path))
+
+    n_steps, B = 60, 16
+    rng = np.random.default_rng(9)
+    batches = []
+    for _ in range(n_steps):
+        batches.append({
+            "pair_data": rng.normal(
+                size=(B, 2, 12, 12)).astype(np.float32),
+            "sim": rng.integers(0, 2, size=(B,)).astype(np.float32),
+        })
+    solver.set_train_data(iter(batches))
+    ours = []
+    for _ in range(n_steps):
+        solver.step(1)
+        ours.append(solver._smoothed[-1])
+    theirs = []
+    for b in batches:
+        loss = tm.forward(torch.tensor(b["pair_data"]),
+                          torch.tensor(b["sim"]))
+        tm.sgd_step(loss)
+        theirs.append(float(loss))
+    np.testing.assert_allclose(ours[:10], theirs[:10], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-2, atol=1e-3)
+    # final shared weights agree -> the two-branch accumulation into the
+    # owner matched step for step (the subtlest AppendParam behavior)
+    final = dict(_export_initial_weights(solver, tmp_path))
+    for name in TorchSiamese.LAYERS:
+        np.testing.assert_allclose(
+            np.asarray(final[name][0]), tm.p[name + ".w"].detach().numpy(),
+            rtol=1e-2, atol=1e-4, err_msg=name)
+    # and the sharer layers serialized the same (shared) blobs
+    np.testing.assert_array_equal(np.asarray(final["conv1"][0]),
+                                  np.asarray(final["conv1_p"][0]))
