@@ -53,8 +53,8 @@ def _template_bank(rng: np.random.Generator, n_classes: int,
 def synth_textures(n: int, *, seed: int, world_seed: int = 1234,
                    image_size: int = 32, template_size: int = 8,
                    per_class: int = 3, n_paste: int = 4,
-                   n_distract: int = 3, amp: float = 1.0,
-                   distract_amp: float = 0.6, noise: float = 1.0,
+                   n_distract: int = 4, amp: float = 0.9,
+                   distract_amp: float = 0.7, noise: float = 1.15,
                    n_classes: int = N_CLASSES
                    ) -> tuple[np.ndarray, np.ndarray]:
     """-> (x [n,3,S,S] float32 ~ pixel scale 0..255, y [n] int32).
