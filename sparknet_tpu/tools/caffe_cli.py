@@ -5,7 +5,8 @@
 Usage:
   python -m sparknet_tpu.tools.caffe_cli train --solver S.prototxt \
       [--snapshot X.solverstate | --weights W.caffemodel] \
-      [--devices N|all [--strategy sync|local_sgd] [--tau T]]
+      [--devices N|all [--strategy sync|local_sgd|hierarchical] \
+       [--tau T] [--hosts H]]
   python -m sparknet_tpu.tools.caffe_cli test --model M.prototxt \
       --weights W.caffemodel [--iterations 50]
   python -m sparknet_tpu.tools.caffe_cli time --model M.prototxt \
@@ -105,11 +106,26 @@ def _train_multi(args, sp) -> int:
     from ..utils.glog import log_line
 
     n = _device_count(args)
-    mesh = make_mesh(n)
+    if args.strategy == "hierarchical":
+        from ..parallel import make_pod_mesh
+        hosts = args.hosts if args.hosts is not None else max(1, n // 4)
+        if hosts < 1:
+            raise SystemExit(f"--hosts must be >= 1, got {hosts}")
+        if n % hosts:
+            raise SystemExit(
+                f"--devices {n} not divisible by --hosts {hosts}")
+        mesh = make_pod_mesh(hosts, n // hosts)
+        topo = f"{hosts}x{n // hosts} pod"
+    else:
+        if args.hosts is not None:
+            raise SystemExit(
+                "--hosts only applies to --strategy hierarchical")
+        mesh = make_mesh(n)
+        topo = f"{n} devices"
     trainer = DistributedTrainer(
         sp, mesh, TrainerConfig(strategy=args.strategy, tau=args.tau),
         seed=0)
-    print(f"Multi-device training: {n} devices, strategy={args.strategy}, "
+    print(f"Multi-device training: {topo}, strategy={args.strategy}, "
           f"tau={args.tau}")
     if args.weights:
         from ..solvers import Solver
@@ -289,13 +305,18 @@ def main(argv=None) -> int:
                    help="train data-parallel over N devices (or 'all') — "
                         "the `caffe train --gpu 0,1,.../all` analog "
                         "(caffe.cpp:81-103); prototxt batch is per device")
-    p.add_argument("--strategy", choices=["sync", "local_sgd"],
+    p.add_argument("--strategy",
+                   choices=["sync", "local_sgd", "hierarchical"],
                    default="sync",
                    help="sync: per-step gradient averaging (P2PSync "
                         "semantics); local_sgd: tau-step weight averaging "
-                        "(SparkNet rounds)")
+                        "(SparkNet rounds); hierarchical: both composed "
+                        "on a (host, chip) pod mesh")
     p.add_argument("--tau", type=int, default=1,
-                   help="steps per round for --strategy local_sgd")
+                   help="steps per round for local_sgd / hierarchical")
+    p.add_argument("--hosts", type=int, default=None,
+                   help="host-axis size for --strategy hierarchical "
+                        "(default: devices//4)")
     p.set_defaults(fn=_train)
     p = sub.add_parser("test")
     p.add_argument("--model", required=True)

@@ -118,13 +118,19 @@ snapshot: 1
     assert "acc =" in out
 
 
-@pytest.mark.parametrize("strategy,tau", [("sync", 1), ("local_sgd", 2)])
-def test_caffe_cli_train_multi_device(db_net, capsys, strategy, tau):
+@pytest.mark.parametrize("strategy,tau,devices,extra,topo", [
+    ("sync", 1, 2, [], "2 devices"),
+    ("local_sgd", 2, 2, [], "2 devices"),
+    ("hierarchical", 2, 4, ["--hosts", "2"], "2x2 pod"),
+])
+def test_caffe_cli_train_multi_device(db_net, capsys, strategy, tau,
+                                      devices, extra, topo):
     """`caffe train --devices N` routes to DistributedTrainer (the
     `caffe train --gpu 0,1` P2PSync path, caffe/tools/caffe.cpp:81-103,
     208-211), end to end from the CLI on the virtual CPU mesh: DB-backed
     feed fanned out one minibatch per device, loss/test logging, npz
-    snapshot."""
+    snapshot.  The hierarchical case drives the composed (host, chip)
+    pod from the same flag surface."""
     tmp_path, model = db_net
     solver = tmp_path / f"solver_{strategy}.prototxt"
     solver.write_text(f"""
@@ -138,12 +144,13 @@ test_iter: 2
 test_interval: 2
 snapshot_prefix: "{tmp_path / ('multi_' + strategy)}"
 """)
-    rc = caffe_cli.main(["train", "--solver", str(solver),
-                         "--devices", "2", "--strategy", strategy,
-                         "--tau", str(tau)])
+    args = ["train", "--solver", str(solver),
+            "--devices", str(devices), "--strategy", strategy,
+            "--tau", str(tau)] + extra
+    rc = caffe_cli.main(args)
     assert rc == 0
     out = capsys.readouterr().out
-    assert "Multi-device training: 2 devices" in out
+    assert f"Multi-device training: {topo}" in out
     assert f"strategy={strategy}" in out
     assert "loss = " in out and "Optimization Done." in out
     assert "Testing net (#0)" in out and "acc = " in out
@@ -153,10 +160,7 @@ snapshot_prefix: "{tmp_path / ('multi_' + strategy)}"
     # resume from the snapshot picks up at iter 4 and finishes cleanly
     solver.write_text(solver.read_text().replace("max_iter: 4",
                                                  "max_iter: 6"))
-    rc = caffe_cli.main(["train", "--solver", str(solver),
-                         "--devices", "2", "--strategy", strategy,
-                         "--tau", str(tau),
-                         "--snapshot", str(snap)])
+    rc = caffe_cli.main(args + ["--snapshot", str(snap)])
     assert rc == 0
     out = capsys.readouterr().out
     assert "Resuming from" in out and "(iter 4)" in out
