@@ -34,6 +34,11 @@ def _train(args) -> int:
     _resolve_solver_net(sp, args.solver)
     if _device_count(args) > 1:
         return _train_multi(args, sp)
+    if args.strategy != "sync" or args.tau != 1 or args.hosts is not None:
+        # distributed flags without --devices must not silently run the
+        # single-device path as if the strategy had been honored
+        raise SystemExit(
+            "--strategy/--tau/--hosts require --devices N|all (>1)")
     solver = Solver(sp, seed=0)
     if args.weights:
         solver.load_weights(args.weights)

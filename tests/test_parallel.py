@@ -705,3 +705,58 @@ def test_vmap_local_sgd_matches_mesh_trainer(np_rng):
                                 micro, jax.random.split(rng0, W))
     avg = jax.tree_util.tree_map(lambda x: x.mean(0), wparams)
     _tree_allclose(tr.params, avg)
+
+
+def test_vmap_hierarchical_matches_mesh_trainer(np_rng):
+    """make_host_step (tools/learning_proxy.py) — the single-chip vmap
+    restatement of the hierarchical strategy's per-step chip-mean update
+    — pinned against the mesh trainer's (host, chip) round, so the
+    proxy's hierarchical curve speaks for the mesh implementation."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "learning_proxy",
+        os.path.join(os.path.dirname(__file__), os.pardir,
+                     "tools", "learning_proxy.py"))
+    lp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lp)
+
+    from sparknet_tpu.graph.net import Net
+    from sparknet_tpu.proto import NetState, Phase
+    from sparknet_tpu.solvers.step import make_step_fns
+    from sparknet_tpu.solvers.update_rules import make_update_rule
+
+    H, C, tau, b = 2, 2, 2, 4
+    sp = load_solver_prototxt_with_net(SOLVER_TXT,
+                                       lenet(H * C * b, H * C * b))
+    tr = DistributedTrainer(sp, make_pod_mesh(H, C),
+                            TrainerConfig(strategy="hierarchical",
+                                          tau=tau), seed=0)
+    batches = round_batches(np_rng, tau, H * C * b)
+    tr.train_round(batches)
+
+    net = Net(sp.net_param or sp.train_net_param, NetState(Phase.TRAIN))
+    rule = make_update_rule(sp)
+    rng0 = jax.random.PRNGKey(0)
+    _, init_rng = jax.random.split(rng0)     # the trainer's init chain
+    params0 = net.init(init_rng)
+    state0 = rule.init(params0)
+    lr_m = net.lr_mult_tree(params0)
+    dc_m = net.decay_mult_tree(params0)
+    _, _, accum = make_step_fns(sp, net, rule, lr_m, dc_m, in_scan=True)
+    host_step = lp.make_host_step(sp, rule, lr_m, dc_m, accum)
+    vm_host = jax.vmap(host_step, in_axes=(0, 0, None, 0, 0))
+
+    stack = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (H,) + x.shape), t)
+    hparams, hstate = stack(params0), stack(state0)
+    for t in range(tau):
+        # mesh batch rows shard host-major over (host, chip)
+        micro = {k: jnp.asarray(v[t]).reshape((H, C, 1, b)
+                                              + v[t].shape[1:])
+                 for k, v in batches.items()}
+        rngs = jax.random.split(rng0, H * C).reshape(H, C, 2)
+        hparams, hstate, _ = vm_host(hparams, hstate, t, micro, rngs)
+    avg = jax.tree_util.tree_map(lambda x: x.mean(0), hparams)
+    _tree_allclose(tr.params, avg)

@@ -64,9 +64,38 @@ def build(sp_text, net):
     state = rule.init(params)
     lr_mults = train_net.lr_mult_tree(params)
     decay_mults = train_net.decay_mult_tree(params)
-    _, local_update, _ = make_step_fns(sp, train_net, rule, lr_mults,
-                                       decay_mults, in_scan=True)
-    return sp, train_net, test_net, params, state, local_update
+    _, local_update, accum = make_step_fns(sp, train_net, rule, lr_mults,
+                                           decay_mults, in_scan=True)
+    pieces = (rule, lr_mults, decay_mults, accum)
+    return sp, train_net, test_net, params, state, local_update, pieces
+
+
+def make_host_step(sp, rule, lr_mults, decay_mults, accum):
+    """One per-step-gradient-mean update for ONE host of the hierarchical
+    strategy — the single-chip restatement of the mesh trainer's
+    ``make_psum_step`` (parallel/trainer.py): vmap grad-accum over the
+    chip axis, mean the gradients, apply one update.  Module-level so
+    tests can pin it against the mesh trainer
+    (tests/test_parallel.py::test_vmap_hierarchical_matches_mesh_trainer).
+    Sound only for nets with no stateful (BN) layers — callers assert."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.solvers.lr_policies import learning_rate
+    from sparknet_tpu.solvers.update_rules import preprocess_grads
+
+    def host_step(params, state, it, micro, rngs):
+        loss, params_bn, grads = jax.vmap(
+            accum, in_axes=(None, 0, 0))(params, micro, rngs)
+        grads = jax.tree_util.tree_map(lambda g: g.mean(0), grads)
+        params = jax.tree_util.tree_map(lambda x: x[0], params_bn)
+        grads = preprocess_grads(sp, params, grads, lr_mults, decay_mults)
+        rate = learning_rate(sp, it)
+        params, state = rule.apply(params, grads, state, rate, it,
+                                   lr_mults=lr_mults)
+        return params, state, jnp.mean(loss)
+
+    return host_step
 
 
 def main(argv=None) -> int:
@@ -116,8 +145,9 @@ def main(argv=None) -> int:
     vx = jax.device_put(jnp.asarray(test_x - mean))
     vy = jax.device_put(jnp.asarray(test_y, jnp.float32))
 
-    sp, train_net, test_net, params0, state0, local_update = build(
+    sp, train_net, test_net, params0, state0, local_update, pieces = build(
         sp_text, cifar10_full(batch, batch))
+    rule, lr_mults, decay_mults, accum = pieces
 
     # -- compiled eval over a resident split -----------------------------
     @jax.jit
@@ -161,12 +191,7 @@ def main(argv=None) -> int:
             params, state, loss = chunk_1x(params, state, it,
                                            jnp.asarray(idxs), sub)
             it += n
-            row = {"iter": it,
-                   "lr": float(learning_rate(sp, it - 1)),
-                   "train_loss": float(loss),
-                   "train_acc": float(accuracy(params, tx[:args.n_test],
-                                               ty[:args.n_test])),
-                   "test_acc": float(accuracy(params, vx, vy))}
+            row = make_row(it, loss, params)
             curve.append(row)
             print(f"1x   iter {it:5d} lr {row['lr']:.0e} "
                   f"loss {row['train_loss']:.3f} "
@@ -210,13 +235,22 @@ def main(argv=None) -> int:
             round_body, (wparams, wstate, it0, rng), idxs)
         return wparams, wstate, jnp.mean(losses)
 
-    def run_8way():
-        rng_idx = np.random.default_rng(6)
-        wparams = jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), params0)
-        wstate = jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), state0)
-        rng = jax.random.PRNGKey(200)
+    def make_row(it, loss, params):
+        return {"iter": it,
+                "lr": float(learning_rate(sp, it - 1)),
+                "train_loss": float(loss),
+                "train_acc": float(accuracy(params, tx[:args.n_test],
+                                            ty[:args.n_test])),
+                "test_acc": float(accuracy(params, vx, vy))}
+
+    def run_stacked(tag, n_lead, rounds_fn, idx_tail, idx_seed, key):
+        """Shared round-driver for the stacked (leading worker/host axis)
+        strategies: chunked compiled rounds + eval/print per interval."""
+        rng_idx = np.random.default_rng(idx_seed)
+        stack = lambda x: jnp.broadcast_to(x[None], (n_lead,) + x.shape)
+        sparams = jax.tree_util.tree_map(stack, params0)
+        sstate = jax.tree_util.tree_map(stack, state0)
+        rng = jax.random.PRNGKey(key)
         curve = []
         it = 0
         rounds_per_eval = max(args.eval_every // tau, 1)
@@ -225,24 +259,68 @@ def main(argv=None) -> int:
             if n_rounds == 0:
                 break
             idxs = rng_idx.integers(
-                0, part, size=(n_rounds, tau, W, batch))
+                0, part, size=(n_rounds, tau) + idx_tail)
             rng, sub = jax.random.split(rng)
-            wparams, wstate, loss = rounds_8way(
-                wparams, wstate, it, jnp.asarray(idxs), sub)
+            sparams, sstate, loss = rounds_fn(
+                sparams, sstate, it, jnp.asarray(idxs), sub)
             it += n_rounds * tau
-            params = jax.tree_util.tree_map(lambda x: x[0], wparams)
-            row = {"iter": it,
-                   "lr": float(learning_rate(sp, it - 1)),
-                   "train_loss": float(loss),
-                   "train_acc": float(accuracy(params, tx[:args.n_test],
-                                               ty[:args.n_test])),
-                   "test_acc": float(accuracy(params, vx, vy))}
+            params = jax.tree_util.tree_map(lambda x: x[0], sparams)
+            row = make_row(it, loss, params)
             curve.append(row)
-            print(f"8way iter {it:5d} lr {row['lr']:.0e} "
+            print(f"{tag:4s} iter {it:5d} lr {row['lr']:.0e} "
                   f"loss {row['train_loss']:.3f} "
                   f"train_acc {row['train_acc']:.3f} "
                   f"test_acc {row['test_acc']:.3f}", flush=True)
         return curve
+
+    def run_8way():
+        return run_stacked("8way", W, rounds_8way, (W, batch), 6, 200)
+
+    # -- hierarchical: 2 hosts x 4 chips on the same 8 partitions --------
+    # per-step chip-mean gradients within each host + one per-host
+    # update, tau-boundary weight average across hosts — the trainer's
+    # "hierarchical" strategy restated for one chip (make_host_step,
+    # pinned against the mesh trainer by
+    # tests/test_parallel.py::test_vmap_hierarchical_matches_mesh_trainer).
+    # Sound here because cifar10_full has no stateful (BN) layers:
+    assert not any(getattr(n.impl, "has_state", False)
+                   for n in train_net.nodes)
+    H = 2
+    C = W // H
+
+    host_step = make_host_step(sp, rule, lr_mults, decay_mults, accum)
+    vm_host = jax.vmap(host_step, in_axes=(0, 0, None, 0, 0))
+
+    @jax.jit
+    def rounds_hier(hparams, hstate, it0, idxs, rng):
+        """idxs: [n_rounds, tau, H, C, batch] partition-local indices."""
+        def round_body(carry, round_idx):
+            hparams, hstate, it, rng = carry
+
+            def step(c, step_idx):
+                hparams, hstate, it, rng = c
+                rng, sub = jax.random.split(rng)
+                subs = jax.random.split(sub, H * C).reshape(H, C, 2)
+                offs = (jnp.arange(H * C) * part).reshape(H, C)[..., None]
+                b = {"data": tx[step_idx + offs][:, :, None],
+                     "label": ty[step_idx + offs][:, :, None]}
+                hparams, hstate, loss = vm_host(hparams, hstate, it, b,
+                                                subs)
+                return (hparams, hstate, it + 1, rng), jnp.mean(loss)
+
+            (hparams, hstate, it, rng), losses = lax.scan(
+                step, (hparams, hstate, it, rng), round_idx)
+            hparams = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x.mean(0, keepdims=True),
+                                           x.shape), hparams)
+            return (hparams, hstate, it, rng), jnp.mean(losses)
+
+        (hparams, hstate, it, _), losses = lax.scan(
+            round_body, (hparams, hstate, it0, rng), idxs)
+        return hparams, hstate, jnp.mean(losses)
+
+    def run_hier():
+        return run_stacked("hier", H, rounds_hier, (H, C, batch), 7, 300)
 
     t0 = time.time()
     curve_1x = run_1x()
@@ -250,9 +328,13 @@ def main(argv=None) -> int:
     t0 = time.time()
     curve_8 = run_8way()
     t_8 = time.time() - t0
+    t0 = time.time()
+    curve_h = run_hier()
+    t_h = time.time() - t0
 
     final_1x = curve_1x[-1]
     final_8 = curve_8[-1]
+    final_h = curve_h[-1]
     at_drop = [r for r in curve_1x if r["iter"] <= steps[0]]
     pre_drop = at_drop[-1] if at_drop else curve_1x[0]
     result = {
@@ -261,24 +343,31 @@ def main(argv=None) -> int:
                          "lr 0.001, x0.1 @ 60000 and 65000, stop 70000",
             "scale": S, "max_iter": max_iter, "stepvalues": list(steps),
             "batch": batch, "n_train": args.n_train, "n_test": args.n_test,
-            "workers": W, "tau": tau,
+            "workers": W, "tau": tau, "hier_topology": f"{H}x{C}",
             "dataset": "synthgen class-conditional textures + distractors "
                        "+ noise (Bayes error > 0)",
         },
         "device": f"{dev.platform}/{dev.device_kind}",
         "curve_1x": curve_1x,
         "curve_8way": curve_8,
+        "curve_hier": curve_h,
         "final": {
             "acc_1x": final_1x["test_acc"],
             "acc_8way": final_8["test_acc"],
+            "acc_hier": final_h["test_acc"],
             "delta": round(final_8["test_acc"] - final_1x["test_acc"], 4),
+            "delta_hier": round(
+                final_h["test_acc"] - final_1x["test_acc"], 4),
             "train_test_gap_1x": round(
                 final_1x["train_acc"] - final_1x["test_acc"], 4),
             "train_test_gap_8way": round(
                 final_8["train_acc"] - final_8["test_acc"], 4),
+            "train_test_gap_hier": round(
+                final_h["train_acc"] - final_h["test_acc"], 4),
             "lr_drop_response_1x": round(
                 final_1x["test_acc"] - pre_drop["test_acc"], 4),
             "wall_s_1x": round(t_1x, 1), "wall_s_8way": round(t_8, 1),
+            "wall_s_hier": round(t_h, 1),
         },
     }
     with open(args.out, "w") as f:
