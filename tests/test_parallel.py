@@ -760,3 +760,45 @@ def test_vmap_hierarchical_matches_mesh_trainer(np_rng):
         hparams, hstate, _ = vm_host(hparams, hstate, t, micro, rngs)
     avg = jax.tree_util.tree_map(lambda x: x.mean(0), hparams)
     _tree_allclose(tr.params, avg)
+
+
+def test_hierarchical_bn_composition_replay(np_rng):
+    """BN running stats under the COMPOSED topology (2 hosts x 2 chips,
+    tau=2): each host behaves as a flat 2-chip sync trainer on its rows
+    (per-step chip re-averaging of the stats), and the tau boundary
+    averages them across hosts with the weights — pinned against that
+    exact replay."""
+    from sparknet_tpu.proto import load_net_prototxt
+
+    sp = load_solver_prototxt_with_net(SOLVER_TXT,
+                                       load_net_prototxt(BN_DP_NET))
+    tau = 2
+    hier = DistributedTrainer(sp, make_pod_mesh(2, 2),
+                              TrainerConfig(strategy="hierarchical",
+                                            tau=tau), seed=0)
+    init = jax.tree_util.tree_map(np.asarray, hier.params)
+    batches = {
+        "data": np_rng.normal(size=(tau, 16, 1, 12, 12)).astype(np.float32),
+        "label": np_rng.integers(0, 5, size=(tau, 16)).astype(np.float32),
+    }
+    hier.train_round(batches)
+
+    host_params = []
+    for h in range(2):
+        sub = DistributedTrainer(sp, make_mesh(2),
+                                 TrainerConfig(strategy="sync", tau=1),
+                                 seed=0)
+        sub.params = jax.tree_util.tree_map(jnp.asarray, init)
+        rows = {k: v[:, 8 * h:8 * (h + 1)] for k, v in batches.items()}
+        for t in range(tau):
+            sub.train_round({k: v[t][None] for k, v in rows.items()})
+        host_params.append(jax.tree_util.tree_map(np.asarray, sub.params))
+
+    # the BN running stats genuinely diverged across the two hosts
+    # (the host average is non-trivial)
+    for i in (0, 1):
+        assert not np.allclose(host_params[0]["bn1"][i],
+                               host_params[1]["bn1"][i])
+    avg = jax.tree_util.tree_map(
+        lambda a, b: (a + b) / 2, host_params[0], host_params[1])
+    _tree_allclose(hier.params, avg)
