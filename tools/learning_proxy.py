@@ -109,7 +109,18 @@ def main(argv=None) -> int:
     ap.add_argument("--eval-every", type=int, default=250)
     ap.add_argument("--out", default="RESULTS_learning_proxy.json")
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--runs", default="1x,8way,hier",
+                    help="which curves to execute this invocation")
+    ap.add_argument("--merge", default=None,
+                    help="JSON (a previous out or .partial) supplying "
+                         "curves not in --runs — resume after a tunnel "
+                         "drop without redoing finished runs")
     args = ap.parse_args(argv)
+    selected = set(args.runs.split(","))
+    merged = {}
+    if args.merge:
+        with open(args.merge) as f:
+            merged = json.load(f)
 
     import jax
     if args.platform:
@@ -322,15 +333,33 @@ def main(argv=None) -> int:
     def run_hier():
         return run_stacked("hier", H, rounds_hier, (H, C, batch), 7, 300)
 
-    t0 = time.time()
-    curve_1x = run_1x()
-    t_1x = time.time() - t0
-    t0 = time.time()
-    curve_8 = run_8way()
-    t_8 = time.time() - t0
-    t0 = time.time()
-    curve_h = run_hier()
-    t_h = time.time() - t0
+    partial: dict = {}
+
+    def checkpoint_partial():
+        """Persist what exists so a tunnel outage mid-run (this rig's
+        known failure mode) loses one curve, not the whole session;
+        resume with --runs <remaining> --merge <out>.partial."""
+        with open(args.out + ".partial", "w") as f:
+            json.dump({"partial": True, **partial}, f)
+
+    def execute(tag, key, wall_key, run_fn):
+        """Run the curve if selected, else take it from --merge."""
+        if tag in selected:
+            t0 = time.time()
+            curve = run_fn()
+            partial[key] = curve
+            partial[wall_key] = round(time.time() - t0, 1)
+            checkpoint_partial()
+            return curve, partial[wall_key]
+        if key not in merged:
+            raise SystemExit(
+                f"run {tag!r} not selected and {key!r} absent from "
+                f"--merge; pass --runs {tag} or a merge file that has it")
+        return merged[key], merged.get(wall_key)
+
+    curve_1x, t_1x = execute("1x", "curve_1x", "wall_s_1x", run_1x)
+    curve_8, t_8 = execute("8way", "curve_8way", "wall_s_8way", run_8way)
+    curve_h, t_h = execute("hier", "curve_hier", "wall_s_hier", run_hier)
 
     final_1x = curve_1x[-1]
     final_8 = curve_8[-1]
@@ -366,8 +395,7 @@ def main(argv=None) -> int:
                 final_h["train_acc"] - final_h["test_acc"], 4),
             "lr_drop_response_1x": round(
                 final_1x["test_acc"] - pre_drop["test_acc"], 4),
-            "wall_s_1x": round(t_1x, 1), "wall_s_8way": round(t_8, 1),
-            "wall_s_hier": round(t_h, 1),
+            "wall_s_1x": t_1x, "wall_s_8way": t_8, "wall_s_hier": t_h,
         },
     }
     with open(args.out, "w") as f:
