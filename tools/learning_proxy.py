@@ -115,6 +115,8 @@ def main(argv=None) -> int:
                     help="JSON (a previous out or .partial) supplying "
                          "curves not in --runs — resume after a tunnel "
                          "drop without redoing finished runs")
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore <out>.resume_* checkpoints")
     args = ap.parse_args(argv)
     selected = set(args.runs.split(","))
     merged = {}
@@ -175,6 +177,41 @@ def main(argv=None) -> int:
         total, _ = lax.scan(body, jnp.zeros(()), jnp.arange(nb))
         return total / nb
 
+    # -- in-curve resume ------------------------------------------------
+    # The rig's tunnel resets long-lived connections (~15-20 min under
+    # sustained load), killing the process's backend.  Each eval chunk
+    # therefore checkpoints (iter, params/state, curve) to host-side
+    # npz; a fresh invocation restores it bit-exactly — the rng and
+    # index streams are chunk-indexed, so fast-forwarding them by the
+    # completed-chunk count reproduces the uninterrupted run exactly.
+    # A transient backend error exits rc=17; loop the invocation until
+    # rc 0 (see the RESULTS runbook note).
+    def _resume_path(tag):
+        return f"{args.out}.resume_{tag}.npz"
+
+    def _save_resume(tag, it, tree, curve):
+        leaves = jax.tree_util.tree_leaves(tree)
+        np.savez(_resume_path(tag), __iter__=it,
+                 __curve__=json.dumps(curve),
+                 **{f"l{i}": np.asarray(x) for i, x in enumerate(leaves)})
+
+    def _load_resume(tag, template):
+        path = _resume_path(tag)
+        if args.fresh or not os.path.exists(path):
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        with np.load(path) as z:
+            it = int(z["__iter__"])
+            curve = json.loads(str(z["__curve__"]))
+            new = [jnp.asarray(z[f"l{i}"]) for i in range(len(leaves))]
+        return it, jax.tree_util.tree_unflatten(treedef, new), curve
+
+    def _transient_exit(tag, it, err):
+        print(f"{tag}: backend lost at iter {it} ({type(err).__name__}); "
+              f"resume checkpoint is on disk — rerun to continue",
+              flush=True)
+        raise SystemExit(17)
+
     # -- 1x: the published config as-is ----------------------------------
     @jax.jit
     def chunk_1x(params, state, it0, idxs, rng):
@@ -195,15 +232,27 @@ def main(argv=None) -> int:
         rng = jax.random.PRNGKey(100)
         curve = []
         it = 0
+        r = _load_resume("1x", (params0, state0))
+        if r:
+            it, (params, state), curve = r
+            for _ in range(it // args.eval_every):  # fast-forward streams
+                rng_idx.integers(0, args.n_train,
+                                 size=(args.eval_every, batch))
+                rng, _ = jax.random.split(rng)
+            print(f"1x   resuming at iter {it}", flush=True)
         while it < max_iter:
             n = min(args.eval_every, max_iter - it)
             idxs = rng_idx.integers(0, args.n_train, size=(n, batch))
             rng, sub = jax.random.split(rng)
-            params, state, loss = chunk_1x(params, state, it,
-                                           jnp.asarray(idxs), sub)
-            it += n
-            row = make_row(it, loss, params)
+            try:
+                params, state, loss = chunk_1x(params, state, it,
+                                               jnp.asarray(idxs), sub)
+                it += n
+                row = make_row(it, loss, params)
+            except jax.errors.JaxRuntimeError as e:
+                _transient_exit("1x", it, e)
             curve.append(row)
+            _save_resume("1x", it, (params, state), curve)
             print(f"1x   iter {it:5d} lr {row['lr']:.0e} "
                   f"loss {row['train_loss']:.3f} "
                   f"train_acc {row['train_acc']:.3f} "
@@ -265,6 +314,15 @@ def main(argv=None) -> int:
         curve = []
         it = 0
         rounds_per_eval = max(args.eval_every // tau, 1)
+        chunk_iters = rounds_per_eval * tau
+        r = _load_resume(tag, (sparams, sstate))
+        if r:
+            it, (sparams, sstate), curve = r
+            for _ in range(it // chunk_iters):     # fast-forward streams
+                rng_idx.integers(0, part,
+                                 size=(rounds_per_eval, tau) + idx_tail)
+                rng, _ = jax.random.split(rng)
+            print(f"{tag:4s} resuming at iter {it}", flush=True)
         while it < max_iter:
             n_rounds = min(rounds_per_eval, (max_iter - it) // tau)
             if n_rounds == 0:
@@ -272,12 +330,16 @@ def main(argv=None) -> int:
             idxs = rng_idx.integers(
                 0, part, size=(n_rounds, tau) + idx_tail)
             rng, sub = jax.random.split(rng)
-            sparams, sstate, loss = rounds_fn(
-                sparams, sstate, it, jnp.asarray(idxs), sub)
-            it += n_rounds * tau
-            params = jax.tree_util.tree_map(lambda x: x[0], sparams)
-            row = make_row(it, loss, params)
+            try:
+                sparams, sstate, loss = rounds_fn(
+                    sparams, sstate, it, jnp.asarray(idxs), sub)
+                it += n_rounds * tau
+                params = jax.tree_util.tree_map(lambda x: x[0], sparams)
+                row = make_row(it, loss, params)
+            except jax.errors.JaxRuntimeError as e:
+                _transient_exit(tag, it, e)
             curve.append(row)
+            _save_resume(tag, it, (sparams, sstate), curve)
             print(f"{tag:4s} iter {it:5d} lr {row['lr']:.0e} "
                   f"loss {row['train_loss']:.3f} "
                   f"train_acc {row['train_acc']:.3f} "
