@@ -742,3 +742,40 @@ def test_detector_channel_swap_and_vector_mean(tmp_path):
     p1 = base.detect_windows([(img, wins)])[0]["prediction"]
     p2 = swapped.detect_windows([(img[::-1], wins)])[0]["prediction"]
     np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_caffe_cli_multi_device_weights_and_errors(db_net, capsys):
+    """--devices finetune path (--weights from a single-device
+    .caffemodel) plus the clean-error contracts: non-integer --devices,
+    .solverstate resume rejection, distributed flags without --devices."""
+    tmp_path, model = db_net
+    solver = tmp_path / "solver_w.prototxt"
+    solver.write_text(f"""
+net: "{model}"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 2
+snapshot_prefix: "{tmp_path / 'seed'}"
+snapshot: 1
+""")
+    assert caffe_cli.main(["train", "--solver", str(solver)]) == 0
+    capsys.readouterr()
+    weights = tmp_path / "seed_iter_2.caffemodel"
+    state = tmp_path / "seed_iter_2.solverstate"
+    assert weights.exists() and state.exists()
+
+    rc = caffe_cli.main(["train", "--solver", str(solver),
+                         "--devices", "2", "--weights", str(weights)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Finetuning from" in out and "Optimization Done." in out
+
+    with pytest.raises(SystemExit, match="integer or 'all'"):
+        caffe_cli.main(["train", "--solver", str(solver),
+                        "--devices", "two"])
+    with pytest.raises(SystemExit, match="solverstate"):
+        caffe_cli.main(["train", "--solver", str(solver),
+                        "--devices", "2", "--snapshot", str(state)])
+    with pytest.raises(SystemExit, match="require --devices"):
+        caffe_cli.main(["train", "--solver", str(solver),
+                        "--strategy", "local_sgd"])
