@@ -18,3 +18,17 @@ def test_pycaffe_workflow_example(capsys):
         os.chdir(cwd)
     out = capsys.readouterr().out
     assert "OK" in out and "class probabilities" in out
+
+
+def test_distributed_workflow_example(capsys):
+    cwd = os.getcwd()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        runpy.run_path(
+            os.path.join(repo, "examples", "distributed_workflow.py"),
+            run_name="__main__")
+    finally:
+        os.chdir(cwd)
+    out = capsys.readouterr().out
+    assert "OK: distributed workflow complete" in out
+    assert "hierarchical 2x4" in out
