@@ -1,0 +1,136 @@
+"""Drive the resilient-runtime PR end-to-end through the public surface.
+
+Run from repo root: python .drive_r6.py
+"""
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+print("== 1. base training still works (happy path) ==")
+import itertools
+from sparknet_tpu.models import lenet
+from sparknet_tpu.proto import load_solver_prototxt_with_net
+from sparknet_tpu.solvers import Solver
+from sparknet_tpu.data.minibatch import batch_feed
+
+rng = np.random.default_rng(0)
+xs = rng.normal(scale=0.3, size=(128, 1, 28, 28)).astype(np.float32)
+ys = rng.integers(0, 10, size=128)
+for i, k in enumerate(ys):
+    xs[i, :, int(k) % 28, :] += 2.0
+batches = [(xs[i:i + 32], ys[i:i + 32].astype(np.float32))
+           for i in range(0, 128, 32)]
+sp = load_solver_prototxt_with_net(
+    'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n', lenet(32, 32))
+solver = Solver(sp, seed=0)
+solver.set_train_data(batch_feed(itertools.cycle(batches), None))
+l0 = solver.step(5)
+l1 = solver.step(35)
+print(f"loss {l0:.3f} -> {l1:.3f}")
+assert l1 < l0, "loss did not drop"
+
+print("== 2. round-granular checkpoint/resume via DistributedTrainer ==")
+from sparknet_tpu.parallel import DistributedTrainer, TrainerConfig, make_mesh
+
+ckdir = tempfile.mkdtemp()
+
+
+def round_batch(r):
+    g = np.random.default_rng(500 + r)
+    return {"data": g.normal(size=(2, 16, 1, 28, 28)).astype(np.float32),
+            "label": g.integers(0, 10, size=(2, 16)).astype(np.float32)}
+
+
+cfg = TrainerConfig(strategy="local_sgd", tau=2, checkpoint_dir=ckdir,
+                    checkpoint_every=1, checkpoint_keep=3)
+sp2 = load_solver_prototxt_with_net(
+    'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n', lenet(16, 16))
+tr = DistributedTrainer(sp2, make_mesh(4), cfg, seed=0)
+for r in range(3):
+    tr.train_round(round_batch(r))
+tr2 = DistributedTrainer(sp2, make_mesh(4), cfg, seed=123)
+assert tr2.resumed and tr2.round == 3 and tr2.iter == 6, tr2.resumed
+tr.train_round(round_batch(3))
+tr2.train_round(round_batch(3))
+np.testing.assert_allclose(np.asarray(tr2.params["conv1"][0]),
+                           np.asarray(tr.params["conv1"][0]))
+print(f"resumed at round 3, continuation exact; files: "
+      f"{sorted(os.listdir(ckdir))}")
+
+print("== 3. corrupt newest snapshot -> fallback to previous manifest ==")
+from sparknet_tpu.utils import faults
+faults.scribble(os.path.join(ckdir, "ckpt_round_00000004.npz"))
+tr3 = DistributedTrainer(sp2, make_mesh(4), cfg, seed=5)
+assert tr3.resumed and tr3.round == 3, (tr3.resumed, tr3.round)
+print(f"fell back to {tr3.resumed['file']}")
+shutil.rmtree(ckdir)
+
+print("== 4. ResilientRunner: real crash -> restart -> exact recovery ==")
+from sparknet_tpu.parallel import ResilientRunner, RestartPolicy
+from sparknet_tpu.tools.launch import launch_local
+
+DRIVER = os.path.join("tests", "multihost_driver.py")
+td = tempfile.mkdtemp()
+base, out, ck = (os.path.join(td, n) for n in ("base.npz", "out.npz", "ck"))
+env_backup = dict(os.environ)
+os.environ.pop("XLA_FLAGS", None)
+try:
+    rc = launch_local([sys.executable, DRIVER, "--strategy", "sync",
+                       "--out", base, "--rounds", "4",
+                       "--local-devices", "4"], nprocs=1, platform="cpu",
+                      timeout=240)
+    assert rc == 0, rc
+    runner = ResilientRunner(
+        [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
+         "--rounds", "4", "--local-devices", "4", "--ckpt-dir", ck],
+        nprocs=1, platform="cpu", timeout=240,
+        policy=RestartPolicy(max_restarts=2, backoff_base=0.2),
+        extra_env={"SPARKNET_FAULT": "crash@round:3"})
+    rc = runner.run()
+finally:
+    os.environ.clear()
+    os.environ.update(env_backup)
+assert rc == 0, f"no recovery, rc={rc}"
+assert [a.returncode for a in runner.attempts] == [43, 0]
+a, b = np.load(base), np.load(out)
+for k in a.files:
+    if not k.startswith("__"):
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6)
+print(f"recovered in {len(runner.attempts)} attempts; params identical "
+      f"to fault-free run")
+shutil.rmtree(td)
+
+print("== 5. error paths ==")
+from sparknet_tpu.utils.checkpoint import CheckpointError, load_checkpoint
+try:
+    load_checkpoint("/tmp/definitely_absent_ckpt.npz")
+    raise AssertionError("expected CheckpointError")
+except CheckpointError as e:
+    print(f"missing ckpt -> CheckpointError: {e}")
+try:
+    faults.parse_faults("explode@round:1")
+    raise AssertionError("expected ValueError")
+except ValueError as e:
+    print(f"bad fault spec -> ValueError: {e}")
+from sparknet_tpu.parallel import cluster
+os.environ["SPARKNET_COORDINATOR"] = "127.0.0.1:9"
+os.environ.pop("SPARKNET_NUM_PROCS", None)
+os.environ.pop("SPARKNET_PROC_ID", None)
+try:
+    cluster.init_cluster_from_env()
+    raise AssertionError("expected ValueError")
+except ValueError as e:
+    print(f"partial env contract -> ValueError: {e}")
+finally:
+    os.environ.pop("SPARKNET_COORDINATOR", None)
+
+print("ALL DRIVES PASSED")

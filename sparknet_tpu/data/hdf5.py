@@ -15,10 +15,19 @@ from typing import Iterator
 
 import numpy as np
 
+from ..utils.retry import io_retry
+
 try:
     import h5py
 except ImportError:  # pragma: no cover
     h5py = None
+
+
+def _open_h5(path: str, mode: str = "r"):
+    """h5py.File with bounded retry — DB/file opens are one-shot
+    control-plane edges; a transient shared-fs error must not kill a
+    multi-hour run (SPARKNET_IO_* knobs)."""
+    return io_retry(h5py.File, path, mode, describe=f"h5py.File {path}")
 
 
 def _require_h5py():
@@ -30,7 +39,7 @@ def read_source_list(source: str) -> list[str]:
     """The HDF5Data `source` convention: a text file of .h5 paths."""
     base = os.path.dirname(source)
     out = []
-    with open(source) as f:
+    with io_retry(open, source, describe=f"open {source}") as f:
         for line in f:
             line = line.strip()
             if line:
@@ -43,7 +52,7 @@ def load_hdf5_blobs(path: str, keys: list[str] | None = None
                     ) -> dict[str, np.ndarray]:
     """All (or the named) datasets of one .h5 file as float32 arrays."""
     _require_h5py()
-    with h5py.File(path, "r") as f:
+    with _open_h5(path) as f:
         names = keys if keys is not None else sorted(f.keys())
         return {k: np.asarray(f[k], np.float32) for k in names}
 
@@ -103,7 +112,7 @@ def load_model_hdf5(path: str) -> "dict[str, list]":
     {layer_name: [blob0, blob1, ...]}."""
     _require_h5py()
     out: dict[str, list] = {}
-    with h5py.File(path, "r") as f:
+    with _open_h5(path) as f:
         data = f["data"]
         for layer_name in data:
             g = data[layer_name]
@@ -133,7 +142,7 @@ def load_state_hdf5(path: str) -> dict:
     """RestoreSolverStateFromHDF5 reader (sgd_solver.cpp:321-338):
     {iter, current_step, learned_net, history}."""
     _require_h5py()
-    with h5py.File(path, "r") as f:
+    with _open_h5(path) as f:
         learned = ""
         if "learned_net" in f:
             raw = f["learned_net"][()]

@@ -25,6 +25,8 @@ import os
 import struct
 from typing import Iterable, Iterator
 
+from ..utils.retry import io_retry
+
 MAGIC = 0xBEEFC0DE
 VERSION = 1
 P_BRANCH, P_LEAF, P_OVERFLOW, P_META = 0x01, 0x02, 0x04, 0x08
@@ -53,8 +55,16 @@ class LmdbReader:
 
     def __init__(self, path: str):
         self.path = _db_path(path)
-        self._f = open(self.path, "rb")
-        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        # the open+mmap is a one-shot control-plane edge (NFS blips on a
+        # pod fail it transiently) — bounded retry, SPARKNET_IO_* knobs
+        self._f = io_retry(open, self.path, "rb",
+                           describe=f"open {self.path}")
+        try:
+            self._mm = mmap.mmap(self._f.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+        except (OSError, ValueError):
+            self._f.close()
+            raise
         meta = self._pick_meta()
         (self.psize, _flags, self.depth, _b, _l, _o,
          self.entries, self.root) = meta

@@ -17,9 +17,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator, Mapping
 
 import jax
+
+from ..utils import faults
 
 
 class PrefetchIterator:
@@ -40,6 +43,9 @@ class PrefetchIterator:
         self._err: BaseException | None = None
         self._stop = threading.Event()
         self._done = False
+        # chaos hook: SPARKNET_FAULT=slow_feed:<dur> models a degraded
+        # input pipeline by delaying every produced batch (utils.faults)
+        feed_delay = faults.get_injector().feed_delay()
 
         def put(item: Any) -> bool:
             while not self._stop.is_set():
@@ -55,6 +61,8 @@ class PrefetchIterator:
                 for item in it:
                     if self._stop.is_set():
                         return
+                    if feed_delay:
+                        time.sleep(feed_delay)
                     if not put(transform(item) if transform else item):
                         return
             except BaseException as e:  # surfaced on next()

@@ -5,3 +5,4 @@ from .trainer import (
     device_crop_mirror_mean,
 )
 from .cluster import init_cluster, is_multi_host, local_batch_slice
+from .resilience import ResilientRunner, RestartPolicy

@@ -28,12 +28,24 @@ def init_cluster(coordinator_address: str | None = None,
     """Join (or bootstrap) the distributed runtime.  No-op for single-host.
 
     All arguments default to auto-discovery (TPU metadata / env vars), the
-    normal mode on a TPU-VM pod."""
-    jax.distributed.initialize(
+    normal mode on a TPU-VM pod.
+
+    The coordinator connect is a one-shot control-plane edge: non-zero
+    ranks race the coordinator's socket bind, and a restarted job can hit
+    its predecessor's port in TIME_WAIT — so the connect is retried with
+    bounded exponential backoff (SPARKNET_CONNECT_RETRIES /
+    SPARKNET_CONNECT_BACKOFF, defaults 3 / 0.5s)."""
+    from ..utils.retry import retry_call
+    attempts = int(os.environ.get("SPARKNET_CONNECT_RETRIES", "3") or 3)
+    base = float(os.environ.get("SPARKNET_CONNECT_BACKOFF", "0.5") or 0.5)
+    retry_call(
+        jax.distributed.initialize,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
-    )
+        attempts=attempts, base_delay=base,
+        retry_on=(RuntimeError, OSError, ConnectionError, TimeoutError),
+        describe="jax.distributed.initialize")
 
 
 def init_cluster_from_env() -> bool:
@@ -42,13 +54,43 @@ def init_cluster_from_env() -> bool:
     (``sparknet_tpu.tools.launch``) sets on every spawned process, playing
     the role of spark-submit's executor placement (reference: SETUP.md,
     ImageNetApp.scala:97).  Returns False (and does nothing) when the env
-    is absent, i.e. single-process runs."""
+    is absent, i.e. single-process runs.
+
+    The three vars are validated together: a partial contract (coordinator
+    set but counts missing, non-integer counts, or an out-of-range rank)
+    raises a ValueError naming the offending variable instead of a bare
+    KeyError deep in the launcher plumbing."""
     addr = os.environ.get("SPARKNET_COORDINATOR")
     if not addr:
+        for var in ("SPARKNET_NUM_PROCS", "SPARKNET_PROC_ID"):
+            if os.environ.get(var):
+                raise ValueError(
+                    f"{var} is set but SPARKNET_COORDINATOR is not — the "
+                    f"launcher env contract requires all three of "
+                    f"SPARKNET_COORDINATOR / SPARKNET_NUM_PROCS / "
+                    f"SPARKNET_PROC_ID")
         return False
-    init_cluster(addr,
-                 int(os.environ["SPARKNET_NUM_PROCS"]),
-                 int(os.environ["SPARKNET_PROC_ID"]))
+    values = {}
+    for var in ("SPARKNET_NUM_PROCS", "SPARKNET_PROC_ID"):
+        raw = os.environ.get(var)
+        if raw is None or raw == "":
+            raise ValueError(
+                f"SPARKNET_COORDINATOR is set but {var} is missing — the "
+                f"launcher must export SPARKNET_COORDINATOR, "
+                f"SPARKNET_NUM_PROCS, and SPARKNET_PROC_ID together")
+        try:
+            values[var] = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{var}={raw!r} is not an integer") from None
+    nprocs, pid = values["SPARKNET_NUM_PROCS"], values["SPARKNET_PROC_ID"]
+    if nprocs < 1:
+        raise ValueError(f"SPARKNET_NUM_PROCS={nprocs} must be >= 1")
+    if not 0 <= pid < nprocs:
+        raise ValueError(
+            f"SPARKNET_PROC_ID={pid} out of range for "
+            f"SPARKNET_NUM_PROCS={nprocs} (want 0 <= id < num_procs)")
+    init_cluster(addr, nprocs, pid)
     return True
 
 

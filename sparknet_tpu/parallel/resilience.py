@@ -1,0 +1,130 @@
+"""Resilient job supervision — the recovery half of Spark's fault tolerance.
+
+The reference inherited two things from Spark: fail-fast (a dead executor
+fails the stage — ``spark.task.maxFailures`` is pinned to 1 at
+CifarApp.scala:36) and *reschedule* (the driver relaunches the failed
+work).  The launcher (``tools.launch``) reproduces fail-fast: the first
+worker death tears the whole round down.  This module is the reschedule
+half: ``ResilientRunner`` wraps ``launch_local``/``launch_ssh``, watches
+the worker set, and on any nonzero exit relaunches the WHOLE job with
+exponential backoff under a bounded restart budget.
+
+Recovery is round-granular, not step-granular: the relaunched job finds
+the newest valid checkpoint manifest on disk (``DistributedTrainer``'s
+``checkpoint_dir`` auto-resume) and replays from that round boundary — a
+preempted host costs at most ``checkpoint_every`` rounds of work, exactly
+the granularity SparkNet's driver loop could recover at (a round was one
+Spark stage).
+
+Every (re)launch is stamped with SPARKNET_FAULT_ATTEMPT /
+SPARKNET_RESTART_COUNT in the child env; the fault-injection harness
+(``utils.faults``) keys one-shot faults off it, and training code can log
+it.  A fresh coordinator port is chosen per attempt so a relaunch never
+races the dying coordinator's socket in TIME_WAIT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Callable
+
+from ..tools.launch import free_port, launch_local, launch_ssh
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartPolicy:
+    """Bounded restarts with exponential backoff — the
+    ``spark.task.maxFailures`` contract plus the backoff Spark's DAG
+    scheduler applies between stage reattempts."""
+
+    max_restarts: int = 3          # total attempts = max_restarts + 1
+    backoff_base: float = 1.0      # seconds before the first restart
+    backoff_factor: float = 2.0
+    backoff_max: float = 60.0
+
+    def delay(self, restart_idx: int) -> float:
+        """Sleep before restart #``restart_idx`` (0-based)."""
+        return min(self.backoff_base * self.backoff_factor ** restart_idx,
+                   self.backoff_max)
+
+
+@dataclasses.dataclass(frozen=True)
+class Attempt:
+    index: int
+    returncode: int
+    duration_s: float
+
+
+class ResilientRunner:
+    """Launch a multi-process training job and keep it alive.
+
+    Exactly one of ``nprocs`` (local mode) or ``hosts`` (ssh mode) must be
+    given — the same split as ``tools.launch``.  ``run()`` returns the
+    final exit code: 0 once any attempt completes, else the last failing
+    code after the restart budget is spent.  ``attempts`` records every
+    try for post-mortems.
+    """
+
+    def __init__(self, cmd: list[str], *,
+                 nprocs: int | None = None,
+                 hosts: list[str] | None = None,
+                 platform: str | None = None,
+                 devices_per_proc: int | None = None,
+                 cwd: str | None = None,
+                 timeout: float | None = None,
+                 policy: RestartPolicy | None = None,
+                 extra_env: dict | None = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if (nprocs is None) == (hosts is None):
+            raise ValueError("exactly one of nprocs / hosts is required")
+        self.cmd = list(cmd)
+        self.nprocs = nprocs
+        self.hosts = list(hosts) if hosts else None
+        self.platform = platform
+        self.devices_per_proc = devices_per_proc
+        self.cwd = cwd
+        self.timeout = timeout
+        self.policy = policy or RestartPolicy()
+        self.extra_env = dict(extra_env or {})
+        self._sleep = sleep
+        self.attempts: list[Attempt] = []
+
+    def _launch_once(self, attempt: int) -> int:
+        env = dict(self.extra_env)
+        env["SPARKNET_FAULT_ATTEMPT"] = str(attempt)
+        env["SPARKNET_RESTART_COUNT"] = str(attempt)
+        if self.hosts is not None:
+            return launch_ssh(self.cmd, self.hosts,
+                              coordinator_port=free_port(),
+                              cwd=self.cwd, timeout=self.timeout,
+                              extra_env=env)
+        return launch_local(self.cmd, self.nprocs, platform=self.platform,
+                            devices_per_proc=self.devices_per_proc,
+                            coordinator=f"127.0.0.1:{free_port()}",
+                            timeout=self.timeout, extra_env=env)
+
+    def run(self) -> int:
+        rc = 0
+        for attempt in range(self.policy.max_restarts + 1):
+            t0 = time.monotonic()
+            rc = self._launch_once(attempt)
+            self.attempts.append(
+                Attempt(attempt, rc, time.monotonic() - t0))
+            if rc == 0:
+                if attempt:
+                    print(f"resilience: job recovered on attempt "
+                          f"{attempt + 1}", file=sys.stderr, flush=True)
+                return 0
+            if attempt < self.policy.max_restarts:
+                delay = self.policy.delay(attempt)
+                print(f"resilience: attempt {attempt + 1} failed rc={rc}; "
+                      f"restarting from latest checkpoint in {delay:.2g}s "
+                      f"({self.policy.max_restarts - attempt} restarts "
+                      f"left)", file=sys.stderr, flush=True)
+                self._sleep(delay)
+        print(f"resilience: restart budget exhausted after "
+              f"{len(self.attempts)} attempts; giving up rc={rc}",
+              file=sys.stderr, flush=True)
+        return rc

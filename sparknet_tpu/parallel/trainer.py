@@ -41,6 +41,12 @@ averages weights after it (per-worker optimizer states).
 from __future__ import annotations
 
 import dataclasses
+import glob
+import hashlib
+import inspect
+import json
+import os
+import sys
 from typing import Any, Callable, Iterator, Mapping
 
 import jax
@@ -64,6 +70,17 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+# the replication-check kwarg was renamed check_rep -> check_vma across
+# jax versions; resolve whichever this jax spells, once at import
+_sm_params = inspect.signature(shard_map).parameters
+if "check_vma" in _sm_params:
+    _SM_NOCHECK: dict[str, bool] = {"check_vma": False}
+elif "check_rep" in _sm_params:
+    _SM_NOCHECK = {"check_rep": False}
+else:  # pragma: no cover
+    _SM_NOCHECK = {}
+del _sm_params
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainerConfig:
@@ -80,6 +97,19 @@ class TrainerConfig:
     # jax.checkpoint the forward: backward recomputes activations instead
     # of storing them (HBM for FLOPs; big-batch / VGG-class configs)
     remat: bool = False
+    # Round-granular fault tolerance: with ``checkpoint_dir`` set, process
+    # 0 writes params + per-worker solver state + round counter + RNG +
+    # data-cursor every ``checkpoint_every`` completed rounds, each under
+    # a checksummed manifest, and a fresh trainer auto-resumes from the
+    # newest manifest whose checksum validates (corrupt/partial snapshots
+    # are skipped).  ``checkpoint_keep`` bounds disk: older round
+    # checkpoints beyond the newest N are pruned.  This is the recovery
+    # half of the reference's Spark story — a relaunched job (see
+    # ``parallel.resilience.ResilientRunner``) loses at most
+    # ``checkpoint_every`` rounds.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
+    checkpoint_keep: int = 3
 
 
 def device_crop_mirror_mean(crop: int, mirror: bool = True,
@@ -191,6 +221,18 @@ class DistributedTrainer:
 
         self._round = self._build_round()
         self._test_fwd = None
+
+        # -- resilience state: completed-round counter, caller-maintained
+        # feed cursor (any JSON value), and the manifest we resumed from
+        self.round = 0
+        self.data_cursor: Any = None
+        self.resumed: dict[str, Any] | None = None
+        if self.config.checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got "
+                f"{self.config.checkpoint_every}")
+        if self.config.checkpoint_dir:
+            self.resumed = self.resume_latest(self.config.checkpoint_dir)
 
     def _state_tier(self) -> tuple[int, P]:
         """(leading-axis length, PartitionSpec) of the stacked optimizer
@@ -325,7 +367,7 @@ class DistributedTrainer:
             body, mesh=self.mesh,
             in_specs=(P(), state_spec, P(), batch_spec, P()),
             out_specs=(P(), state_spec, P()),
-            check_vma=False,
+            **_SM_NOCHECK,
         )
         donate = (0, 1) if self.config.donate else ()
         return jax.jit(mapped, donate_argnums=donate)
@@ -381,6 +423,10 @@ class DistributedTrainer:
         if (self.sp.snapshot and self.sp.snapshot_prefix
                 and prev // self.sp.snapshot != self.iter // self.sp.snapshot):
             self.snapshot(f"{self.sp.snapshot_prefix}_iter_{self.iter}.npz")
+        self.round += 1
+        if (self.config.checkpoint_dir
+                and self.round % self.config.checkpoint_every == 0):
+            self.save_round_checkpoint()
         return float(loss)
 
     def test(self, feed: Iterator[Mapping[str, Any]], num_steps: int,
@@ -430,7 +476,7 @@ class DistributedTrainer:
             self._test_fwd = jax.jit(shard_map(
                 worker, mesh=self.mesh,
                 in_specs=(P(), P(self._batch_axes), P(self._batch_axes)),
-                out_specs=P(), check_vma=False))
+                out_specs=P(), **_SM_NOCHECK))
         sharding = NamedSharding(self.mesh, P(self._batch_axes))
         local_workers = max(self.n_workers // jax.process_count(), 1)
         totals: dict[str, Any] = {}
@@ -471,22 +517,28 @@ class DistributedTrainer:
 
     # -- checkpoint (driver-side averaged weights + per-worker state;
     #    parity target per SURVEY.md §5 checkpoint/resume) ----------------
-    def snapshot(self, path: str) -> None:
-        from ..utils.checkpoint import save_checkpoint
-        blob = {
+    def _host_blob(self) -> dict[str, Any]:
+        """The full training state as a host-fetchable pytree.  Multi-host
+        this is a COLLECTIVE (the sharded per-worker optimizer state is
+        all-gathered to replicated) — every process must call it."""
+        state = self.state
+        if jax.process_count() > 1 and self.config.strategy != "sync":
+            state = jax.jit(lambda t: t,
+                            out_shardings=replicated(self.mesh))(state)
+        blob: dict[str, Any] = {
             "params": self.params,
-            "state": self.state,
+            "state": state,
             "iter": self.iter,
+            "round": self.round,
+            "rng": np.asarray(self._rng),
             "strategy": self.config.strategy,
             "n_workers": self.n_workers,
         }
         if self.config.strategy == "hierarchical":
             blob["n_hosts"] = self.n_hosts  # state is per-host
-        save_checkpoint(path, blob)
+        return blob
 
-    def restore(self, path: str) -> None:
-        from ..utils.checkpoint import load_checkpoint
-        blob = load_checkpoint(path)
+    def _apply_blob(self, blob: Mapping[str, Any]) -> None:
         saved_strategy = str(np.asarray(blob.get("strategy", "")))
         saved_workers = int(blob["n_workers"]) if "n_workers" in blob else None
         if saved_strategy and saved_strategy != self.config.strategy:
@@ -514,3 +566,135 @@ class DistributedTrainer:
                 blob["state"],
                 NamedSharding(self.mesh, self._state_tier()[1]))
         self.iter = int(blob["iter"])
+        if "round" in blob:
+            self.round = int(blob["round"])
+        if "rng" in blob:
+            self._rng = jnp.asarray(blob["rng"])
+
+    def snapshot(self, path: str) -> None:
+        from ..utils.checkpoint import save_checkpoint
+        save_checkpoint(path, self._host_blob())
+
+    def restore(self, path: str) -> None:
+        from ..utils.checkpoint import load_checkpoint
+        self._apply_blob(load_checkpoint(path))
+
+    # -- round-granular checkpoint/resume (the recovery half of the
+    #    reference's Spark fault-tolerance story; see TrainerConfig) ------
+    def save_round_checkpoint(self, directory: str | None = None) -> str | None:
+        """Write checkpoint + manifest for the current round.  All
+        processes must call (the state fetch is a collective); only
+        process 0 touches disk.  Returns the checkpoint path on process 0,
+        None elsewhere."""
+        from ..utils import faults
+        from ..utils.checkpoint import save_checkpoint
+        directory = directory or self.config.checkpoint_dir
+        if not directory:
+            raise ValueError("no checkpoint directory configured")
+        blob = self._host_blob()
+        if jax.process_index() != 0:
+            return None
+        os.makedirs(directory, exist_ok=True)
+        name = f"ckpt_round_{self.round:08d}.npz"
+        path = os.path.join(directory, name)
+        save_checkpoint(path, blob)
+        # deterministic chaos hook: scribble the snapshot AFTER it exists
+        # (and before/after the manifest — both orders must be survivable;
+        # we corrupt after so the manifest's checksum catches it)
+        corrupt = faults.get_injector().corrupt_checkpoint(self.round)
+        manifest = {
+            "round": self.round,
+            "iter": self.iter,
+            "file": name,
+            "sha256": _sha256_file(path),
+            "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
+            "strategy": self.config.strategy,
+            "n_workers": self.n_workers,
+            "tau": self.config.tau,
+            "data_cursor": self.data_cursor,
+        }
+        mpath = os.path.join(directory, f"manifest_{self.round:08d}.json")
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp, mpath)  # manifest appears atomically, last
+        if corrupt:
+            print(f"FAULT: corrupt_ckpt scribbling {path}",
+                  file=sys.stderr, flush=True)
+            faults.scribble(path)
+        self._prune_checkpoints(directory)
+        return path
+
+    def _prune_checkpoints(self, directory: str) -> None:
+        keep = max(int(self.config.checkpoint_keep), 1)
+        rounds = sorted(
+            (_manifest_round(m) for m in
+             glob.glob(os.path.join(directory, "manifest_*.json"))),
+            reverse=True)
+        for r in rounds[keep:]:
+            for p in (os.path.join(directory, f"manifest_{r:08d}.json"),
+                      os.path.join(directory, f"ckpt_round_{r:08d}.npz")):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    def resume_latest(self, directory: str) -> dict[str, Any] | None:
+        """Restore from the newest manifest whose checkpoint validates
+        (file sha256 against the manifest, then the in-file content
+        checksum).  Corrupt or partial snapshots are skipped with a
+        warning, falling back to the next-older manifest; a checkpoint
+        from an INCOMPATIBLE config (strategy/mesh mismatch) raises — that
+        is a config error, not corruption.  Returns the manifest resumed
+        from, or None when no valid checkpoint exists."""
+        from ..utils.checkpoint import CheckpointError, load_checkpoint
+        for mpath in sorted(
+                glob.glob(os.path.join(directory, "manifest_*.json")),
+                key=_manifest_round, reverse=True):
+            try:
+                with open(mpath) as f:
+                    manifest = json.load(f)
+                path = os.path.join(directory, manifest["file"])
+                got = _sha256_file(path)
+                if got != manifest["sha256"]:
+                    raise CheckpointError(
+                        f"manifest sha256 mismatch (manifest "
+                        f"{manifest['sha256'][:12]}…, file {got[:12]}…)",
+                        path)
+                blob = load_checkpoint(path)
+            except (OSError, json.JSONDecodeError, KeyError,
+                    CheckpointError) as e:
+                print(f"resume: skipping {os.path.basename(mpath)}: {e}",
+                      file=sys.stderr, flush=True)
+                continue
+            mesh_shape = manifest.get("mesh_shape")
+            if mesh_shape and mesh_shape != {
+                    k: int(v) for k, v in self.mesh.shape.items()}:
+                raise ValueError(
+                    f"checkpoint mesh shape {mesh_shape} != trainer mesh "
+                    f"{dict(self.mesh.shape)}")
+            self._apply_blob(blob)
+            self.round = int(manifest.get("round", self.round))
+            self.data_cursor = manifest.get("data_cursor")
+            print(f"resume: restored round {self.round} "
+                  f"(iter {self.iter}) from "
+                  f"{os.path.basename(manifest['file'])}",
+                  file=sys.stderr, flush=True)
+            return manifest
+        return None
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest_round(path: str) -> int:
+    stem = os.path.basename(path)
+    try:
+        return int(stem[len("manifest_"):-len(".json")])
+    except ValueError:
+        return -1

@@ -31,6 +31,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 
 def free_port() -> int:
@@ -40,7 +41,8 @@ def free_port() -> int:
 
 
 def _proc_env(base: dict, coordinator: str, nprocs: int, pid: int,
-              platform: str | None, devices_per_proc: int | None) -> dict:
+              platform: str | None, devices_per_proc: int | None,
+              extra_env: dict | None = None) -> dict:
     env = dict(base)
     env["SPARKNET_COORDINATOR"] = coordinator
     env["SPARKNET_NUM_PROCS"] = str(nprocs)
@@ -53,7 +55,45 @@ def _proc_env(base: dict, coordinator: str, nprocs: int, pid: int,
         env["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count="
             f"{devices_per_proc}").strip()
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
     return env
+
+
+def _wait_all(procs: list, timeout: float | None,
+              poll_interval: float = 0.05) -> int:
+    """Supervise the worker set: returns 0 when every process exits clean.
+    The FIRST nonzero exit tears the whole round down — remaining workers
+    are killed immediately rather than left hanging on a dead collective
+    until the timeout (the stage-abort half of Spark's task supervision;
+    the reschedule half lives in ``parallel.resilience``).  A timeout
+    kills everything and returns 124."""
+    deadline = time.monotonic() + timeout if timeout else None
+    rc = 0
+    pending = list(procs)
+    while pending and rc == 0:
+        for p in list(pending):
+            r = p.poll()
+            if r is None:
+                continue
+            pending.remove(p)
+            if r != 0:
+                rc = r
+                break
+        if rc == 0 and pending:
+            if deadline is not None and time.monotonic() > deadline:
+                rc = 124
+                break
+            time.sleep(poll_interval)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            pass
+    return rc
 
 
 def _stream(prefix: str, pipe) -> None:
@@ -65,15 +105,19 @@ def _stream(prefix: str, pipe) -> None:
 def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
                  devices_per_proc: int | None = None,
                  coordinator: str | None = None,
-                 timeout: float | None = None) -> int:
+                 timeout: float | None = None,
+                 extra_env: dict | None = None) -> int:
     """Spawn ``nprocs`` copies of ``cmd`` locally; returns the first
-    non-zero exit code, else 0.  Output is streamed with [p<i>] prefixes."""
+    non-zero exit code, else 0.  Output is streamed with [p<i>] prefixes.
+    The first worker death kills the remaining workers immediately
+    (see ``_wait_all``).  ``extra_env`` adds per-job vars to every child
+    (the ResilientRunner's attempt-stamping channel)."""
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
     procs = []
     threads = []
     for pid in range(nprocs):
         env = _proc_env(os.environ, coordinator, nprocs, pid, platform,
-                        devices_per_proc)
+                        devices_per_proc, extra_env)
         p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
         t = threading.Thread(target=_stream, args=(f"p{pid}", p.stdout),
@@ -81,28 +125,17 @@ def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
         t.start()
         procs.append(p)
         threads.append(t)
-    rc = 0
-    try:
-        for p in procs:
-            p.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        rc = 124
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    rc = _wait_all(procs, timeout)
     for t in threads:
         t.join(timeout=5)
-    for p in procs:
-        if p.returncode not in (0, None) and rc == 0:
-            rc = p.returncode
     return rc
 
 
 def launch_ssh(cmd: list[str], hosts: list[str], *,
                coordinator_port: int | None = None,
                cwd: str | None = None,
-               timeout: float | None = None) -> int:
+               timeout: float | None = None,
+               extra_env: dict | None = None) -> int:
     """Run ``cmd`` on every host via ssh; host 0 doubles as coordinator."""
     port = coordinator_port or 9876
     coordinator = f"{hosts[0]}:{port}"
@@ -110,12 +143,14 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
     procs = []
     threads = []
     for pid, host in enumerate(hosts):
-        envs = " ".join(
-            f"{k}={v!r}" for k, v in (
-                ("SPARKNET_COORDINATOR", coordinator),
-                ("SPARKNET_NUM_PROCS", str(len(hosts))),
-                ("SPARKNET_PROC_ID", str(pid)),
-            ))
+        pairs = [
+            ("SPARKNET_COORDINATOR", coordinator),
+            ("SPARKNET_NUM_PROCS", str(len(hosts))),
+            ("SPARKNET_PROC_ID", str(pid)),
+        ]
+        if extra_env:
+            pairs.extend((k, str(v)) for k, v in extra_env.items())
+        envs = " ".join(f"{k}={v!r}" for k, v in pairs)
         remote = f"cd {cwd} && env {envs} " + " ".join(cmd)
         p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host, remote],
                              stdout=subprocess.PIPE,
@@ -125,21 +160,9 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
         t.start()
         procs.append(p)
         threads.append(t)
-    rc = 0
-    try:
-        for p in procs:
-            p.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        rc = 124
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    rc = _wait_all(procs, timeout)
     for t in threads:
         t.join(timeout=5)
-    for p in procs:
-        if p.returncode not in (0, None) and rc == 0:
-            rc = p.returncode
     return rc
 
 

@@ -1,2 +1,3 @@
-from .checkpoint import save_checkpoint, load_checkpoint
+from .checkpoint import CheckpointError, save_checkpoint, load_checkpoint
+from .retry import retry_call
 from .timing import Timer

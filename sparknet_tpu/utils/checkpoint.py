@@ -6,16 +6,36 @@ solvers/sgd_solver.cpp:242-296) and restores via ``Solver::Restore``
 (solver.cpp:510).  Here a checkpoint is any pytree, written as an ``.npz``
 of flattened leaves plus a pickled treedef-free key list — no pickle of
 arbitrary objects, so checkpoints are portable and safe to load.
+
+Robustness contract (the recovery layer leans on this):
+- writes are atomic (tmp + ``os.replace``), so a crash mid-write never
+  leaves a half-checkpoint under the final name;
+- the meta block carries a content checksum over every leaf, verified on
+  load — bit-rot or a torn copy fails loudly;
+- ANY malformed file (truncated zip, missing arrays, bad meta, checksum
+  mismatch) surfaces as ``CheckpointError`` carrying ``.path``, never a
+  raw ``zipfile.BadZipFile``/``KeyError`` from deep inside numpy.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import zipfile
 from typing import Any
 
 import jax
 import numpy as np
+
+
+class CheckpointError(Exception):
+    """A checkpoint file is missing, truncated, corrupt, or fails its
+    checksum.  ``path`` names the offending file."""
+
+    def __init__(self, message: str, path: str):
+        super().__init__(f"{path}: {message}")
+        self.path = path
 
 
 def _flatten(tree: Any, prefix: str, out: dict[str, np.ndarray],
@@ -43,11 +63,25 @@ def _unflatten(prefix: str, data: dict[str, np.ndarray],
     return data[prefix]
 
 
+def content_checksum(arrays: dict[str, np.ndarray]) -> str:
+    """Order-independent sha256 over every leaf's name, dtype, shape, and
+    bytes — what the meta block stores and the loader re-verifies."""
+    h = hashlib.sha256()
+    for k in sorted(arrays):
+        a = np.ascontiguousarray(arrays[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def save_checkpoint(path: str, tree: Any) -> None:
     arrays: dict[str, np.ndarray] = {}
     meta: dict[str, Any] = {}
     host_tree = jax.tree_util.tree_map(np.asarray, tree)
     _flatten(host_tree, "root", arrays, meta)
+    meta["__checksum__"] = content_checksum(arrays)
     tmp = path + ".tmp"
     np.savez(tmp, __meta__=np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8), **arrays)
@@ -55,8 +89,25 @@ def save_checkpoint(path: str, tree: Any) -> None:
     os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
 
 
-def load_checkpoint(path: str) -> Any:
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["__meta__"]).decode())
-        data = {k: z[k] for k in z.files if k != "__meta__"}
-    return _unflatten("root", data, meta)
+def load_checkpoint(path: str, verify: bool = True) -> Any:
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            data = {k: z[k] for k in z.files if k != "__meta__"}
+    except (zipfile.BadZipFile, OSError, EOFError, ValueError, KeyError,
+            json.JSONDecodeError) as e:
+        raise CheckpointError(
+            f"unreadable checkpoint ({type(e).__name__}: {e})", path) from e
+    expect = meta.pop("__checksum__", None)
+    if verify and expect is not None:
+        got = content_checksum(data)
+        if got != expect:
+            raise CheckpointError(
+                f"checksum mismatch (file says {expect[:12]}…, content is "
+                f"{got[:12]}…) — truncated or bit-rotted snapshot", path)
+    try:
+        return _unflatten("root", data, meta)
+    except (KeyError, IndexError, TypeError) as e:
+        raise CheckpointError(
+            f"malformed checkpoint structure ({type(e).__name__}: {e})",
+            path) from e

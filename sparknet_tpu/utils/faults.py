@@ -1,0 +1,198 @@
+"""Deterministic fault injection — chaos testing for the resilience layer.
+
+SparkNet's recovery story was only ever exercised by luck (a preempted
+EC2 spot node during a paper run); here every failure mode is a
+first-class, deterministic test input.  Faults are described by the
+``SPARKNET_FAULT`` env var and fire at well-defined hook points:
+
+    SPARKNET_FAULT=<spec>[,<spec>...]
+    spec     := kind[:arg][@round:<N>][@rank:<R>][@attempt:<A>]
+    kind     := crash        — os._exit(43) at the start of round N
+              | hang         — block forever at the start of round N
+              | slow_feed    — arg = per-batch delay ("200ms", "0.5s", "2")
+              | corrupt_ckpt — scribble over the checkpoint written at
+                               round N, after its manifest exists
+
+Scoping:
+  @round:N   — fire at round N (required for crash/hang; for corrupt_ckpt
+               it names the checkpointed round; slow_feed ignores it)
+  @rank:R    — only on process R (default: every rank)
+  @attempt:A — only on job attempt A.  The ResilientRunner stamps every
+               (re)launch with SPARKNET_FAULT_ATTEMPT; crash / hang /
+               corrupt_ckpt default to attempt 0 ONLY, so an injected
+               fault fires once and the automatic restart then runs
+               clean — the deterministic replacement for "the spot
+               instance came back".  slow_feed defaults to every attempt
+               (it models degradation, not death).
+
+Hook points: ``FaultInjector.on_round`` in training drivers,
+``feed_delay`` in ``data.prefetch.PrefetchIterator``, and
+``corrupt_checkpoint`` in the trainer's round-checkpoint writer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from typing import Callable, Mapping
+
+KINDS = ("crash", "hang", "slow_feed", "corrupt_ckpt")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    round: int | None = None
+    rank: int | None = None
+    attempt: int | None = None     # None => kind-specific default (see doc)
+    delay_s: float = 0.0           # slow_feed only
+
+
+def _parse_duration(text: str) -> float:
+    t = text.strip()
+    try:
+        if t.endswith("ms"):
+            return float(t[:-2]) / 1000.0
+        if t.endswith("s"):
+            return float(t[:-1])
+        return float(t)
+    except ValueError:
+        raise ValueError(f"bad duration {text!r} (want e.g. '200ms', "
+                         f"'1.5s', or plain seconds)") from None
+
+
+def parse_faults(text: str) -> tuple[FaultSpec, ...]:
+    """Parse a SPARKNET_FAULT value; raises ValueError with the offending
+    spec named (config errors must be loud, not silently inert)."""
+    specs = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, *mods = raw.split("@")
+        kind, _, arg = head.partition(":")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in {raw!r} "
+                             f"(known: {', '.join(KINDS)})")
+        delay = 0.0
+        if kind == "slow_feed":
+            if not arg:
+                raise ValueError(f"slow_feed needs a duration arg in {raw!r}")
+            delay = _parse_duration(arg)
+        elif arg:
+            raise ValueError(f"{kind} takes no ':' arg (got {raw!r})")
+        fields: dict[str, int] = {}
+        for mod in mods:
+            key, _, val = mod.partition(":")
+            key = key.strip()
+            if key not in ("round", "rank", "attempt") or not val:
+                raise ValueError(f"bad modifier {mod!r} in {raw!r} "
+                                 f"(want @round:N / @rank:R / @attempt:A)")
+            try:
+                fields[key] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"modifier {mod!r} in {raw!r}: not an integer") from None
+        if kind in ("crash", "hang") and "round" not in fields:
+            raise ValueError(f"{kind} needs @round:N ({raw!r})")
+        specs.append(FaultSpec(kind=kind, round=fields.get("round"),
+                               rank=fields.get("rank"),
+                               attempt=fields.get("attempt"),
+                               delay_s=delay))
+    return tuple(specs)
+
+
+class FaultInjector:
+    """Evaluates parsed fault specs at the hook points.  ``_exit`` and
+    ``_sleep`` are injectable for unit tests; production uses the real
+    ones (crash must be un-catchable, like a SIGKILLed worker)."""
+
+    def __init__(self, specs: tuple[FaultSpec, ...], *, attempt: int = 0,
+                 rank: int = 0,
+                 _exit: Callable[[int], None] = os._exit,
+                 _sleep: Callable[[float], None] = time.sleep):
+        self.specs = specs
+        self.attempt = attempt
+        self.rank = rank
+        self._exit = _exit
+        self._sleep = _sleep
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None,
+                 **kwargs) -> "FaultInjector":
+        env = os.environ if env is None else env
+        text = env.get("SPARKNET_FAULT", "")
+        return cls(parse_faults(text) if text else (),
+                   attempt=int(env.get("SPARKNET_FAULT_ATTEMPT", "0") or 0),
+                   rank=int(env.get("SPARKNET_PROC_ID", "0") or 0),
+                   **kwargs)
+
+    def _active(self, spec: FaultSpec, rank: int | None) -> bool:
+        r = self.rank if rank is None else rank
+        if spec.rank is not None and spec.rank != r:
+            return False
+        want = spec.attempt
+        if want is None:
+            # one-shot faults fire on the first attempt only; slow_feed
+            # degrades every attempt
+            want = None if spec.kind == "slow_feed" else 0
+        return want is None or want == self.attempt
+
+    def on_round(self, round_idx: int, rank: int | None = None) -> None:
+        """Call at the start of every training round."""
+        for spec in self.specs:
+            if spec.kind not in ("crash", "hang") or spec.round != round_idx:
+                continue
+            if not self._active(spec, rank):
+                continue
+            who = self.rank if rank is None else rank
+            print(f"FAULT: {spec.kind} at round {round_idx} on rank {who} "
+                  f"(attempt {self.attempt})", file=sys.stderr, flush=True)
+            if spec.kind == "crash":
+                self._exit(43)
+                return  # only reached with a test-injected _exit
+            while True:  # hang: a stuck worker, killable only from outside
+                self._sleep(3600)
+
+    def feed_delay(self, rank: int | None = None) -> float:
+        """Seconds each prefetched batch should be delayed by."""
+        return sum(s.delay_s for s in self.specs
+                   if s.kind == "slow_feed" and self._active(s, rank))
+
+    def corrupt_checkpoint(self, round_idx: int,
+                           rank: int | None = None) -> bool:
+        """True when the checkpoint just written for ``round_idx`` should
+        be scribbled over (exercises manifest-fallback on resume)."""
+        return any(
+            s.kind == "corrupt_ckpt"
+            and (s.round is None or s.round == round_idx)
+            and self._active(s, rank)
+            for s in self.specs)
+
+
+_CACHE: tuple[tuple[str, ...], FaultInjector] | None = None
+
+
+def get_injector() -> FaultInjector:
+    """Process-wide injector, re-parsed whenever the driving env vars
+    change (so tests can monkeypatch the env between uses)."""
+    global _CACHE
+    key = tuple(os.environ.get(k, "") for k in
+                ("SPARKNET_FAULT", "SPARKNET_FAULT_ATTEMPT",
+                 "SPARKNET_PROC_ID"))
+    if _CACHE is None or _CACHE[0] != key:
+        _CACHE = (key, FaultInjector.from_env())
+    return _CACHE[1]
+
+
+def scribble(path: str) -> None:
+    """Corrupt a file in place: truncate to half and overwrite the tail —
+    breaks both the zip directory of an .npz and any content checksum."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+        f.seek(max(size // 2 - 64, 0))
+        f.write(b"\xde\xad\xbe\xef" * 4)

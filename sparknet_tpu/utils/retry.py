@@ -1,0 +1,67 @@
+"""Bounded retry-with-backoff for one-shot control-plane edges.
+
+The reference inherits retry semantics from Spark — a failed task is
+rescheduled up to ``spark.task.maxFailures`` times (reference:
+CifarApp.scala:36 pins it to 1, i.e. fail-fast) — but its one-shot
+control-plane calls (driver connect, LMDB open) have no such cover and a
+transient NFS blip or a coordinator that is still binding its port kills
+the job.  This module is the missing half: a small deterministic
+exponential-backoff loop used by ``parallel.cluster.init_cluster`` and the
+DB/file opens in ``data.lmdb_io`` / ``data.hdf5``.
+
+Knobs (also via env, read per call so launchers can tune children):
+  SPARKNET_IO_RETRIES   — attempts for data-plane file/DB opens (default 3)
+  SPARKNET_IO_BACKOFF   — base delay in seconds (default 0.05)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Callable, Iterable
+
+
+def backoff_delays(attempts: int, base: float, factor: float = 2.0,
+                   max_delay: float = 30.0) -> Iterable[float]:
+    """The sleep schedule between ``attempts`` tries: base, base·factor,
+    base·factor², ... capped at ``max_delay`` (len == attempts - 1)."""
+    for i in range(max(attempts - 1, 0)):
+        yield min(base * factor ** i, max_delay)
+
+
+def retry_call(fn: Callable[..., Any], *args: Any,
+               attempts: int = 3, base_delay: float = 0.1,
+               factor: float = 2.0, max_delay: float = 30.0,
+               retry_on: tuple[type[BaseException], ...] = (OSError,),
+               sleep: Callable[[float], None] = time.sleep,
+               describe: str | None = None, **kwargs: Any) -> Any:
+    """Call ``fn(*args, **kwargs)``; on an exception in ``retry_on`` retry
+    up to ``attempts`` total tries with exponential backoff.  The final
+    failure re-raises the last exception unchanged (bounded budget — this
+    is Spark's maxFailures contract, not an infinite supervisor)."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    delays = list(backoff_delays(attempts, base_delay, factor, max_delay))
+    for i in range(attempts):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if i == attempts - 1:
+                raise
+            what = describe or getattr(fn, "__name__", "call")
+            print(f"retry: {what} failed ({type(e).__name__}: {e}); "
+                  f"attempt {i + 1}/{attempts}, backing off {delays[i]:.2g}s",
+                  file=sys.stderr)
+            sleep(delays[i])
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def io_retry(fn: Callable[..., Any], *args: Any,
+             describe: str | None = None, **kwargs: Any) -> Any:
+    """``retry_call`` tuned from the SPARKNET_IO_* env knobs — the wrapper
+    the data-plane opens (LMDB mmap, HDF5, source lists) go through."""
+    attempts = int(os.environ.get("SPARKNET_IO_RETRIES", "3") or 3)
+    base = float(os.environ.get("SPARKNET_IO_BACKOFF", "0.05") or 0.05)
+    return retry_call(fn, *args, attempts=attempts, base_delay=base,
+                      retry_on=(OSError,), describe=describe, **kwargs)

@@ -33,3 +33,43 @@ def rng():
 @pytest.fixture
 def np_rng():
     return np.random.default_rng(0)
+
+
+_MP_PROBE: dict = {"result": None}
+
+
+@pytest.fixture(scope="session")
+def multiprocess_cpu() -> bool:
+    """Whether this rig's CPU backend supports multiprocess XLA
+    computations.  Some jax builds reject them outright ('Multiprocess
+    computations aren't implemented on the CPU backend'); the multi-host
+    and multi-process chaos tests skip there instead of failing on an
+    environment limitation.  Probed once per session with a minimal
+    2-process driver run."""
+    if _MP_PROBE["result"] is None:
+        import subprocess
+        import sys
+        import tempfile
+
+        from sparknet_tpu.tools.launch import launch_local
+
+        driver = os.path.join(os.path.dirname(__file__),
+                              "multihost_driver.py")
+        saved = dict(os.environ)
+        os.environ.pop("XLA_FLAGS", None)   # this conftest's 8-device flag
+        for k in list(os.environ):
+            if k.startswith("SPARKNET_"):
+                os.environ.pop(k)
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                rc = launch_local(
+                    [sys.executable, driver, "--strategy", "sync",
+                     "--out", os.path.join(td, "probe.npz"),
+                     "--rounds", "1"],
+                    nprocs=2, platform="cpu", devices_per_proc=2,
+                    timeout=240)
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+        _MP_PROBE["result"] = rc == 0
+    return _MP_PROBE["result"]

@@ -3,6 +3,16 @@ hand): trains a fixed lenet workload over the global mesh and dumps final
 params.  Each process feeds only ITS rows of the deterministic global batch
 (the per-host partition placement of ImageNetApp.scala:145).
 
+Resilience rig: ``--ckpt-dir`` turns on round-granular checkpointing
+(params + per-worker solver state + round counter + RNG, manifest with
+checksum), and a relaunched driver auto-resumes from the newest valid
+manifest.  Every round start passes through the fault-injection hook
+(``SPARKNET_FAULT=crash@round:N@rank:R`` etc., utils/faults.py), so the
+chaos tests can kill a rank deterministically and assert the restarted
+job converges to the fault-free result.  Per-round data is derived from
+the ROUND INDEX alone (not a running RNG stream), so a resumed round
+refeeds exactly the batch the killed round would have seen.
+
 Invoked by sparknet_tpu.tools.launch (env contract) or standalone
 single-process with --local-devices N.
 """
@@ -14,6 +24,20 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def round_batch(r, tau, global_batch):
+    """Deterministic per-round lenet batch, a pure function of the round
+    index — the property that makes round-granular resume exact."""
+    import numpy as np
+    rng = np.random.default_rng(1000 + r)
+    y = rng.integers(0, 10, size=(tau, global_batch))
+    x = rng.normal(scale=0.3, size=(tau, global_batch, 1, 28, 28)
+                   ).astype(np.float32)
+    for t in range(tau):
+        for i, k in enumerate(y[t]):
+            x[t, i, :, int(k) % 28, :] += 2.0
+    return x, y
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--strategy", default="sync")
@@ -22,6 +46,9 @@ def main() -> None:
                     help="single-process mode: virtual CPU device count")
     ap.add_argument("--expect-devices", type=int, default=4,
                     help="global device count the mesh must have")
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="round-granular checkpoint/auto-resume directory")
     ap.add_argument("--fail-rank", type=int, default=None,
                     help="failure-path mode: this rank dies (exit 3) after "
                          "the first round")
@@ -46,6 +73,7 @@ def main() -> None:
         init_cluster_from_env, local_batch_slice,
     )
     from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.utils import faults
 
     distributed = init_cluster_from_env()
     if args.strategy == "hierarchical":
@@ -62,24 +90,26 @@ def main() -> None:
     assert n_devices == args.expect_devices, (
         f"expected {args.expect_devices} global devices, got {n_devices}")
 
-    GLOBAL_BATCH, TAU, ROUNDS = 16, 2, 2
+    GLOBAL_BATCH, TAU = 16, 2
     sp = load_solver_prototxt_with_net(
         'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n',
         lenet(GLOBAL_BATCH, GLOBAL_BATCH))
-    tr = DistributedTrainer(sp, mesh,
-                            TrainerConfig(strategy=args.strategy, tau=TAU),
-                            seed=0)
+    tr = DistributedTrainer(
+        sp, mesh,
+        TrainerConfig(strategy=args.strategy, tau=TAU,
+                      checkpoint_dir=args.ckpt_dir, checkpoint_every=1),
+        seed=0)
     rows = local_batch_slice(GLOBAL_BATCH)
+    injector = faults.get_injector()
+    rank = jax.process_index()
+    if tr.resumed:
+        print(f"driver: resumed at round {tr.round} (attempt "
+              f"{injector.attempt})", flush=True)
 
-    rng = np.random.default_rng(0)  # identical stream on every process
     losses = []
-    for r in range(ROUNDS):
-        y = rng.integers(0, 10, size=(TAU, GLOBAL_BATCH))
-        x = rng.normal(scale=0.3, size=(TAU, GLOBAL_BATCH, 1, 28, 28)
-                       ).astype(np.float32)
-        for t in range(TAU):
-            for i, k in enumerate(y[t]):
-                x[t, i, :, int(k) % 28, :] += 2.0
+    for r in range(tr.round, args.rounds):
+        injector.on_round(r, rank=rank)
+        x, y = round_batch(r, TAU, GLOBAL_BATCH)
         losses.append(tr.train_round(
             {"data": x[:, rows], "label": y[:, rows].astype(np.float32)}))
         if r == 0 and args.fail_rank is not None \
@@ -88,9 +118,10 @@ def main() -> None:
                   flush=True)
             os._exit(3)
 
-    eval_y = rng.integers(0, 10, size=(GLOBAL_BATCH,))
-    eval_x = rng.normal(scale=0.3, size=(GLOBAL_BATCH, 1, 28, 28)
-                        ).astype(np.float32)
+    erng = np.random.default_rng(2000)
+    eval_y = erng.integers(0, 10, size=(GLOBAL_BATCH,))
+    eval_x = erng.normal(scale=0.3, size=(GLOBAL_BATCH, 1, 28, 28)
+                         ).astype(np.float32)
     feed = iter([{"data": eval_x[rows],
                   "label": eval_y[rows].astype(np.float32)}] * 2)
     scores = tr.test(feed, num_steps=2)

@@ -90,6 +90,78 @@ def test_prefetch_iterator_order_and_error():
         list(it)
 
 
+def test_prefetch_producer_error_propagates_even_with_full_queue():
+    """Failure semantics: a producer exception must reach the consumer on
+    next() even when staged items sit ahead of it in the queue (the
+    consumer drains the good items, THEN sees the error — no silent
+    truncation of the stream)."""
+    def bad():
+        yield 1
+        yield 2
+        raise ValueError("producer died")
+
+    it = PrefetchIterator(bad(), depth=1)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(ValueError, match="producer died"):
+        next(it)
+    # the error is sticky: the iterator stays failed, not silently empty
+    with pytest.raises(ValueError, match="producer died"):
+        next(it)
+
+
+def test_prefetch_transform_error_propagates():
+    it = PrefetchIterator(iter([1, 2]), transform=lambda x: 1 // 0)
+    with pytest.raises(ZeroDivisionError):
+        next(it)
+
+
+def test_prefetch_close_after_error_does_not_deadlock_or_leak():
+    """close() after a producer error must return promptly and reap the
+    daemon thread — the InternalThread lifecycle contract
+    (internal_thread.hpp:29-42) under failure."""
+    import time
+
+    def bad():
+        yield 1
+        raise RuntimeError("late failure")
+
+    it = PrefetchIterator(bad(), depth=1)
+    assert next(it) == 1
+    t0 = time.monotonic()
+    it.close()
+    assert time.monotonic() - t0 < 5.0, "close() hung after producer error"
+    assert not it._thread.is_alive(), "producer thread leaked"
+    with pytest.raises(RuntimeError, match="late failure"):
+        next(it)  # the error stays visible after close, never masked
+
+
+def test_prefetch_close_with_blocked_producer_does_not_deadlock():
+    """A producer blocked on a FULL queue (endless source, consumer gone)
+    must be released by close() — otherwise it would pin staged device
+    memory for the rest of the process."""
+
+    it = PrefetchIterator(itertools.count(), depth=2)
+    assert next(it) == 0
+    it.close()
+    it._thread.join(timeout=5.0)
+    assert not it._thread.is_alive(), "producer stuck on full queue"
+
+
+def test_prefetch_slow_feed_fault_injection(monkeypatch):
+    """SPARKNET_FAULT=slow_feed:<dur> delays every produced batch — the
+    degraded-input-pipeline chaos mode."""
+    import time
+
+    monkeypatch.setenv("SPARKNET_FAULT", "slow_feed:30ms")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    t0 = time.monotonic()
+    out = list(PrefetchIterator(iter(range(4)), depth=1))
+    elapsed = time.monotonic() - t0
+    assert out == list(range(4))
+    assert elapsed >= 0.12, f"slow_feed not applied ({elapsed:.3f}s)"
+
+
 def test_partitioned_dataset():
     ds = PartitionedDataset.from_items(range(10), 3)
     assert ds.num_partitions == 3

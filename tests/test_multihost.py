@@ -37,11 +37,14 @@ def _run_single(out, strategy):
 
 
 @pytest.mark.parametrize("strategy", ["sync", "local_sgd", "hierarchical"])
-def test_two_process_matches_single_process(tmp_path, strategy):
+def test_two_process_matches_single_process(tmp_path, strategy,
+                                            multiprocess_cpu):
     """For "hierarchical" the two REAL processes are the two hosts of the
     2x2 pod mesh — per-step chip psum stays process-local, the tau-boundary
     weight average crosses the process boundary (the DCN tier), and the
     result must equal the single-process 2x2 virtual pod."""
+    if not multiprocess_cpu:
+        pytest.skip("CPU backend lacks multiprocess XLA computations")
     from sparknet_tpu.tools.launch import launch_local
 
     single = str(tmp_path / f"single_{strategy}.npz")
@@ -75,10 +78,12 @@ def test_two_process_matches_single_process(tmp_path, strategy):
                                    err_msg=f"param {k} diverged")
 
 
-def test_four_process_matches_single_process(tmp_path):
+def test_four_process_matches_single_process(tmp_path, multiprocess_cpu):
     """4 processes × 2 devices = 8-device global mesh; must equal one
     process with 8 virtual devices bit-close (deeper than the 2×2
     minimum shape — VERDICT r2 weak #3)."""
+    if not multiprocess_cpu:
+        pytest.skip("CPU backend lacks multiprocess XLA computations")
     from sparknet_tpu.tools.launch import launch_local
 
     single = str(tmp_path / "single8.npz")
@@ -134,13 +139,15 @@ def test_worker_death_is_reported_not_hung(tmp_path):
     assert time.monotonic() - t0 < 400, "launcher hung past its timeout"
 
 
-def test_ssh_mode_via_shim(tmp_path):
+def test_ssh_mode_via_shim(tmp_path, multiprocess_cpu):
     """Exercise launch_ssh end-to-end against a local `ssh` shim: the shim
     logs the wire command (host, BatchMode, env contract) and executes the
     remote string locally, so two fake 'hosts' form a real 2-process
     jax.distributed mesh.  This pins the ssh tier's command construction
     and env contract without an sshd (the pod itself stays
     live-system-untested, as documented in README)."""
+    if not multiprocess_cpu:
+        pytest.skip("CPU backend lacks multiprocess XLA computations")
     from sparknet_tpu.tools.launch import free_port, launch_ssh
 
     shim_dir = tmp_path / "bin"

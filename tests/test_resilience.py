@@ -1,0 +1,628 @@
+"""Resilient-runtime coverage: fault-spec grammar, injector semantics,
+restart policy/backoff, launcher supervision, bounded control-plane
+retries, checkpoint integrity (checksums, CheckpointError), round-granular
+trainer checkpoint/resume, and the end-to-end chaos paths — a rank killed
+mid-job recovers through ResilientRunner and matches the fault-free run
+(the recovery half of the reference's spark.task.maxFailures contract,
+CifarApp.scala:36; snapshots-as-recovery per Caffe's Solver::Snapshot).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.parallel.resilience import (
+    Attempt, ResilientRunner, RestartPolicy,
+)
+from sparknet_tpu.utils import faults
+from sparknet_tpu.utils.checkpoint import (
+    CheckpointError, load_checkpoint, save_checkpoint,
+)
+from sparknet_tpu.utils.retry import backoff_delays, retry_call
+
+DRIVER = os.path.join(os.path.dirname(__file__), "multihost_driver.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fault grammar + injector
+# ---------------------------------------------------------------------------
+
+def test_parse_faults_grammar():
+    specs = faults.parse_faults(
+        "crash@round:3@rank:1, slow_feed:200ms, corrupt_ckpt@round:2,"
+        "hang@round:5@attempt:2")
+    assert specs[0] == faults.FaultSpec("crash", round=3, rank=1)
+    assert specs[1].kind == "slow_feed"
+    assert specs[1].delay_s == pytest.approx(0.2)
+    assert specs[2] == faults.FaultSpec("corrupt_ckpt", round=2)
+    assert specs[3] == faults.FaultSpec("hang", round=5, attempt=2)
+
+
+@pytest.mark.parametrize("bad, msg", [
+    ("explode@round:1", "unknown fault kind"),
+    ("crash", "needs @round"),
+    ("crash@round:x", "not an integer"),
+    ("crash@rnd:1", "bad modifier"),
+    ("slow_feed", "needs a duration"),
+    ("slow_feed:fast", "bad duration"),
+    ("crash:3@round:1", "takes no ':' arg"),
+])
+def test_parse_faults_rejects(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        faults.parse_faults(bad)
+
+
+def test_duration_units():
+    assert faults.parse_faults("slow_feed:1.5s")[0].delay_s == 1.5
+    assert faults.parse_faults("slow_feed:2")[0].delay_s == 2.0
+
+
+class _Exit(Exception):
+    pass
+
+
+def _injector(spec, attempt=0, rank=0):
+    calls = {"exit": [], "sleep": []}
+
+    def fake_exit(code):
+        calls["exit"].append(code)
+        raise _Exit()  # real os._exit never returns; simulate that
+
+    def fake_sleep(s):
+        calls["sleep"].append(s)
+        raise _Exit()  # break the hang loop
+
+    inj = faults.FaultInjector(faults.parse_faults(spec), attempt=attempt,
+                               rank=rank, _exit=fake_exit, _sleep=fake_sleep)
+    return inj, calls
+
+
+def test_crash_fires_on_matching_round_and_rank_only():
+    inj, calls = _injector("crash@round:3@rank:1", rank=1)
+    inj.on_round(2, rank=1)            # wrong round: no-op
+    inj.on_round(3, rank=0)            # wrong rank: no-op
+    assert calls["exit"] == []
+    with pytest.raises(_Exit):
+        inj.on_round(3, rank=1)
+    assert calls["exit"] == [43]
+
+
+def test_one_shot_faults_default_to_first_attempt_only():
+    inj, calls = _injector("crash@round:1", attempt=1)
+    inj.on_round(1)                    # restarted job: fault suppressed
+    assert calls["exit"] == []
+    inj0, calls0 = _injector("crash@round:1", attempt=0)
+    with pytest.raises(_Exit):
+        inj0.on_round(1)
+
+
+def test_attempt_scoped_fault():
+    inj, calls = _injector("hang@round:2@attempt:1", attempt=1)
+    with pytest.raises(_Exit):
+        inj.on_round(2)
+    assert calls["sleep"]              # entered the hang loop
+
+
+def test_slow_feed_applies_on_every_attempt():
+    inj, _ = _injector("slow_feed:50ms", attempt=3)
+    assert inj.feed_delay() == pytest.approx(0.05)
+    assert inj.feed_delay(rank=7) == pytest.approx(0.05)
+    inj2, _ = _injector("slow_feed:50ms@rank:1", attempt=0)
+    assert inj2.feed_delay(rank=0) == 0.0
+
+
+def test_corrupt_ckpt_matching():
+    inj, _ = _injector("corrupt_ckpt@round:2")
+    assert inj.corrupt_checkpoint(2)
+    assert not inj.corrupt_checkpoint(3)
+    inj1, _ = _injector("corrupt_ckpt@round:2", attempt=1)
+    assert not inj1.corrupt_checkpoint(2)   # one-shot: attempt 0 only
+
+
+def test_get_injector_tracks_env(monkeypatch):
+    monkeypatch.setenv("SPARKNET_FAULT", "slow_feed:10ms")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    assert faults.get_injector().feed_delay() == pytest.approx(0.01)
+    monkeypatch.setenv("SPARKNET_FAULT", "")
+    assert faults.get_injector().feed_delay() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# restart policy + ResilientRunner (fake launcher)
+# ---------------------------------------------------------------------------
+
+def test_restart_policy_backoff_sequence_and_cap():
+    p = RestartPolicy(max_restarts=5, backoff_base=1.0, backoff_factor=3.0,
+                      backoff_max=10.0)
+    assert [p.delay(i) for i in range(4)] == [1.0, 3.0, 9.0, 10.0]
+
+
+def test_runner_requires_exactly_one_mode():
+    with pytest.raises(ValueError, match="exactly one"):
+        ResilientRunner(["true"])
+    with pytest.raises(ValueError, match="exactly one"):
+        ResilientRunner(["true"], nprocs=2, hosts=["a"])
+
+
+def _fake_runner(monkeypatch, rcs):
+    """ResilientRunner whose launch returns scripted rcs and records the
+    per-attempt env stamps and sleeps."""
+    import sparknet_tpu.parallel.resilience as R
+    seen = {"envs": [], "sleeps": []}
+    it = iter(rcs)
+
+    def fake_local(cmd, nprocs, **kw):
+        seen["envs"].append(dict(kw["extra_env"]))
+        return next(it)
+
+    monkeypatch.setattr(R, "launch_local", fake_local)
+    runner = ResilientRunner(
+        ["job"], nprocs=2,
+        policy=RestartPolicy(max_restarts=3, backoff_base=0.5),
+        sleep=lambda s: seen["sleeps"].append(s))
+    return runner, seen
+
+
+def test_runner_success_first_try_no_restart(monkeypatch):
+    runner, seen = _fake_runner(monkeypatch, [0])
+    assert runner.run() == 0
+    assert seen["sleeps"] == []
+    assert [a.returncode for a in runner.attempts] == [0]
+    assert seen["envs"][0]["SPARKNET_FAULT_ATTEMPT"] == "0"
+
+
+def test_runner_restarts_with_backoff_and_attempt_stamp(monkeypatch):
+    runner, seen = _fake_runner(monkeypatch, [43, 1, 0])
+    assert runner.run() == 0
+    assert seen["sleeps"] == [0.5, 1.0]          # exponential backoff
+    assert [e["SPARKNET_FAULT_ATTEMPT"] for e in seen["envs"]] == \
+        ["0", "1", "2"]
+    assert [a.returncode for a in runner.attempts] == [43, 1, 0]
+    assert isinstance(runner.attempts[0], Attempt)
+
+
+def test_runner_bounded_budget_gives_up(monkeypatch):
+    runner, seen = _fake_runner(monkeypatch, [7, 7, 7, 7])
+    assert runner.run() == 7
+    assert len(runner.attempts) == 4             # max_restarts=3 → 4 tries
+    assert seen["sleeps"] == [0.5, 1.0, 2.0]     # no sleep after final try
+
+
+# ---------------------------------------------------------------------------
+# bounded retry helper + control-plane edges
+# ---------------------------------------------------------------------------
+
+def test_backoff_delays_shape():
+    assert list(backoff_delays(4, 0.1, 2.0, 0.3)) == \
+        pytest.approx([0.1, 0.2, 0.3])
+    assert list(backoff_delays(1, 0.1)) == []
+
+
+def test_retry_call_recovers_then_gives_up():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    sleeps = []
+    assert retry_call(flaky, attempts=3, base_delay=0.01,
+                      sleep=sleeps.append) == "ok"
+    assert sleeps == pytest.approx([0.01, 0.02])
+
+    calls["n"] = -10  # always failing now
+    with pytest.raises(OSError, match="transient"):
+        retry_call(flaky, attempts=2, base_delay=0.01, sleep=sleeps.append)
+
+
+def test_retry_call_non_matching_exception_propagates_immediately():
+    def boom():
+        raise KeyError("nope")
+
+    sleeps = []
+    with pytest.raises(KeyError):
+        retry_call(boom, attempts=5, sleep=sleeps.append)
+    assert sleeps == []
+
+
+def test_io_retry_env_knobs(monkeypatch, tmp_path):
+    monkeypatch.setenv("SPARKNET_IO_RETRIES", "4")
+    monkeypatch.setenv("SPARKNET_IO_BACKOFF", "0")
+    from sparknet_tpu.utils.retry import io_retry
+    calls = {"n": 0}
+
+    def flaky_open():
+        calls["n"] += 1
+        raise OSError("gone")
+
+    with pytest.raises(OSError):
+        io_retry(flaky_open)
+    assert calls["n"] == 4
+
+
+def test_lmdb_reader_retries_transient_open(tmp_path, monkeypatch):
+    from sparknet_tpu.data import lmdb_io
+    db = tmp_path / "db"
+    lmdb_io.write_lmdb(str(db), [(b"k", b"v")])
+    monkeypatch.setenv("SPARKNET_IO_RETRIES", "3")
+    monkeypatch.setenv("SPARKNET_IO_BACKOFF", "0")
+    real_open, state = open, {"n": 0}
+
+    def flaky(path, *a, **kw):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise OSError("NFS blip")
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr("builtins.open", flaky)
+    with lmdb_io.LmdbReader(str(db)) as r:
+        assert r.first() == (b"k", b"v")
+    assert state["n"] >= 2
+
+
+def test_init_cluster_from_env_validation(monkeypatch):
+    from sparknet_tpu.parallel import cluster
+    joined = []
+    monkeypatch.setattr(cluster, "init_cluster",
+                        lambda *a: joined.append(a))
+    for var in ("SPARKNET_COORDINATOR", "SPARKNET_NUM_PROCS",
+                "SPARKNET_PROC_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert cluster.init_cluster_from_env() is False
+
+    monkeypatch.setenv("SPARKNET_COORDINATOR", "127.0.0.1:1234")
+    with pytest.raises(ValueError, match="SPARKNET_NUM_PROCS is missing"):
+        cluster.init_cluster_from_env()
+    monkeypatch.setenv("SPARKNET_NUM_PROCS", "two")
+    monkeypatch.setenv("SPARKNET_PROC_ID", "0")
+    with pytest.raises(ValueError, match="SPARKNET_NUM_PROCS='two' is not"):
+        cluster.init_cluster_from_env()
+    monkeypatch.setenv("SPARKNET_NUM_PROCS", "2")
+    monkeypatch.setenv("SPARKNET_PROC_ID", "2")
+    with pytest.raises(ValueError, match="out of range"):
+        cluster.init_cluster_from_env()
+    monkeypatch.setenv("SPARKNET_PROC_ID", "1")
+    assert cluster.init_cluster_from_env() is True
+    assert joined == [("127.0.0.1:1234", 2, 1)]
+    # partial contract without coordinator is named, not silently ignored
+    monkeypatch.delenv("SPARKNET_COORDINATOR")
+    with pytest.raises(ValueError, match="SPARKNET_COORDINATOR is not"):
+        cluster.init_cluster_from_env()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_with_checksum(tmp_path):
+    p = str(tmp_path / "c.npz")
+    tree = {"w": np.arange(6.0).reshape(2, 3), "n": [np.int64(3)]}
+    save_checkpoint(p, tree)
+    out = load_checkpoint(p)
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    assert int(out["n"][0]) == 3
+
+
+def test_truncated_checkpoint_raises_checkpoint_error(tmp_path):
+    p = str(tmp_path / "trunc.npz")
+    save_checkpoint(p, {"w": np.zeros(1000)})
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(CheckpointError) as ei:
+        load_checkpoint(p)
+    assert ei.value.path == p
+
+
+def test_bitflip_fails_checksum(tmp_path):
+    p = str(tmp_path / "rot.npz")
+    save_checkpoint(p, {"w": np.zeros(4096, np.float32)})
+    faults.scribble(p)
+    with pytest.raises(CheckpointError):
+        load_checkpoint(p)
+
+
+def test_missing_checkpoint_raises_checkpoint_error(tmp_path):
+    with pytest.raises(CheckpointError):
+        load_checkpoint(str(tmp_path / "absent.npz"))
+
+
+# ---------------------------------------------------------------------------
+# launcher supervision
+# ---------------------------------------------------------------------------
+
+def test_first_worker_death_tears_down_survivors_fast():
+    """One worker exits nonzero while its sibling would sleep for 60s: the
+    supervisor must kill the sibling and return well before that (the
+    stage-abort, without waiting for the job timeout)."""
+    from sparknet_tpu.tools.launch import _wait_all
+    quick = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(5)"])
+    slow = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    t0 = time.monotonic()
+    rc = _wait_all([quick, slow], timeout=50)
+    assert rc == 5
+    assert time.monotonic() - t0 < 30
+    assert slow.poll() is not None  # sibling was killed
+
+
+def test_wait_all_timeout_returns_124():
+    from sparknet_tpu.tools.launch import _wait_all
+    p = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    assert _wait_all([p], timeout=0.5) == 124
+
+
+def test_launch_local_extra_env_reaches_children(tmp_path):
+    from sparknet_tpu.tools.launch import launch_local
+    out = tmp_path / "env.txt"
+    code = (f"import os; open({str(out)!r}, 'a').write("
+            f"os.environ['SPARKNET_FAULT_ATTEMPT'] + '\\n')")
+    rc = launch_local([sys.executable, "-c", code], nprocs=2,
+                      timeout=60, extra_env={"SPARKNET_FAULT_ATTEMPT": "7"})
+    assert rc == 0
+    assert out.read_text().splitlines() == ["7", "7"]
+
+
+# ---------------------------------------------------------------------------
+# trainer round-granular checkpoint / resume (in-process, 4 virtual devices)
+# ---------------------------------------------------------------------------
+
+def _make_trainer(ckpt_dir, seed=0, every=1, keep=3):
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.parallel import (
+        DistributedTrainer, TrainerConfig, make_mesh,
+    )
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n', lenet(16, 16))
+    cfg = TrainerConfig(strategy="local_sgd", tau=2,
+                        checkpoint_dir=str(ckpt_dir), checkpoint_every=every,
+                        checkpoint_keep=keep)
+    return DistributedTrainer(sp, make_mesh(4), cfg, seed=seed)
+
+
+def _batch(r):
+    rng = np.random.default_rng(100 + r)
+    return {"data": rng.normal(size=(2, 16, 1, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(2, 16)).astype(np.float32)}
+
+
+def test_round_checkpoint_resume_is_exact(tmp_path):
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)
+    for r in range(3):
+        tr.data_cursor = {"next_round": r + 1}
+        tr.train_round(_batch(r))
+    # fresh trainer auto-resumes at round 3 with identical state
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None
+    assert tr2.round == 3 and tr2.iter == 6
+    assert tr2.data_cursor == {"next_round": 3}
+    np.testing.assert_allclose(np.asarray(tr2.params["conv1"][0]),
+                               np.asarray(tr.params["conv1"][0]))
+    # one more round on both: bit-identical continuation (RNG restored too)
+    tr.train_round(_batch(3))
+    tr2.train_round(_batch(3))
+    np.testing.assert_allclose(np.asarray(tr2.params["conv1"][0]),
+                               np.asarray(tr.params["conv1"][0]))
+    np.testing.assert_allclose(np.asarray(tr2.params["ip2"][0]),
+                               np.asarray(tr.params["ip2"][0]))
+
+
+def test_checkpoint_every_and_pruning(tmp_path):
+    d = tmp_path / "ck"
+    tr = _make_trainer(d, every=2, keep=2)
+    for r in range(8):
+        tr.train_round(_batch(r))
+    rounds = sorted(int(f[len("manifest_"):-len(".json")])
+                    for f in os.listdir(d) if f.startswith("manifest_"))
+    assert rounds == [6, 8]            # every 2 rounds, newest 2 kept
+    assert sorted(f for f in os.listdir(d) if f.endswith(".npz")) == \
+        ["ckpt_round_00000006.npz", "ckpt_round_00000008.npz"]
+
+
+@pytest.mark.chaos
+def test_corrupt_checkpoint_falls_back_to_previous_manifest(tmp_path):
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)
+    for r in range(3):
+        tr.train_round(_batch(r))
+    # scribble the NEWEST snapshot (round 3) — manifest checksum now lies
+    faults.scribble(str(d / "ckpt_round_00000003.npz"))
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None
+    assert tr2.round == 2              # fell back, did not crash
+    assert tr2.resumed["file"] == "ckpt_round_00000002.npz"
+
+
+@pytest.mark.chaos
+def test_corrupt_ckpt_fault_injection_end_to_end(tmp_path, monkeypatch):
+    """The writer-side corrupt_ckpt fault produces exactly the
+    corrupt-newest layout, and auto-resume survives it."""
+    d = tmp_path / "ck"
+    monkeypatch.setenv("SPARKNET_FAULT", "corrupt_ckpt@round:3")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    tr = _make_trainer(d)
+    for r in range(3):
+        tr.train_round(_batch(r))
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "1")  # the restarted job
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None and tr2.round == 2
+    # and the restarted job's own round-3 checkpoint is clean this time
+    tr2.train_round(_batch(2))
+    blob = load_checkpoint(str(d / "ckpt_round_00000003.npz"))
+    assert int(blob["round"]) == 3
+
+
+def test_mesh_shape_mismatch_raises_not_skips(tmp_path):
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.parallel import (
+        DistributedTrainer, TrainerConfig, make_mesh,
+    )
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)
+    tr.train_round(_batch(0))
+    sp = load_solver_prototxt_with_net(
+        'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n', lenet(16, 16))
+    cfg = TrainerConfig(strategy="local_sgd", tau=2, checkpoint_dir=str(d))
+    with pytest.raises(ValueError, match="mesh shape|workers"):
+        DistributedTrainer(sp, make_mesh(8), cfg, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos: crash → automatic restart → exact recovery
+# ---------------------------------------------------------------------------
+
+def _clean_launch_env():
+    saved = dict(os.environ)
+    os.environ.pop("XLA_FLAGS", None)  # conftest's 8-device flag
+    for k in list(os.environ):
+        if k.startswith("SPARKNET_"):
+            os.environ.pop(k)
+    return saved
+
+
+def _run_crash_restart(tmp_path, *, nprocs, devices_per_proc,
+                       local_devices, fault):
+    """Shared body: fault-free baseline vs ResilientRunner-supervised run
+    with an injected crash; returns (runner, baseline npz, chaos npz,
+    ckpt dir)."""
+    base = str(tmp_path / "base.npz")
+    out = str(tmp_path / "chaos.npz")
+    ck = str(tmp_path / "ck")
+    extra = ["--rounds", "4"]
+    if local_devices:
+        extra += ["--local-devices", str(local_devices)]
+
+    saved = _clean_launch_env()
+    try:
+        from sparknet_tpu.tools.launch import launch_local
+        rc = launch_local(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", base]
+            + extra,
+            nprocs=nprocs, platform="cpu",
+            devices_per_proc=devices_per_proc, timeout=300)
+        assert rc == 0, f"fault-free run failed rc={rc}"
+
+        runner = ResilientRunner(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
+             "--ckpt-dir", ck] + extra,
+            nprocs=nprocs, platform="cpu",
+            devices_per_proc=devices_per_proc, timeout=300,
+            policy=RestartPolicy(max_restarts=2, backoff_base=0.2),
+            extra_env={"SPARKNET_FAULT": fault})
+        rc = runner.run()
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+    assert rc == 0, f"job did not recover, rc={rc}"
+    # exactly one failed attempt (the injected crash) then a clean recovery
+    assert len(runner.attempts) == 2
+    assert runner.attempts[0].returncode != 0
+    assert runner.attempts[1].returncode == 0
+    a, b = np.load(base), np.load(out)
+    for k in a.files:
+        if k.startswith("__"):
+            continue
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=f"param {k} diverged after "
+                                           f"crash-restart recovery")
+    np.testing.assert_allclose(a["__scores__"], b["__scores__"],
+                               rtol=1e-5, atol=1e-5)
+    # the crash cost one round, not the run: manifests exist on disk
+    assert any(f.startswith("manifest_") for f in os.listdir(ck))
+    return runner, base, out, ck
+
+
+@pytest.mark.chaos
+def test_crash_restart_completes_and_matches_fault_free(tmp_path):
+    """THE acceptance path: the worker dies at round 3 of 4
+    (SPARKNET_FAULT=crash@round:3); ResilientRunner relaunches, the job
+    auto-resumes from the newest valid manifest, and the final params
+    equal a fault-free run of the same config — recovery is exact at
+    round granularity."""
+    runner, _, _, ck = _run_crash_restart(
+        tmp_path, nprocs=1, devices_per_proc=None, local_devices=4,
+        fault="crash@round:3")
+    assert runner.attempts[0].returncode == 43  # the injected os._exit
+
+
+@pytest.mark.chaos
+def test_crash_restart_two_process_one_rank(tmp_path, multiprocess_cpu):
+    """Same contract with a REAL 2-process mesh and only rank 1 dying:
+    the supervisor must tear down the surviving rank and relaunch both.
+    Skips on CPU backends without multiprocess computations (those rigs
+    skip test_multihost identically)."""
+    if not multiprocess_cpu:
+        pytest.skip("CPU backend lacks multiprocess XLA computations")
+    _run_crash_restart(
+        tmp_path, nprocs=2, devices_per_proc=2, local_devices=None,
+        fault="crash@round:3@rank:1")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_hang_restart_recovers_via_timeout(tmp_path):
+    """A HUNG worker (not dead — blocked forever) is only detectable by
+    the job timeout: the supervisor must kill it (rc 124) and the restart
+    must still recover from the checkpoint."""
+    out = str(tmp_path / "hang.npz")
+    ck = str(tmp_path / "ck")
+    saved = _clean_launch_env()
+    try:
+        runner = ResilientRunner(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
+             "--local-devices", "4", "--rounds", "2", "--ckpt-dir", ck],
+            nprocs=1, platform="cpu", timeout=60,
+            policy=RestartPolicy(max_restarts=1, backoff_base=0.2),
+            extra_env={"SPARKNET_FAULT": "hang@round:1"})
+        rc = runner.run()
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    assert rc == 0, f"hung job did not recover, rc={rc}"
+    assert [a.returncode for a in runner.attempts] == [124, 0]
+    assert os.path.exists(out)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_ssh_mode_crash_restart_via_shim(tmp_path, multiprocess_cpu):
+    """ResilientRunner over launch_ssh (shimmed ssh, as in
+    test_multihost.test_ssh_mode_via_shim): a crashed 'host' is restarted
+    and the job completes from its checkpoint."""
+    if not multiprocess_cpu:
+        pytest.skip("CPU backend lacks multiprocess XLA computations")
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "ssh"
+    shim.write_text("#!/bin/bash\nexec bash -c \"$4\"\n")
+    shim.chmod(0o755)
+
+    out = str(tmp_path / "ssh_chaos.npz")
+    ck = str(tmp_path / "ck")
+    saved = _clean_launch_env()
+    os.environ["PATH"] = f"{shim_dir}:{os.environ['PATH']}"
+    try:
+        runner = ResilientRunner(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
+             "--local-devices", "2", "--rounds", "3", "--ckpt-dir", ck],
+            hosts=["127.0.0.1", "localhost"], cwd=REPO, timeout=300,
+            policy=RestartPolicy(max_restarts=2, backoff_base=0.2),
+            extra_env={"SPARKNET_FAULT": "crash@round:2@rank:1"})
+        rc = runner.run()
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    assert rc == 0, f"ssh-mode job did not recover, rc={rc}"
+    assert len(runner.attempts) == 2
+    assert os.path.exists(out)
