@@ -1,0 +1,120 @@
+"""Drive PR 2 surfaces end-to-end: numerical guard rollback, elastic
+resume, heartbeats/straggler supervision, preemption signals.
+Run from repo root: python .drive_r7.py"""
+import os, sys, tempfile, time
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from sparknet_tpu.models import lenet
+from sparknet_tpu.parallel import (
+    DistributedTrainer, TrainerConfig, TrainingDivergedError, make_mesh,
+    ElasticPolicy, ResilienceError, ResilientRunner, RestartPolicy, health,
+)
+from sparknet_tpu.proto import load_solver_prototxt_with_net
+from sparknet_tpu.utils import faults
+
+SP = 'base_lr: 0.005\nmomentum: 0.9\nlr_policy: "fixed"\n'
+
+def trainer(d, workers, **kw):
+    sp = load_solver_prototxt_with_net(SP, lenet(24, 24))
+    return DistributedTrainer(sp, make_mesh(workers),
+                              TrainerConfig(strategy="local_sgd", tau=2,
+                                            checkpoint_dir=d, **kw), seed=0)
+
+def batch(r):
+    rng = np.random.default_rng(100 + r)
+    return {"data": rng.normal(size=(2, 24, 1, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(2, 24)).astype(np.float32)}
+
+# 1) numerical guard: poison round 2, roll back, match fault-free exactly
+da, db = tempfile.mkdtemp(), tempfile.mkdtemp()
+clean = trainer(da, 4, guard_numerics=True)
+clean_losses = [clean.train_round(batch(r)) for r in range(4)]
+os.environ["SPARKNET_FAULT"] = "nan_inject@round:2"
+faults.reset_injector()
+tr = trainer(db, 4, guard_numerics=True)
+while tr.round < 4:
+    tr.train_round(batch(tr.round))
+os.environ.pop("SPARKNET_FAULT"); faults.reset_injector()
+assert tr.guard_trips == 1, tr.guard_trips
+np.testing.assert_array_equal(np.asarray(tr.params["conv1"][0]),
+                              np.asarray(clean.params["conv1"][0]))
+print("1) guard: NaN round dropped, rollback exact, trips =", tr.guard_trips)
+
+# 2) elastic resume: the 4-worker checkpoint re-forms on 3 workers
+b = trainer(db, 3, elastic=True)
+assert b.round == 4 and b.n_workers == 3
+l = b.train_round(batch(4))
+assert np.isfinite(l)
+print(f"2) elastic: resumed 4->3 workers at round {b.round - 1}, "
+      f"continued with loss {l:.3f}")
+
+# 3) error paths: non-elastic mismatch raises; guard needs a ckpt dir
+try:
+    trainer(da, 3); raise AssertionError("should have raised")
+except ValueError as e:
+    assert "elastic" in str(e)
+try:
+    trainer(None, 4, guard_numerics=True); raise AssertionError("no raise")
+except ValueError as e:
+    assert "guard_numerics" in str(e)
+print("3) error paths: mismatch/config errors raise with guidance")
+
+# 4) heartbeats + straggler supervision + elastic re-form, real processes
+saved = dict(os.environ)
+os.environ.pop("XLA_FLAGS", None)
+for k in list(os.environ):
+    if k.startswith("SPARKNET_"):
+        os.environ.pop(k)
+try:
+    wd = tempfile.mkdtemp()
+    worker = os.path.join(wd, "w.py")
+    with open(worker, "w") as f:
+        f.write("""import os, sys, time
+sys.path.insert(0, %r)
+from sparknet_tpu.parallel import health
+from sparknet_tpu.utils import faults
+rank = int(os.environ["SPARKNET_PROC_ID"])
+inj = faults.FaultInjector.from_env()
+for r in range(3):
+    health.maybe_beat(r, "round_start")
+    inj.on_round(r, rank=rank)
+    time.sleep(0.05)
+print("ok", rank, os.environ["SPARKNET_NUM_PROCS"])
+""" % os.getcwd())
+    runner = ResilientRunner(
+        [sys.executable, worker], nprocs=4, timeout=120,
+        policy=RestartPolicy(max_restarts=1, backoff_base=0.05, jitter=0.0),
+        elastic=ElasticPolicy(enabled=True, min_workers=2),
+        extra_env={"SPARKNET_FAULT": "perma_crash@rank:3"})
+    rc = runner.run()
+    assert rc == 0 and runner.nprocs == 3 and runner.incarnation == 1
+    print("4) elastic re-form: perma-crashed rank dropped, survivors "
+          "completed; attempts:",
+          [(a.returncode, a.world) for a in runner.attempts])
+
+    # 5) straggler: hung worker killed at the deadline, post-mortem raised
+    with open(worker, "a") as f:
+        f.write("\nif rank == 1:\n    print('HUNG-HERE', flush=True)\n"
+                "    time.sleep(600)\n")
+    runner2 = ResilientRunner(
+        [sys.executable, worker], nprocs=2, timeout=300, round_deadline=3.0,
+        policy=RestartPolicy(max_restarts=0))
+    t0 = time.monotonic()
+    try:
+        runner2.run_or_raise(); raise AssertionError("should have raised")
+    except ResilienceError as e:
+        took = time.monotonic() - t0
+        assert e.cause == "straggler" and e.rank == 1, (e.cause, e.rank)
+        assert "HUNG-HERE" in (e.log_tail or ""), "log tail missing"
+        assert e.heartbeat_age is not None
+        assert took < 60, took
+        print(f"5) straggler: killed at deadline in {took:.1f}s (not "
+              f"600s); post-mortem has log tail + heartbeat age "
+              f"{e.heartbeat_age:.1f}s")
+finally:
+    os.environ.clear(); os.environ.update(saved)
+print("DRIVE OK")

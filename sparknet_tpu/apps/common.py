@@ -183,8 +183,10 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
             action = guard.check()
             if action == SolverAction.SNAPSHOT:
                 maybe_snapshot("SIGHUP")
-            elif action == SolverAction.STOP:
-                log.log("stop requested (SIGINT); halting at round boundary")
+            elif action in (SolverAction.STOP, SolverAction.SNAPSHOT_STOP):
+                why = ("SIGTERM/preemption"
+                       if action == SolverAction.SNAPSHOT_STOP else "SIGINT")
+                log.log(f"stop requested ({why}); halting at round boundary")
                 maybe_snapshot("stop")
                 return last_scores
             if test_interval and r % test_interval == 0 and r > 0:

@@ -65,6 +65,48 @@ class PartitionedDataset:
         flat = [x for p in self.partitions for x in p]
         return PartitionedDataset.from_items(flat, n)
 
+    # -- elastic membership support (the re-shard half of degraded-mode
+    #    training: when a worker is dropped or rejoins, the survivor set
+    #    must re-cover ALL the data, not orphan the lost partition) ------
+    def without_partitions(self, dropped: Sequence[int]
+                           ) -> "PartitionedDataset":
+        """Remove the given partition indices (a dead worker's shard),
+        keeping order — the records they held are NOT re-covered; chain
+        with :meth:`rebalance` when the survivors must take them over."""
+        drop = set(dropped)
+        bad = [i for i in drop if not 0 <= i < self.num_partitions]
+        if bad:
+            raise IndexError(
+                f"partition indices {sorted(bad)} out of range for "
+                f"{self.num_partitions} partitions")
+        return PartitionedDataset(
+            [p for i, p in enumerate(self.partitions) if i not in drop])
+
+    def rebalance(self, num_partitions: int) -> "PartitionedDataset":
+        """Re-shard every record over ``num_partitions`` contiguous,
+        size-balanced partitions (sizes differ by at most 1), preserving
+        record order.  This is the elastic re-form primitive: after a
+        permanent worker loss the survivors call
+        ``ds.without_partitions([dead]).rebalance(n_survivors)`` and the
+        full epoch is re-covered by the smaller worker set; a rejoining
+        worker re-runs it with the larger count at the next round
+        boundary.  Unlike :meth:`coalesce` (round-robin — the historical
+        parallelize analog), contiguous reassignment keeps each record's
+        neighborhood, so sequential readers (LMDB cursors, tar members)
+        stay sequential."""
+        if num_partitions < 1:
+            raise ValueError(
+                f"rebalance needs num_partitions >= 1, got {num_partitions}")
+        flat = [x for p in self.partitions for x in p]
+        n, k = len(flat), num_partitions
+        base, extra = divmod(n, k)
+        parts, at = [], 0
+        for i in range(k):
+            size = base + (1 if i < extra else 0)
+            parts.append(flat[at:at + size])
+            at += size
+        return PartitionedDataset(parts)
+
     def iterator(self, partition: int) -> Iterator[Any]:
         return iter(self.partitions[partition])
 

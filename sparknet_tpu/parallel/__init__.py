@@ -2,7 +2,14 @@ from .mesh import make_mesh, make_pod_mesh, replicated, batch_sharded
 from .trainer import (
     DistributedTrainer,
     TrainerConfig,
+    TrainingDivergedError,
     device_crop_mirror_mean,
 )
 from .cluster import init_cluster, is_multi_host, local_batch_slice
-from .resilience import ResilientRunner, RestartPolicy
+from .resilience import (
+    ElasticPolicy,
+    ResilienceError,
+    ResilientRunner,
+    RestartPolicy,
+)
+from . import health

@@ -34,16 +34,22 @@ def init_cluster(coordinator_address: str | None = None,
     ranks race the coordinator's socket bind, and a restarted job can hit
     its predecessor's port in TIME_WAIT — so the connect is retried with
     bounded exponential backoff (SPARKNET_CONNECT_RETRIES /
-    SPARKNET_CONNECT_BACKOFF, defaults 3 / 0.5s)."""
+    SPARKNET_CONNECT_BACKOFF, defaults 3 / 0.5s).  The backoff is
+    JITTERED by default (SPARKNET_CONNECT_JITTER, default 0.25): a
+    relaunched job restarts ALL its ranks at the same instant, and
+    without jitter every rank re-dials the coordinator in lockstep — the
+    textbook thundering herd."""
     from ..utils.retry import retry_call
     attempts = int(os.environ.get("SPARKNET_CONNECT_RETRIES", "3") or 3)
     base = float(os.environ.get("SPARKNET_CONNECT_BACKOFF", "0.5") or 0.5)
+    jitter = float(os.environ.get("SPARKNET_CONNECT_JITTER", "0.25")
+                   or 0.25)
     retry_call(
         jax.distributed.initialize,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
-        attempts=attempts, base_delay=base,
+        attempts=attempts, base_delay=base, jitter=jitter,
         retry_on=(RuntimeError, OSError, ConnectionError, TimeoutError),
         describe="jax.distributed.initialize")
 

@@ -1,0 +1,155 @@
+"""Worker liveness heartbeats and straggler detection.
+
+SparkNet had no health plane at all: a hung executor stalled the stage
+until Spark's network timeout, and the driver could not tell "slow" from
+"dead" (SURVEY.md §2.5 — all supervision was Spark's, at whole-stage
+granularity).  This module is the missing beacon layer: every worker
+publishes a tiny per-rank heartbeat file at round boundaries (atomic
+tmp+rename into a directory the supervisor shares — the same shared-fs
+assumption the checkpoint dir already makes), and the supervisor side
+(``StragglerMonitor``, consumed by ``tools.launch``) turns beat *age*
+into a per-round deadline: a rank that stops beating past the deadline
+is declared hung and killed, so the survivors relaunch from the last
+checkpoint instead of waiting out the global job timeout.
+
+Contract notes:
+- A beat is one JSON file per rank (``hb_rank_<R>.json``), replaced
+  atomically — readers never see a torn write.
+- The deadline only engages for ranks that have beaten at least once:
+  startup (imports, jit compile) is covered by the job-level timeout,
+  not the round deadline.
+- Ages compare the supervisor's clock against the writer's; local mode
+  shares one clock, ssh mode assumes NTP-level agreement (document your
+  skew into the deadline).
+
+Env contract (set by the launcher, consumed by ``maybe_beat``):
+  SPARKNET_HEARTBEAT_DIR — where to publish; absent = beacons off.
+  SPARKNET_PROC_ID       — the rank stamped into the beat.
+  SPARKNET_FAULT_ATTEMPT — the job attempt stamped into the beat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable
+
+HB_PREFIX = "hb_rank_"
+ENV_DIR = "SPARKNET_HEARTBEAT_DIR"
+
+
+@dataclasses.dataclass(frozen=True)
+class Heartbeat:
+    rank: int
+    round: int
+    phase: str          # "init" | "round_start" | "round_end" | "final"
+    time: float         # writer's epoch seconds
+    pid: int
+    attempt: int
+
+    def age(self, now: float | None = None) -> float:
+        return (time.time() if now is None else now) - self.time
+
+
+def beat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"{HB_PREFIX}{rank}.json")
+
+
+def write_beat(directory: str, rank: int, round_idx: int, phase: str,
+               attempt: int = 0, *, clock: Callable[[], float] = time.time,
+               ) -> None:
+    """Publish rank ``rank``'s beat — atomic replace, never a torn read."""
+    os.makedirs(directory, exist_ok=True)
+    beat = {"rank": rank, "round": round_idx, "phase": phase,
+            "time": clock(), "pid": os.getpid(), "attempt": attempt}
+    path = beat_path(directory, rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(beat, f)
+    os.replace(tmp, path)
+
+
+def read_beat(directory: str, rank: int) -> Heartbeat | None:
+    """The newest beat for ``rank``, or None when absent/unreadable (a
+    missing beacon is 'no data', never an exception — the monitor decides
+    what silence means)."""
+    try:
+        with open(beat_path(directory, rank)) as f:
+            d = json.load(f)
+        return Heartbeat(rank=int(d["rank"]), round=int(d["round"]),
+                         phase=str(d["phase"]), time=float(d["time"]),
+                         pid=int(d["pid"]), attempt=int(d["attempt"]))
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return None
+
+
+def read_all(directory: str) -> dict[int, Heartbeat]:
+    beats: dict[int, Heartbeat] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return beats
+    for name in names:
+        if not (name.startswith(HB_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            rank = int(name[len(HB_PREFIX):-len(".json")])
+        except ValueError:
+            continue
+        beat = read_beat(directory, rank)
+        if beat is not None:
+            beats[rank] = beat
+    return beats
+
+
+def maybe_beat(round_idx: int, phase: str = "round_start") -> None:
+    """Worker-side hook: publish a beat iff SPARKNET_HEARTBEAT_DIR is set.
+    Deliberately swallow-nothing-raise-nothing is NOT the contract — a
+    beacon dir that exists but is unwritable should fail loudly (it means
+    the supervisor will kill us as hung)."""
+    directory = os.environ.get(ENV_DIR)
+    if not directory:
+        return
+    write_beat(directory, int(os.environ.get("SPARKNET_PROC_ID", "0") or 0),
+               round_idx, phase,
+               attempt=int(os.environ.get("SPARKNET_FAULT_ATTEMPT", "0")
+                           or 0))
+
+
+class StragglerMonitor:
+    """Supervisor side of the health plane: given the heartbeat dir and a
+    per-round ``deadline_s``, :meth:`check` names the live ranks whose
+    last beat is older than the deadline.  A rank with no beat yet is
+    never flagged (startup grace — see module docstring); each rank is
+    flagged at most once."""
+
+    def __init__(self, directory: str, deadline_s: float,
+                 clock: Callable[[], float] = time.time):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.directory = directory
+        self.deadline_s = deadline_s
+        self._clock = clock
+        self._flagged: set[int] = set()
+
+    def check(self, live_ranks) -> list[int]:
+        """Ranks from ``live_ranks`` past the deadline (newly flagged)."""
+        now = self._clock()
+        beats = read_all(self.directory)
+        out = []
+        for rank in live_ranks:
+            if rank in self._flagged:
+                continue
+            beat = beats.get(rank)
+            if beat is not None and beat.age(now) > self.deadline_s:
+                self._flagged.add(rank)
+                out.append(rank)
+        return out
+
+    def last_age(self, rank: int) -> float | None:
+        """Age of ``rank``'s last beat, or None if it never beat — the
+        post-mortem datum ResilientRunner folds into its error report."""
+        beat = read_beat(self.directory, rank)
+        return None if beat is None else beat.age(self._clock())

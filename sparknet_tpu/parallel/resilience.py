@@ -4,57 +4,130 @@ The reference inherited two things from Spark: fail-fast (a dead executor
 fails the stage — ``spark.task.maxFailures`` is pinned to 1 at
 CifarApp.scala:36) and *reschedule* (the driver relaunches the failed
 work).  The launcher (``tools.launch``) reproduces fail-fast: the first
-worker death tears the whole round down.  This module is the reschedule
-half: ``ResilientRunner`` wraps ``launch_local``/``launch_ssh``, watches
-the worker set, and on any nonzero exit relaunches the WHOLE job with
-exponential backoff under a bounded restart budget.
+worker death (or a straggler caught by the round deadline) tears the
+whole round down.  This module is the reschedule half, in two tiers:
 
-Recovery is round-granular, not step-granular: the relaunched job finds
-the newest valid checkpoint manifest on disk (``DistributedTrainer``'s
-``checkpoint_dir`` auto-resume) and replays from that round boundary — a
-preempted host costs at most ``checkpoint_every`` rounds of work, exactly
-the granularity SparkNet's driver loop could recover at (a round was one
-Spark stage).
+**Restart** — ``ResilientRunner`` wraps ``launch_local``/``launch_ssh``,
+watches the worker set, and on any nonzero exit relaunches the WHOLE job
+with jittered exponential backoff under a bounded restart budget.
+Recovery is round-granular: the relaunched job finds the newest valid
+checkpoint manifest on disk (``DistributedTrainer``'s ``checkpoint_dir``
+auto-resume) and replays from that round boundary.
+
+**Re-form (elastic degraded mode)** — SparkNet's parameter average over
+k-1 workers is still a valid consensus, so a job whose restart budget is
+spent on the SAME failing rank need not die: with an ``ElasticPolicy``
+the runner drops the culprit and relaunches on the survivors — a fresh
+*incarnation* with a fresh restart budget, a smaller world
+(``nprocs``-1 locally; the dead host removed in ssh mode), and the
+trainer's ``TrainerConfig.elastic`` resume re-tiering the per-worker
+optimizer state.  Incarnations shrink until ``min_workers``; a
+``rejoin_probe`` lets a recovered host re-enter at the next relaunch
+boundary (the only membership boundary an SPMD job has).  Note local
+mode renumbers ranks 0..n-1 after a drop — ranks are fungible slots; in
+ssh mode the *host* is what is dropped, which is the real-world
+semantics.
 
 Every (re)launch is stamped with SPARKNET_FAULT_ATTEMPT /
-SPARKNET_RESTART_COUNT in the child env; the fault-injection harness
-(``utils.faults``) keys one-shot faults off it, and training code can log
-it.  A fresh coordinator port is chosen per attempt so a relaunch never
-races the dying coordinator's socket in TIME_WAIT.
+SPARKNET_RESTART_COUNT (global attempt counter, so one-shot injected
+faults stay one-shot across re-forms) plus SPARKNET_INCARNATION in the
+child env.  A fresh coordinator port is chosen per attempt so a relaunch
+never races the dying coordinator's socket in TIME_WAIT, and the backoff
+is jittered so N relaunched ranks don't thundering-herd the coordinator
+in lockstep.
+
+Post-mortems are first-class: each attempt runs with a per-rank log tee
+and a heartbeat dir, so the final failure (``run_or_raise`` /
+``.failure``) names the culprit rank and carries the tail of its log and
+the age of its last heartbeat — not just an exit code.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import os
+import random
 import sys
+import tempfile
 import time
 from typing import Callable
 
-from ..tools.launch import free_port, launch_local, launch_ssh
+from ..tools.launch import EXIT_STRAGGLER, free_port, launch_local, launch_ssh
+from . import health
+
+LOG_TAIL_BYTES = 2048
 
 
 @dataclasses.dataclass(frozen=True)
 class RestartPolicy:
-    """Bounded restarts with exponential backoff — the
+    """Bounded restarts with jittered exponential backoff — the
     ``spark.task.maxFailures`` contract plus the backoff Spark's DAG
-    scheduler applies between stage reattempts."""
+    scheduler applies between stage reattempts.  ``jitter`` spreads each
+    delay over ±``jitter``·delay so simultaneously-dead jobs don't
+    relaunch (and re-dial the coordinator) in lockstep; set 0.0 for
+    deterministic schedules in tests."""
 
     max_restarts: int = 3          # total attempts = max_restarts + 1
     backoff_base: float = 1.0      # seconds before the first restart
     backoff_factor: float = 2.0
     backoff_max: float = 60.0
+    jitter: float = 0.2
 
-    def delay(self, restart_idx: int) -> float:
+    def delay(self, restart_idx: int,
+              rng: random.Random | None = None) -> float:
         """Sleep before restart #``restart_idx`` (0-based)."""
-        return min(self.backoff_base * self.backoff_factor ** restart_idx,
-                   self.backoff_max)
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+        d = min(self.backoff_base * self.backoff_factor ** restart_idx,
+                self.backoff_max)
+        if self.jitter:
+            r = (rng or random).random()
+            d *= 1.0 + self.jitter * (2.0 * r - 1.0)
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPolicy:
+    """When to re-form instead of die.  ``enabled=False`` reproduces the
+    pre-elastic contract exactly: budget exhausted → give up."""
+
+    enabled: bool = False
+    min_workers: int = 1           # never shrink below this many
 
 
 @dataclasses.dataclass(frozen=True)
 class Attempt:
-    index: int
+    index: int                     # global attempt counter
     returncode: int
     duration_s: float
+    incarnation: int = 0           # which world membership this ran under
+    world: int = 0                 # worker count of that membership
+    first_failure: int | None = None   # rank attribution (None = unknown)
+    cause: str = ""                # "exit" | "straggler" | "timeout" | ...
+
+
+class ResilienceError(RuntimeError):
+    """A supervised job failed for good.  Carries the post-mortem: the
+    culprit rank, its exit code and failure cause, the tail of its log,
+    and the age of its last heartbeat when the job died."""
+
+    def __init__(self, message: str, *, returncode: int,
+                 rank: int | None = None, cause: str = "",
+                 log_tail: str | None = None,
+                 heartbeat_age: float | None = None):
+        parts = [message]
+        if heartbeat_age is not None:
+            parts.append(f"last heartbeat {heartbeat_age:.1f}s before "
+                         f"teardown")
+        if log_tail:
+            parts.append(f"--- tail of rank {rank} log ---\n{log_tail}")
+        super().__init__("\n".join(parts))
+        self.returncode = returncode
+        self.rank = rank
+        self.cause = cause
+        self.log_tail = log_tail
+        self.heartbeat_age = heartbeat_age
 
 
 class ResilientRunner:
@@ -63,8 +136,15 @@ class ResilientRunner:
     Exactly one of ``nprocs`` (local mode) or ``hosts`` (ssh mode) must be
     given — the same split as ``tools.launch``.  ``run()`` returns the
     final exit code: 0 once any attempt completes, else the last failing
-    code after the restart budget is spent.  ``attempts`` records every
-    try for post-mortems.
+    code after the restart budget (and any elastic re-forms) are spent —
+    with the post-mortem in ``.failure``.  ``run_or_raise()`` raises that
+    post-mortem instead.  ``attempts`` records every try.
+
+    ``round_deadline`` (seconds) arms the straggler detector: every
+    attempt runs with a heartbeat dir, and a rank that beat once then
+    went silent past the deadline is killed (exit ``EXIT_STRAGGLER``)
+    and the job relaunched from checkpoint — a hung rank costs one
+    deadline, not the global ``timeout``.
     """
 
     def __init__(self, cmd: list[str], *,
@@ -75,8 +155,13 @@ class ResilientRunner:
                  cwd: str | None = None,
                  timeout: float | None = None,
                  policy: RestartPolicy | None = None,
+                 elastic: ElasticPolicy | None = None,
+                 rejoin_probe: Callable[[int | str], bool] | None = None,
+                 round_deadline: float | None = None,
+                 workdir: str | None = None,
                  extra_env: dict | None = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 jitter_rng: random.Random | None = None):
         if (nprocs is None) == (hosts is None):
             raise ValueError("exactly one of nprocs / hosts is required")
         self.cmd = list(cmd)
@@ -87,44 +172,209 @@ class ResilientRunner:
         self.cwd = cwd
         self.timeout = timeout
         self.policy = policy or RestartPolicy()
+        self.elastic = elastic or ElasticPolicy()
+        self.rejoin_probe = rejoin_probe
+        self.round_deadline = round_deadline
         self.extra_env = dict(extra_env or {})
         self._sleep = sleep
+        self._rng = jitter_rng or random.Random()
+        self.workdir = workdir or tempfile.mkdtemp(prefix="sparknet-job-")
         self.attempts: list[Attempt] = []
+        self.incarnation = 0
+        self.dropped: list[int | str] = []   # host names (ssh) / slots
+        self._drop_counts: dict[int | str, int] = {}
+        self.failure: ResilienceError | None = None
+        if self.elastic.min_workers < 1:
+            raise ValueError(
+                f"min_workers must be >= 1, got {self.elastic.min_workers}")
 
-    def _launch_once(self, attempt: int) -> int:
+    # -- world membership -------------------------------------------------
+    def world_size(self) -> int:
+        return len(self.hosts) if self.hosts is not None else self.nprocs
+
+    def _drop(self, culprit_rank: int) -> int | str:
+        """Shrink the world by the culprit; returns the dropped slot."""
+        if self.hosts is not None:
+            slot: int | str = self.hosts.pop(culprit_rank)
+        else:
+            self.nprocs -= 1
+            slot = self.nprocs          # local slots are fungible
+        self.dropped.append(slot)
+        self._drop_counts[slot] = self._drop_counts.get(slot, 0) + 1
+        return slot
+
+    def _maybe_rejoin(self) -> None:
+        """Re-admit dropped slots whose probe passes — the relaunch
+        boundary is the only membership boundary an SPMD job has, so a
+        recovered host rejoins here, at the next incarnation."""
+        if self.rejoin_probe is None or not self.dropped:
+            return
+        still_out = []
+        for slot in self.dropped:
+            if self._drop_counts.get(slot, 0) >= 2:
+                # two strikes: a slot that failed again after rejoining is
+                # out for good — an always-True probe against a still-broken
+                # host must not livelock the drop/rejoin cycle
+                still_out.append(slot)
+                continue
+            ok = False
+            try:
+                ok = bool(self.rejoin_probe(slot))
+            except Exception as e:   # a probe that dies means "not yet"
+                print(f"resilience: rejoin probe for {slot!r} failed: {e}",
+                      file=sys.stderr, flush=True)
+            if ok:
+                print(f"resilience: {slot!r} rejoins the job",
+                      file=sys.stderr, flush=True)
+                if self.hosts is not None:
+                    self.hosts.append(str(slot))
+                else:
+                    self.nprocs += 1
+            else:
+                still_out.append(slot)
+        self.dropped = still_out
+
+    # -- one attempt ------------------------------------------------------
+    def _attempt_dir(self, attempt: int) -> str:
+        d = os.path.join(self.workdir, f"attempt_{attempt:03d}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _launch_once(self, attempt: int, report: dict) -> int:
         env = dict(self.extra_env)
         env["SPARKNET_FAULT_ATTEMPT"] = str(attempt)
         env["SPARKNET_RESTART_COUNT"] = str(attempt)
+        env["SPARKNET_INCARNATION"] = str(self.incarnation)
+        adir = self._attempt_dir(attempt)
+        health_kw = dict(
+            heartbeat_dir=os.path.join(adir, "hb"),
+            round_deadline=self.round_deadline,
+            log_dir=os.path.join(adir, "logs"),
+            report=report)
         if self.hosts is not None:
             return launch_ssh(self.cmd, self.hosts,
                               coordinator_port=free_port(),
                               cwd=self.cwd, timeout=self.timeout,
-                              extra_env=env)
+                              extra_env=env, **health_kw)
         return launch_local(self.cmd, self.nprocs, platform=self.platform,
                             devices_per_proc=self.devices_per_proc,
                             coordinator=f"127.0.0.1:{free_port()}",
-                            timeout=self.timeout, extra_env=env)
+                            timeout=self.timeout, extra_env=env,
+                            **health_kw)
 
-    def run(self) -> int:
+    # -- post-mortem helpers ----------------------------------------------
+    def _log_tail(self, attempt: int, rank: int) -> str | None:
+        path = os.path.join(self._attempt_dir(attempt), "logs",
+                            f"rank_{rank}.log")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(size - LOG_TAIL_BYTES, 0))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return None
+
+    def _heartbeat_age(self, attempt: int, rank: int) -> float | None:
+        beat = health.read_beat(
+            os.path.join(self._attempt_dir(attempt), "hb"), rank)
+        return None if beat is None else beat.age()
+
+    def _build_failure(self, rc: int) -> ResilienceError:
+        last = self.attempts[-1]
+        rank = last.first_failure
+        cause = last.cause or "exit"
+        what = {"straggler": "was killed as hung (missed the round "
+                             "deadline)",
+                "timeout": "hit the global job timeout"}.get(
+            cause, f"exited rc={last.returncode}")
+        msg = (f"job failed for good after {len(self.attempts)} attempts "
+               f"across {self.incarnation + 1} incarnation(s); "
+               + (f"rank {rank} {what}" if rank is not None
+                  else f"last attempt {what} (no rank attribution)"))
+        log_tail = hb_age = None
+        if rank is not None:
+            log_tail = self._log_tail(last.index, rank)
+            hb_age = self._heartbeat_age(last.index, rank)
+        return ResilienceError(msg, returncode=rc, rank=rank, cause=cause,
+                               log_tail=log_tail, heartbeat_age=hb_age)
+
+    def _culprit(self) -> int | None:
+        """Rank attribution for the just-exhausted incarnation: the most
+        frequently failing rank among its attempts (None when the
+        launcher produced no attribution — e.g. a global timeout)."""
+        ranks = [a.first_failure for a in self.attempts
+                 if a.incarnation == self.incarnation
+                 and a.first_failure is not None]
+        if not ranks:
+            return None
+        return collections.Counter(ranks).most_common(1)[0][0]
+
+    # -- the supervision loop ---------------------------------------------
+    def _run_incarnation(self, attempt_base: int) -> int:
+        """One full restart budget at the current world size; returns the
+        last exit code (0 = recovered)."""
         rc = 0
-        for attempt in range(self.policy.max_restarts + 1):
+        for i in range(self.policy.max_restarts + 1):
+            attempt = attempt_base + i
+            report: dict = {}
             t0 = time.monotonic()
-            rc = self._launch_once(attempt)
-            self.attempts.append(
-                Attempt(attempt, rc, time.monotonic() - t0))
+            rc = self._launch_once(attempt, report)
+            self.attempts.append(Attempt(
+                attempt, rc, time.monotonic() - t0,
+                incarnation=self.incarnation, world=self.world_size(),
+                first_failure=report.get("first_failure"),
+                cause=report.get("cause", "")))
             if rc == 0:
                 if attempt:
                     print(f"resilience: job recovered on attempt "
                           f"{attempt + 1}", file=sys.stderr, flush=True)
                 return 0
-            if attempt < self.policy.max_restarts:
-                delay = self.policy.delay(attempt)
+            if rc == EXIT_STRAGGLER:
+                print(f"resilience: rank "
+                      f"{report.get('first_failure', '?')} missed the "
+                      f"round deadline; relaunching from checkpoint",
+                      file=sys.stderr, flush=True)
+            if i < self.policy.max_restarts:
+                delay = self.policy.delay(i, self._rng)
                 print(f"resilience: attempt {attempt + 1} failed rc={rc}; "
                       f"restarting from latest checkpoint in {delay:.2g}s "
-                      f"({self.policy.max_restarts - attempt} restarts "
-                      f"left)", file=sys.stderr, flush=True)
+                      f"({self.policy.max_restarts - i} restarts left in "
+                      f"incarnation {self.incarnation})",
+                      file=sys.stderr, flush=True)
                 self._sleep(delay)
-        print(f"resilience: restart budget exhausted after "
-              f"{len(self.attempts)} attempts; giving up rc={rc}",
-              file=sys.stderr, flush=True)
+        return rc
+
+    def run(self) -> int:
+        """Supervise to completion.  Returns the final exit code; a
+        nonzero return leaves the post-mortem in ``self.failure``."""
+        while True:
+            self._maybe_rejoin()
+            rc = self._run_incarnation(len(self.attempts))
+            if rc == 0:
+                return 0
+            culprit = self._culprit()
+            survivors = self.world_size() - 1
+            if (self.elastic.enabled and culprit is not None
+                    and survivors >= self.elastic.min_workers):
+                slot = self._drop(culprit)
+                self.incarnation += 1
+                print(f"resilience: restart budget exhausted on "
+                      f"{slot!r}; re-forming with {self.world_size()} "
+                      f"survivors (incarnation {self.incarnation}) — the "
+                      f"average over the survivors is still a valid "
+                      f"consensus", file=sys.stderr, flush=True)
+                continue
+            self.failure = self._build_failure(rc)
+            print(f"resilience: giving up rc={rc}: {self.failure}",
+                  file=sys.stderr, flush=True)
+            return rc
+
+    def run_or_raise(self) -> int:
+        """Like :meth:`run`, but a final failure raises the
+        :class:`ResilienceError` post-mortem (culprit rank, log tail,
+        heartbeat age) instead of returning an opaque exit code."""
+        rc = self.run()
+        if rc != 0:
+            raise self.failure   # always set on nonzero return
         return rc

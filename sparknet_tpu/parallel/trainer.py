@@ -110,6 +110,36 @@ class TrainerConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
     checkpoint_keep: int = 3
+    # Elastic degraded mode: allow resuming a checkpoint written by a
+    # DIFFERENT worker count — the SparkNet average over k-1 workers is
+    # still a valid consensus, so a job that lost a host permanently can
+    # re-form on the survivors (params are replicated and restore as-is;
+    # stacked per-worker/per-host optimizer state is re-tiered: surviving
+    # worker i inherits saved row i mod saved_n).  Strategy mismatches
+    # still raise — that is a config error, not membership change.
+    elastic: bool = False
+    # Numerical-integrity guard: after each averaging step validate the
+    # round (finite loss, finite params, optional loss-spike threshold);
+    # a poisoned round is DROPPED — the trainer rolls back to the newest
+    # valid round checkpoint instead of letting a NaN/Inf be averaged
+    # into the master weights and persisted forever.  Requires
+    # ``checkpoint_dir`` (a baseline round-0 checkpoint is written at
+    # init so rollback is always possible).
+    guard_numerics: bool = False
+    # > 0: additionally trip when loss exceeds ``loss_spike_factor`` ×
+    # the trailing-mean loss (catches divergence before it reaches Inf)
+    loss_spike_factor: float = 0.0
+    # multiply the effective LR by this on every guard trip (< 1.0 backs
+    # off a diverging step size; 1.0 = rollback only).  The scale is a
+    # traced input of the compiled round — changing it never recompiles.
+    guard_lr_backoff: float = 1.0
+    guard_max_trips: int = 3
+
+
+class TrainingDivergedError(RuntimeError):
+    """The numerical-integrity guard tripped and could not recover:
+    no checkpoint to roll back to, or ``guard_max_trips`` exceeded
+    (the fault is deterministic — rollback alone cannot outrun it)."""
 
 
 def device_crop_mirror_mean(crop: int, mirror: bool = True,
@@ -227,12 +257,29 @@ class DistributedTrainer:
         self.round = 0
         self.data_cursor: Any = None
         self.resumed: dict[str, Any] | None = None
+        # -- numerical-integrity guard state: effective-LR scale (backed
+        # off on trips; checkpointed so a relaunch keeps it), trip count,
+        # and a short trailing window of accepted losses for spike checks
+        self.lr_scale = 1.0
+        self.guard_trips = 0
+        self._loss_history: list[float] = []
+        self._finite_check = None
         if self.config.checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got "
                 f"{self.config.checkpoint_every}")
+        if self.config.guard_numerics and not self.config.checkpoint_dir:
+            raise ValueError(
+                "guard_numerics needs checkpoint_dir — rollback is the "
+                "guard's only recovery action")
         if self.config.checkpoint_dir:
             self.resumed = self.resume_latest(self.config.checkpoint_dir)
+            if self.config.guard_numerics and self.resumed is None:
+                # baseline snapshot: the guard can always roll back, even
+                # when the very first round is the poisoned one
+                self.save_round_checkpoint()
+        from . import health
+        health.maybe_beat(self.round, "init")
 
     def _state_tier(self) -> tuple[int, P]:
         """(leading-axis length, PartitionSpec) of the stacked optimizer
@@ -277,7 +324,7 @@ class DistributedTrainer:
                 return micro
             return device_pre(micro, rng)
 
-        def make_psum_step(axis):
+        def make_psum_step(axis, lr_scale):
             """One per-step-gradient-averaged update over ``axis`` — the
             P2PSync step, shared verbatim by "sync" (over the flat data
             axis) and "hierarchical" (over the chip axis within a host)."""
@@ -300,20 +347,20 @@ class DistributedTrainer:
                         for k, v in params.items()}
                 grads = preprocess_grads(sp, params, grads, lr_mults,
                                          decay_mults)
-                rate = learning_rate(sp, it)
+                rate = learning_rate(sp, it) * lr_scale
                 params, state = rule.apply(params, grads, state, rate, it,
                                            lr_mults=lr_mults)
                 return (params, state, it + 1, rng), loss
             return step
 
-        def sync_body(params, state, it, batches, rng):
+        def sync_body(params, state, it, batches, rng, lr_scale):
             """Per-step grad pmean (P2PSync semantics)."""
             (params, state, it, _), losses = lax.scan(
-                make_psum_step(DATA_AXIS), (params, state, it, rng),
-                split_micro(batches))
+                make_psum_step(DATA_AXIS, lr_scale),
+                (params, state, it, rng), split_micro(batches))
             return params, state, jnp.mean(losses)
 
-        def local_sgd_body(params, state, it, batches, rng):
+        def local_sgd_body(params, state, it, batches, rng, lr_scale):
             """τ local steps, then weight averaging (SparkNet semantics)."""
             state = jax.tree_util.tree_map(lambda x: x[0], state)
             rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
@@ -322,7 +369,8 @@ class DistributedTrainer:
                 params, state, it, rng = carry
                 rng, sub, pre_rng = jax.random.split(rng, 3)
                 micro = maybe_preprocess(micro, pre_rng)
-                params, state, loss = local_update(params, state, it, micro, sub)
+                params, state, loss = local_update(params, state, it, micro,
+                                                   sub, lr_scale)
                 return (params, state, it + 1, rng), loss
 
             (params, state, it, _), losses = lax.scan(
@@ -334,7 +382,7 @@ class DistributedTrainer:
             state = jax.tree_util.tree_map(lambda x: x[None], state)
             return params, state, loss
 
-        def hierarchical_body(params, state, it, batches, rng):
+        def hierarchical_body(params, state, it, batches, rng, lr_scale):
             """Per-step grad pmean over chips (the P2PSync step over the
             fast tier), τ-boundary weight pmean over hosts (the Spark
             round) — the two reference tiers composed on the
@@ -345,8 +393,8 @@ class DistributedTrainer:
             state = jax.tree_util.tree_map(lambda x: x[0], state)
             rng = jax.random.fold_in(rng, lax.axis_index(HOST_AXIS))
             (params, state, it, _), losses = lax.scan(
-                make_psum_step(CHIP_AXIS), (params, state, it, rng),
-                split_micro(batches))
+                make_psum_step(CHIP_AXIS, lr_scale),
+                (params, state, it, rng), split_micro(batches))
             # the cross-host averaging rides DCN once per τ steps — the
             # broadcast → reduce → scalarDivide of the reference's outer
             # loop (ImageNetApp.scala:102,178-179)
@@ -365,7 +413,7 @@ class DistributedTrainer:
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(), state_spec, P(), batch_spec, P()),
+            in_specs=(P(), state_spec, P(), batch_spec, P(), P()),
             out_specs=(P(), state_spec, P()),
             **_SM_NOCHECK,
         )
@@ -394,7 +442,16 @@ class DistributedTrainer:
         global batch; multi-host, each process passes only ITS rows of the
         global batch (its partitions — the zipPartitions placement,
         reference: ImageNetApp.scala:145) and the global array is assembled
-        without any host seeing the whole batch."""
+        without any host seeing the whole batch.
+
+        With ``guard_numerics`` the finished round is validated before it
+        counts: a non-finite loss, non-finite params, or a loss spike
+        rolls the trainer back to the newest valid checkpoint and the
+        round is DROPPED — ``self.round`` does not advance, so a
+        ``while trainer.round < rounds`` driver naturally replays it.
+        The (poisoned) loss is still returned for logging."""
+        from . import health
+        from ..utils import faults
         expect = self.batches_per_round
         procs = jax.process_count()
         local_workers = max(self.n_workers // procs, 1)
@@ -407,6 +464,19 @@ class DistributedTrainer:
                 raise ValueError(
                     f"{k}: batch {v.shape[1]} not divisible by "
                     f"{local_workers} local workers")
+        round_idx = self.round
+        health.maybe_beat(round_idx, "round_start")
+        # deterministic chaos hook: poison THIS rank's feed with NaNs (the
+        # guard must catch the poison after averaging, no matter which
+        # rank produced it — exactly a flaky-HBM / bad-DMA event)
+        if faults.get_injector().nan_inject(round_idx):
+            print(f"FAULT: nan_inject poisoning round {round_idx} feed",
+                  file=sys.stderr, flush=True)
+            batches = {
+                k: (np.full_like(v, np.nan)
+                    if np.issubdtype(np.asarray(v).dtype, np.floating)
+                    else v)
+                for k, v in batches.items()}
         # pre-shard the feed so each device receives only its slice — no
         # single-device staging (the reference's driver bottleneck); a no-op
         # for feeds already staged via device_feed(input_sharding)
@@ -414,7 +484,15 @@ class DistributedTrainer:
                    for k, v in batches.items()}
         self._rng, rng = jax.random.split(self._rng)
         self.params, self.state, loss = self._round(
-            self.params, self.state, jnp.asarray(self.iter), batches, rng)
+            self.params, self.state, jnp.asarray(self.iter), batches, rng,
+            jnp.asarray(self.lr_scale, jnp.float32))
+        loss_val = float(loss)
+        if self.config.guard_numerics:
+            reason = self._poison_reason(loss_val)
+            if reason:
+                self._guard_trip(round_idx, reason)
+                return loss_val   # round dropped; self.round unchanged
+            self._loss_history = (self._loss_history + [loss_val])[-8:]
         prev = self.iter
         self.iter += self.config.tau
         # snapshot-on-schedule at round granularity (Solver::Step checks per
@@ -427,7 +505,63 @@ class DistributedTrainer:
         if (self.config.checkpoint_dir
                 and self.round % self.config.checkpoint_every == 0):
             self.save_round_checkpoint()
-        return float(loss)
+        health.maybe_beat(round_idx, "round_end")
+        return loss_val
+
+    # -- numerical-integrity guard (see TrainerConfig.guard_numerics) -----
+    def _all_finite(self, tree) -> bool:
+        """Jitted all-leaves-finite reduction over the float leaves of a
+        (replicated) pytree — one fused pass, one scalar fetched."""
+        if self._finite_check is None:
+            def check(t):
+                leaves = [jnp.all(jnp.isfinite(x))
+                          for x in jax.tree_util.tree_leaves(t)
+                          if jnp.issubdtype(x.dtype, jnp.floating)]
+                return (jnp.all(jnp.stack(leaves)) if leaves
+                        else jnp.asarray(True))
+            self._finite_check = jax.jit(check)
+        return bool(self._finite_check(tree))
+
+    def _poison_reason(self, loss_val: float) -> str | None:
+        """Why the just-finished round should be rejected, or None."""
+        if not np.isfinite(loss_val):
+            return f"non-finite loss {loss_val}"
+        factor = self.config.loss_spike_factor
+        if factor > 0 and len(self._loss_history) >= 3:
+            mean = sum(self._loss_history) / len(self._loss_history)
+            if loss_val > factor * mean:
+                return (f"loss spike {loss_val:.4g} > {factor:g} x "
+                        f"trailing mean {mean:.4g}")
+        if not self._all_finite(self.params):
+            return "non-finite parameters after averaging"
+        return None
+
+    def _guard_trip(self, round_idx: int, reason: str) -> None:
+        """Reject round ``round_idx``: roll back to the newest valid
+        checkpoint (params/state/iter/round/RNG all restored, so the
+        replay is exact), optionally back off the LR, and count the trip.
+        All processes take this path together — the decision derives from
+        replicated values, so no collective can diverge."""
+        self.guard_trips += 1
+        print(f"guard: round {round_idx} REJECTED ({reason}); rolling "
+              f"back to last valid checkpoint "
+              f"(trip {self.guard_trips}/{self.config.guard_max_trips})",
+              file=sys.stderr, flush=True)
+        if self.guard_trips > self.config.guard_max_trips:
+            raise TrainingDivergedError(
+                f"numerical guard tripped {self.guard_trips} times "
+                f"(> guard_max_trips={self.config.guard_max_trips}); "
+                f"last reason: {reason}")
+        manifest = self.resume_latest(self.config.checkpoint_dir)
+        if manifest is None:
+            raise TrainingDivergedError(
+                f"round {round_idx} poisoned ({reason}) and no valid "
+                f"checkpoint to roll back to in "
+                f"{self.config.checkpoint_dir!r}")
+        if self.config.guard_lr_backoff != 1.0:
+            self.lr_scale *= self.config.guard_lr_backoff
+            print(f"guard: LR scale backed off to {self.lr_scale:g}",
+                  file=sys.stderr, flush=True)
 
     def test(self, feed: Iterator[Mapping[str, Any]], num_steps: int,
              ) -> dict[str, Any]:
@@ -533,10 +667,25 @@ class DistributedTrainer:
             "rng": np.asarray(self._rng),
             "strategy": self.config.strategy,
             "n_workers": self.n_workers,
+            "lr_scale": np.float64(self.lr_scale),
         }
         if self.config.strategy == "hierarchical":
             blob["n_hosts"] = self.n_hosts  # state is per-host
         return blob
+
+    @staticmethod
+    def _retier_state(state, new_n: int):
+        """Re-tile stacked per-worker/per-host optimizer state saved with
+        a DIFFERENT tier count: new row i inherits saved row i mod
+        saved_n.  Shrinking drops the dead workers' rows; growing seeds a
+        rejoined worker from an existing one — both keep the elastic
+        continuation deterministic, which is what the bit-for-bit re-form
+        contract needs (any fixed rule works; this one is stable under
+        repeated shrink/grow)."""
+        def fix(x):
+            x = np.asarray(x)
+            return x[np.arange(new_n) % x.shape[0]]
+        return jax.tree_util.tree_map(fix, state)
 
     def _apply_blob(self, blob: Mapping[str, Any]) -> None:
         saved_strategy = str(np.asarray(blob.get("strategy", "")))
@@ -546,30 +695,44 @@ class DistributedTrainer:
                 f"checkpoint strategy {saved_strategy!r} != trainer "
                 f"{self.config.strategy!r} (per-worker optimizer state is "
                 f"not convertible)")
+        elastic = self.config.elastic
+        state = blob["state"]
         if saved_workers is not None and saved_workers != self.n_workers:
-            raise ValueError(
-                f"checkpoint has {saved_workers} workers, mesh has "
-                f"{self.n_workers}")
+            if not elastic:
+                raise ValueError(
+                    f"checkpoint has {saved_workers} workers, mesh has "
+                    f"{self.n_workers} (set TrainerConfig.elastic=True to "
+                    f"re-form on a different worker set)")
+            print(f"elastic: re-forming {saved_workers} -> "
+                  f"{self.n_workers} workers (params are the consensus "
+                  f"average; stacked optimizer state re-tiled)",
+                  file=sys.stderr, flush=True)
+            if self.config.strategy == "local_sgd":
+                state = self._retier_state(state, self.n_workers)
         if self.config.strategy == "hierarchical" and "n_hosts" in blob:
             saved_hosts = int(blob["n_hosts"])
             if saved_hosts != self.n_hosts:
-                raise ValueError(
-                    f"checkpoint has {saved_hosts} hosts, mesh has "
-                    f"{self.n_hosts} (per-host optimizer state does not "
-                    f"re-tile)")
+                if not elastic:
+                    raise ValueError(
+                        f"checkpoint has {saved_hosts} hosts, mesh has "
+                        f"{self.n_hosts} (per-host optimizer state does "
+                        f"not re-tile; set TrainerConfig.elastic=True)")
+                state = self._retier_state(state, self.n_hosts)
         rep = replicated(self.mesh)
         self.params = put_global_tree(blob["params"], rep)
         if self.config.strategy == "sync":
-            self.state = put_global_tree(blob["state"], rep)
+            self.state = put_global_tree(state, rep)
         else:
             self.state = put_global_tree(
-                blob["state"],
+                state,
                 NamedSharding(self.mesh, self._state_tier()[1]))
         self.iter = int(blob["iter"])
         if "round" in blob:
             self.round = int(blob["round"])
         if "rng" in blob:
             self._rng = jnp.asarray(blob["rng"])
+        if "lr_scale" in blob:
+            self.lr_scale = float(np.asarray(blob["lr_scale"]))
 
     def snapshot(self, path: str) -> None:
         from ..utils.checkpoint import save_checkpoint
@@ -598,6 +761,10 @@ class DistributedTrainer:
         name = f"ckpt_round_{self.round:08d}.npz"
         path = os.path.join(directory, name)
         save_checkpoint(path, blob)
+        # torn-write chaos window: the npz is durable, the manifest is not
+        # yet — crash_in_ckpt kills HERE; resume must treat the orphan npz
+        # as if the checkpoint never happened
+        faults.get_injector().on_checkpoint_write(self.round)
         # deterministic chaos hook: scribble the snapshot AFTER it exists
         # (and before/after the manifest — both orders must be survivable;
         # we corrupt after so the manifest's checksum catches it)
@@ -614,7 +781,9 @@ class DistributedTrainer:
             "data_cursor": self.data_cursor,
         }
         mpath = os.path.join(directory, f"manifest_{self.round:08d}.json")
-        tmp = mpath + ".tmp"
+        # unique temp name (pid-stamped): a crashed writer's leftover can
+        # never collide with — or be half-overwritten into — a live write
+        tmp = f"{mpath}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1)
         os.replace(tmp, mpath)  # manifest appears atomically, last
@@ -638,6 +807,13 @@ class DistributedTrainer:
                     os.remove(p)
                 except OSError:
                     pass
+        # sweep temp droppings from writers killed mid-write (ours are
+        # already renamed away by now, so anything *.tmp.* is an orphan)
+        for p in glob.glob(os.path.join(directory, "*.tmp.*")):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
     def resume_latest(self, directory: str) -> dict[str, Any] | None:
         """Restore from the newest manifest whose checkpoint validates
@@ -670,9 +846,14 @@ class DistributedTrainer:
             mesh_shape = manifest.get("mesh_shape")
             if mesh_shape and mesh_shape != {
                     k: int(v) for k, v in self.mesh.shape.items()}:
-                raise ValueError(
-                    f"checkpoint mesh shape {mesh_shape} != trainer mesh "
-                    f"{dict(self.mesh.shape)}")
+                if not self.config.elastic:
+                    raise ValueError(
+                        f"checkpoint mesh shape {mesh_shape} != trainer "
+                        f"mesh {dict(self.mesh.shape)} (set TrainerConfig."
+                        f"elastic=True to re-form on a different mesh)")
+                print(f"elastic: resuming checkpoint of mesh {mesh_shape} "
+                      f"on mesh {dict(self.mesh.shape)}",
+                      file=sys.stderr, flush=True)
             self._apply_blob(blob)
             self.round = int(manifest.get("round", self.round))
             self.data_cursor = manifest.get("data_cursor")

@@ -203,7 +203,11 @@ class Solver:
                 if action == SolverAction.SNAPSHOT and self.sp.snapshot_prefix:
                     print(f"Snapshotting (signal) at iter {self.iter}")
                     self.snapshot_caffe()
-                elif action == SolverAction.STOP:
+                elif action in (SolverAction.STOP,
+                                SolverAction.SNAPSHOT_STOP):
+                    # SNAPSHOT_STOP (preemption notice): the stop path in
+                    # solve() snapshots before returning, so both map to
+                    # a clean, resumable stop at the chunk boundary
                     self._stop_requested = True
                     break
         return self.smoothed_loss() if self._smoothed else loss

@@ -24,8 +24,11 @@ def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
     """Returns (loss_and_grads, local_update, accum_loss_and_grads):
 
     - ``loss_and_grads(params, batch, rng) -> (loss, params_with_bn, grads)``
-    - ``local_update(params, state, it, batches, rng) -> (params, state,
-      loss)`` — one full solver step over [iter_size, batch, ...] feeds
+    - ``local_update(params, state, it, batches, rng, lr_scale=1.0) ->
+      (params, state, loss)`` — one full solver step over
+      [iter_size, batch, ...] feeds; ``lr_scale`` multiplies the policy
+      rate (the numerical-integrity guard's LR-backoff channel — a
+      traced scalar, so changing it does not recompile)
     - ``accum_loss_and_grads(params, batches, rng) -> (loss, params, grads)``
       — the ``iter_size`` micro-batch accumulation of ``Solver::Step``
       (reference: solver.cpp:221-224), raw summed grads (normalization by
@@ -74,10 +77,10 @@ def make_step_fns(sp: SolverParameter, net: Net, rule: SolverUpdate,
             body, (params, zero, rng), batches)
         return jnp.mean(losses), params, grads
 
-    def local_update(params, state, it, batches, rng):
+    def local_update(params, state, it, batches, rng, lr_scale=1.0):
         loss, params, grads = accum_loss_and_grads(params, batches, rng)
         grads = preprocess_grads(sp, params, grads, lr_mults, decay_mults)
-        rate = learning_rate(sp, it)
+        rate = learning_rate(sp, it) * lr_scale
         params, state = rule.apply(params, grads, state, rate, it,
                                    lr_mults=lr_mults)
         return params, state, loss

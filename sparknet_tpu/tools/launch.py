@@ -16,6 +16,18 @@ Modes:
            on host i (plain SSH pod bring-up for TPU-VM workers, where
            each host sees its local chips natively).
 
+Health plane: with ``heartbeat_dir`` set the children get
+SPARKNET_HEARTBEAT_DIR (workers publish per-round beats via
+``parallel.health.maybe_beat``), and with ``round_deadline`` the
+supervisor additionally runs a ``StragglerMonitor`` over those beats — a
+rank that beat once and then went silent past the deadline is declared
+hung, killed, and the job torn down with exit code ``EXIT_STRAGGLER``
+(125) so the resilience layer relaunches from checkpoint instead of
+stalling until the global timeout.  ``log_dir`` tees every rank's output
+to ``rank_<i>.log`` (the post-mortem ResilientRunner quotes), and a
+caller-provided ``report`` dict receives per-rank exit codes, the first
+failing rank, and any straggler kills.
+
 Usage:
   python -m sparknet_tpu.tools.launch --nprocs 2 --devices-per-proc 2 \
       --platform cpu -- python -m sparknet_tpu.apps.cifar_app --synthetic ...
@@ -32,6 +44,9 @@ import subprocess
 import sys
 import threading
 import time
+
+
+EXIT_STRAGGLER = 125   # a rank was killed for missing the round deadline
 
 
 def free_port() -> int:
@@ -61,71 +76,134 @@ def _proc_env(base: dict, coordinator: str, nprocs: int, pid: int,
 
 
 def _wait_all(procs: list, timeout: float | None,
-              poll_interval: float = 0.05) -> int:
+              poll_interval: float = 0.05, monitor=None,
+              report: dict | None = None) -> int:
     """Supervise the worker set: returns 0 when every process exits clean.
     The FIRST nonzero exit tears the whole round down — remaining workers
     are killed immediately rather than left hanging on a dead collective
     until the timeout (the stage-abort half of Spark's task supervision;
     the reschedule half lives in ``parallel.resilience``).  A timeout
-    kills everything and returns 124."""
+    kills everything and returns 124.
+
+    ``monitor`` (a ``parallel.health.StragglerMonitor``) is polled with
+    the still-live rank set; any rank it flags is killed and the job
+    torn down with EXIT_STRAGGLER — a hung rank costs one round-deadline,
+    not the whole timeout.  ``report`` (if given) is filled with the
+    post-mortem: per-rank exit codes, the first failing rank, straggler
+    kills, and the failure cause."""
     deadline = time.monotonic() + timeout if timeout else None
     rc = 0
-    pending = list(procs)
+    rcs: dict[int, int | None] = {i: None for i in range(len(procs))}
+    first_failure: int | None = None
+    stragglers: list[int] = []
+    cause = ""
+    pending = dict(enumerate(procs))
     while pending and rc == 0:
-        for p in list(pending):
+        for rank, p in list(pending.items()):
             r = p.poll()
             if r is None:
                 continue
-            pending.remove(p)
+            del pending[rank]
+            rcs[rank] = r
             if r != 0:
-                rc = r
+                rc, first_failure, cause = r, rank, "exit"
                 break
         if rc == 0 and pending:
+            if monitor is not None:
+                hung = monitor.check(sorted(pending))
+                if hung:
+                    rc, first_failure, cause = (
+                        EXIT_STRAGGLER, hung[0], "straggler")
+                    stragglers = hung
+                    for rank in hung:
+                        print(f"launch: rank {rank} missed the round "
+                              f"deadline ({monitor.deadline_s:.3g}s); "
+                              f"killing as hung", file=sys.stderr,
+                              flush=True)
+                        pending[rank].kill()
+                    break
             if deadline is not None and time.monotonic() > deadline:
-                rc = 124
+                rc, cause = 124, "timeout"
                 break
             time.sleep(poll_interval)
     for p in procs:
         if p.poll() is None:
             p.kill()
-    for p in procs:
+    for rank, p in enumerate(procs):
         try:
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:  # pragma: no cover
             pass
+        if rcs.get(rank) is None:
+            rcs[rank] = p.poll()
+    if rc == 0:
+        cause = "clean"
+    if report is not None:
+        report.update(rcs=rcs, first_failure=first_failure,
+                      stragglers=stragglers, cause=cause)
     return rc
 
 
-def _stream(prefix: str, pipe) -> None:
-    for line in iter(pipe.readline, b""):
-        sys.stderr.write(f"[{prefix}] {line.decode(errors='replace')}")
-        sys.stderr.flush()
+def _stream(prefix: str, pipe, log_path: str | None = None) -> None:
+    log = open(log_path, "ab") if log_path else None
+    try:
+        for line in iter(pipe.readline, b""):
+            sys.stderr.write(f"[{prefix}] {line.decode(errors='replace')}")
+            sys.stderr.flush()
+            if log is not None:
+                log.write(line)
+                log.flush()
+    finally:
+        if log is not None:
+            log.close()
+
+
+def _make_monitor(heartbeat_dir: str | None, round_deadline: float | None):
+    if not (heartbeat_dir and round_deadline):
+        return None
+    # lazy import: the health plane is optional and the launcher should
+    # stay importable without it on minimal rigs
+    from ..parallel.health import StragglerMonitor
+    os.makedirs(heartbeat_dir, exist_ok=True)
+    return StragglerMonitor(heartbeat_dir, round_deadline)
 
 
 def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
                  devices_per_proc: int | None = None,
                  coordinator: str | None = None,
                  timeout: float | None = None,
-                 extra_env: dict | None = None) -> int:
+                 extra_env: dict | None = None,
+                 heartbeat_dir: str | None = None,
+                 round_deadline: float | None = None,
+                 log_dir: str | None = None,
+                 report: dict | None = None) -> int:
     """Spawn ``nprocs`` copies of ``cmd`` locally; returns the first
     non-zero exit code, else 0.  Output is streamed with [p<i>] prefixes.
     The first worker death kills the remaining workers immediately
     (see ``_wait_all``).  ``extra_env`` adds per-job vars to every child
-    (the ResilientRunner's attempt-stamping channel)."""
+    (the ResilientRunner's attempt-stamping channel); ``heartbeat_dir`` /
+    ``round_deadline`` / ``log_dir`` / ``report`` are the health plane
+    (module docstring)."""
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    monitor = _make_monitor(heartbeat_dir, round_deadline)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
     procs = []
     threads = []
     for pid in range(nprocs):
         env = _proc_env(os.environ, coordinator, nprocs, pid, platform,
                         devices_per_proc, extra_env)
+        if heartbeat_dir:
+            env["SPARKNET_HEARTBEAT_DIR"] = heartbeat_dir
         p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
-        t = threading.Thread(target=_stream, args=(f"p{pid}", p.stdout),
+        log = os.path.join(log_dir, f"rank_{pid}.log") if log_dir else None
+        t = threading.Thread(target=_stream, args=(f"p{pid}", p.stdout, log),
                              daemon=True)
         t.start()
         procs.append(p)
         threads.append(t)
-    rc = _wait_all(procs, timeout)
+    rc = _wait_all(procs, timeout, monitor=monitor, report=report)
     for t in threads:
         t.join(timeout=5)
     return rc
@@ -135,11 +213,21 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
                coordinator_port: int | None = None,
                cwd: str | None = None,
                timeout: float | None = None,
-               extra_env: dict | None = None) -> int:
-    """Run ``cmd`` on every host via ssh; host 0 doubles as coordinator."""
+               extra_env: dict | None = None,
+               heartbeat_dir: str | None = None,
+               round_deadline: float | None = None,
+               log_dir: str | None = None,
+               report: dict | None = None) -> int:
+    """Run ``cmd`` on every host via ssh; host 0 doubles as coordinator.
+    The health plane (``heartbeat_dir``/``round_deadline``) requires the
+    dir to be on a filesystem shared with the supervisor — the same
+    assumption the checkpoint dir makes."""
     port = coordinator_port or 9876
     coordinator = f"{hosts[0]}:{port}"
     cwd = cwd or os.getcwd()
+    monitor = _make_monitor(heartbeat_dir, round_deadline)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
     procs = []
     threads = []
     for pid, host in enumerate(hosts):
@@ -148,6 +236,8 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
             ("SPARKNET_NUM_PROCS", str(len(hosts))),
             ("SPARKNET_PROC_ID", str(pid)),
         ]
+        if heartbeat_dir:
+            pairs.append(("SPARKNET_HEARTBEAT_DIR", heartbeat_dir))
         if extra_env:
             pairs.extend((k, str(v)) for k, v in extra_env.items())
         envs = " ".join(f"{k}={v!r}" for k, v in pairs)
@@ -155,12 +245,13 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
         p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host, remote],
                              stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
-        t = threading.Thread(target=_stream, args=(host, p.stdout),
+        log = os.path.join(log_dir, f"rank_{pid}.log") if log_dir else None
+        t = threading.Thread(target=_stream, args=(host, p.stdout, log),
                              daemon=True)
         t.start()
         procs.append(p)
         threads.append(t)
-    rc = _wait_all(procs, timeout)
+    rc = _wait_all(procs, timeout, monitor=monitor, report=report)
     for t in threads:
         t.join(timeout=5)
     return rc
@@ -178,19 +269,31 @@ def main(argv=None) -> int:
     ap.add_argument("--devices-per-proc", type=int, default=None,
                     help="virtual CPU devices per process (test rigs)")
     ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("--heartbeat-dir", default=None,
+                    help="shared dir for worker liveness beacons")
+    ap.add_argument("--round-deadline", type=float, default=None,
+                    help="seconds of beacon silence before a rank is "
+                         "declared hung and killed (needs --heartbeat-dir)")
+    ap.add_argument("--log-dir", default=None,
+                    help="tee each rank's output to rank_<i>.log here")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to run (prefix with --)")
     args = ap.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         ap.error("no command given")
+    if args.round_deadline and not args.heartbeat_dir:
+        ap.error("--round-deadline requires --heartbeat-dir")
+    health = dict(heartbeat_dir=args.heartbeat_dir,
+                  round_deadline=args.round_deadline, log_dir=args.log_dir)
     if args.hosts:
-        return launch_ssh(cmd, args.hosts.split(","), timeout=args.timeout)
+        return launch_ssh(cmd, args.hosts.split(","), timeout=args.timeout,
+                          **health)
     if not args.nprocs:
         ap.error("--nprocs or --hosts required")
     return launch_local(cmd, args.nprocs, platform=args.platform,
                         devices_per_proc=args.devices_per_proc,
-                        timeout=args.timeout)
+                        timeout=args.timeout, **health)
 
 
 if __name__ == "__main__":

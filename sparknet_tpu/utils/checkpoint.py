@@ -82,11 +82,13 @@ def save_checkpoint(path: str, tree: Any) -> None:
     host_tree = jax.tree_util.tree_map(np.asarray, tree)
     _flatten(host_tree, "root", arrays, meta)
     meta["__checksum__"] = content_checksum(arrays)
-    tmp = path + ".tmp"
+    # pid-stamped temp name: a writer killed mid-write leaves an orphan
+    # that can never collide with a later writer's live temp file; the
+    # .npz suffix keeps np.savez from appending its own
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
     np.savez(tmp, __meta__=np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8), **arrays)
-    # np.savez appends .npz to the temp name
-    os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
+    os.replace(tmp, path)
 
 
 def load_checkpoint(path: str, verify: bool = True) -> Any:

@@ -7,27 +7,51 @@ first-class, deterministic test input.  Faults are described by the
 
     SPARKNET_FAULT=<spec>[,<spec>...]
     spec     := kind[:arg][@round:<N>][@rank:<R>][@attempt:<A>]
-    kind     := crash        — os._exit(43) at the start of round N
-              | hang         — block forever at the start of round N
-              | slow_feed    — arg = per-batch delay ("200ms", "0.5s", "2")
-              | corrupt_ckpt — scribble over the checkpoint written at
-                               round N, after its manifest exists
+    kind     := crash         — os._exit(43) at the start of round N
+              | perma_crash   — os._exit(43) on EVERY attempt (a broken
+                                host, not a transient death; needs @rank —
+                                the elastic layer drops the rank once its
+                                restart budget is spent)
+              | hang          — block forever at the start of round N
+              | straggle      — arg = duration: sleep that long at the
+                                start of round N (a stuck-but-alive rank;
+                                the straggler deadline must catch it)
+              | slow_feed     — arg = per-batch delay ("200ms", "0.5s", "2")
+              | nan_inject    — poison the round-N feed with NaNs (the
+                                numerical-integrity guard must roll back)
+              | corrupt_ckpt  — scribble over the checkpoint written at
+                                round N, after its manifest exists
+              | crash_in_ckpt — os._exit(43) mid-checkpoint-write at round
+                                N: after the npz is durable but BEFORE the
+                                manifest (the worst torn-write window —
+                                resume must skip the orphan)
 
 Scoping:
-  @round:N   — fire at round N (required for crash/hang; for corrupt_ckpt
-               it names the checkpointed round; slow_feed ignores it)
-  @rank:R    — only on process R (default: every rank)
+  @round:N   — fire at round N (required for crash/hang/straggle/
+               nan_inject/crash_in_ckpt; for corrupt_ckpt it names the
+               checkpointed round; optional for perma_crash — default
+               every round; slow_feed ignores it)
+  @rank:R    — only on process R (default: every rank; REQUIRED for
+               perma_crash)
   @attempt:A — only on job attempt A.  The ResilientRunner stamps every
                (re)launch with SPARKNET_FAULT_ATTEMPT; crash / hang /
-               corrupt_ckpt default to attempt 0 ONLY, so an injected
-               fault fires once and the automatic restart then runs
-               clean — the deterministic replacement for "the spot
-               instance came back".  slow_feed defaults to every attempt
-               (it models degradation, not death).
+               straggle / corrupt_ckpt / crash_in_ckpt / nan_inject
+               default to attempt 0 ONLY, so an injected fault fires once
+               and the automatic restart then runs clean — the
+               deterministic replacement for "the spot instance came
+               back".  slow_feed and perma_crash default to every attempt
+               (they model degradation and permanent loss, not a
+               transient death).
+
+nan_inject additionally fires at most once per process even without a
+restart: the guard's in-process rollback replays the same round index,
+and the replay must run clean (the deterministic replacement for "the
+cosmic ray does not strike twice").
 
 Hook points: ``FaultInjector.on_round`` in training drivers,
 ``feed_delay`` in ``data.prefetch.PrefetchIterator``, and
-``corrupt_checkpoint`` in the trainer's round-checkpoint writer.
+``nan_inject`` / ``corrupt_checkpoint`` / ``on_checkpoint_write`` in
+``parallel.trainer.DistributedTrainer``.
 """
 
 from __future__ import annotations
@@ -38,7 +62,15 @@ import sys
 import time
 from typing import Callable, Mapping
 
-KINDS = ("crash", "hang", "slow_feed", "corrupt_ckpt")
+KINDS = ("crash", "perma_crash", "hang", "straggle", "slow_feed",
+         "nan_inject", "corrupt_ckpt", "crash_in_ckpt")
+
+# kinds that keep firing on every job attempt unless @attempt pins one
+_EVERY_ATTEMPT = ("slow_feed", "perma_crash")
+# kinds whose ':' arg is a duration
+_DURATION_ARG = ("slow_feed", "straggle")
+# kinds that must name a round
+_NEED_ROUND = ("crash", "hang", "straggle", "nan_inject", "crash_in_ckpt")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,7 +79,7 @@ class FaultSpec:
     round: int | None = None
     rank: int | None = None
     attempt: int | None = None     # None => kind-specific default (see doc)
-    delay_s: float = 0.0           # slow_feed only
+    delay_s: float = 0.0           # slow_feed / straggle only
 
 
 def _parse_duration(text: str) -> float:
@@ -78,9 +110,9 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
             raise ValueError(f"unknown fault kind {kind!r} in {raw!r} "
                              f"(known: {', '.join(KINDS)})")
         delay = 0.0
-        if kind == "slow_feed":
+        if kind in _DURATION_ARG:
             if not arg:
-                raise ValueError(f"slow_feed needs a duration arg in {raw!r}")
+                raise ValueError(f"{kind} needs a duration arg in {raw!r}")
             delay = _parse_duration(arg)
         elif arg:
             raise ValueError(f"{kind} takes no ':' arg (got {raw!r})")
@@ -96,8 +128,12 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
             except ValueError:
                 raise ValueError(
                     f"modifier {mod!r} in {raw!r}: not an integer") from None
-        if kind in ("crash", "hang") and "round" not in fields:
+        if kind in _NEED_ROUND and "round" not in fields:
             raise ValueError(f"{kind} needs @round:N ({raw!r})")
+        if kind == "perma_crash" and "rank" not in fields:
+            raise ValueError(
+                f"perma_crash needs @rank:R ({raw!r}) — a rankless "
+                f"permanent crash means no survivor set to re-form with")
         specs.append(FaultSpec(kind=kind, round=fields.get("round"),
                                rank=fields.get("rank"),
                                attempt=fields.get("attempt"),
@@ -119,6 +155,7 @@ class FaultInjector:
         self.rank = rank
         self._exit = _exit
         self._sleep = _sleep
+        self._fired: set[FaultSpec] = set()   # once-per-process kinds
 
     @classmethod
     def from_env(cls, env: Mapping[str, str] | None = None,
@@ -136,24 +173,32 @@ class FaultInjector:
             return False
         want = spec.attempt
         if want is None:
-            # one-shot faults fire on the first attempt only; slow_feed
-            # degrades every attempt
-            want = None if spec.kind == "slow_feed" else 0
+            # one-shot faults fire on the first attempt only; degradation
+            # and permanent-loss kinds fire on every attempt
+            want = None if spec.kind in _EVERY_ATTEMPT else 0
         return want is None or want == self.attempt
 
     def on_round(self, round_idx: int, rank: int | None = None) -> None:
         """Call at the start of every training round."""
         for spec in self.specs:
-            if spec.kind not in ("crash", "hang") or spec.round != round_idx:
+            if spec.kind not in ("crash", "perma_crash", "hang", "straggle"):
+                continue
+            if spec.kind == "perma_crash":
+                if spec.round is not None and spec.round != round_idx:
+                    continue
+            elif spec.round != round_idx:
                 continue
             if not self._active(spec, rank):
                 continue
             who = self.rank if rank is None else rank
             print(f"FAULT: {spec.kind} at round {round_idx} on rank {who} "
                   f"(attempt {self.attempt})", file=sys.stderr, flush=True)
-            if spec.kind == "crash":
+            if spec.kind in ("crash", "perma_crash"):
                 self._exit(43)
                 return  # only reached with a test-injected _exit
+            if spec.kind == "straggle":
+                self._sleep(spec.delay_s)
+                continue  # a straggler resumes (if it survives that long)
             while True:  # hang: a stuck worker, killable only from outside
                 self._sleep(3600)
 
@@ -161,6 +206,20 @@ class FaultInjector:
         """Seconds each prefetched batch should be delayed by."""
         return sum(s.delay_s for s in self.specs
                    if s.kind == "slow_feed" and self._active(s, rank))
+
+    def nan_inject(self, round_idx: int, rank: int | None = None) -> bool:
+        """True when the round-``round_idx`` feed should be poisoned with
+        NaNs on this rank.  Fires at most ONCE per process per spec: the
+        guard's rollback replays the same round index and the replay must
+        run clean (see module docstring)."""
+        for spec in self.specs:
+            if (spec.kind != "nan_inject" or spec.round != round_idx
+                    or spec in self._fired
+                    or not self._active(spec, rank)):
+                continue
+            self._fired.add(spec)
+            return True
+        return False
 
     def corrupt_checkpoint(self, round_idx: int,
                            rank: int | None = None) -> bool:
@@ -172,13 +231,32 @@ class FaultInjector:
             and self._active(s, rank)
             for s in self.specs)
 
+    def on_checkpoint_write(self, round_idx: int,
+                            rank: int | None = None) -> None:
+        """Call between a round-checkpoint's npz write and its manifest
+        write — the torn-write window ``crash_in_ckpt`` kills in (the
+        orphan npz without a manifest must be invisible to resume)."""
+        for spec in self.specs:
+            if (spec.kind != "crash_in_ckpt" or spec.round != round_idx
+                    or not self._active(spec, rank)):
+                continue
+            who = self.rank if rank is None else rank
+            print(f"FAULT: crash_in_ckpt at round {round_idx} on rank "
+                  f"{who} (attempt {self.attempt})", file=sys.stderr,
+                  flush=True)
+            self._exit(43)
+            return  # only reached with a test-injected _exit
+
 
 _CACHE: tuple[tuple[str, ...], FaultInjector] | None = None
 
 
 def get_injector() -> FaultInjector:
     """Process-wide injector, re-parsed whenever the driving env vars
-    change (so tests can monkeypatch the env between uses)."""
+    change (so tests can monkeypatch the env between uses).  Note the
+    once-per-process state (``nan_inject``) lives in the cached instance:
+    tests that reuse an identical SPARKNET_FAULT value across cases must
+    call :func:`reset_injector` to re-arm it."""
     global _CACHE
     key = tuple(os.environ.get(k, "") for k in
                 ("SPARKNET_FAULT", "SPARKNET_FAULT_ATTEMPT",
@@ -186,6 +264,12 @@ def get_injector() -> FaultInjector:
     if _CACHE is None or _CACHE[0] != key:
         _CACHE = (key, FaultInjector.from_env())
     return _CACHE[1]
+
+
+def reset_injector() -> None:
+    """Drop the process-wide injector (and its fired-once memory)."""
+    global _CACHE
+    _CACHE = None
 
 
 def scribble(path: str) -> None:

@@ -17,22 +17,39 @@ Knobs (also via env, read per call so launchers can tune children):
 from __future__ import annotations
 
 import os
+import random
 import sys
 import time
 from typing import Any, Callable, Iterable
 
 
 def backoff_delays(attempts: int, base: float, factor: float = 2.0,
-                   max_delay: float = 30.0) -> Iterable[float]:
+                   max_delay: float = 30.0, jitter: float = 0.0,
+                   rng: random.Random | None = None) -> Iterable[float]:
     """The sleep schedule between ``attempts`` tries: base, base·factor,
-    base·factor², ... capped at ``max_delay`` (len == attempts - 1)."""
+    base·factor², ... capped at ``max_delay`` (len == attempts - 1).
+
+    ``jitter`` > 0 spreads each delay uniformly over
+    ``[delay·(1-jitter), delay·(1+jitter)]`` so N simultaneously-failed
+    ranks don't retry in lockstep and thundering-herd the coordinator
+    (every rank of a torn-down job restarts at the same instant — without
+    jitter they all re-connect in the same millisecond too).  ``rng`` is
+    injectable for deterministic tests; the default is seeded per-process
+    by the OS, which is exactly the decorrelation the herd needs."""
+    if not 0.0 <= jitter < 1.0:
+        raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+    rng = rng or random
     for i in range(max(attempts - 1, 0)):
-        yield min(base * factor ** i, max_delay)
+        delay = min(base * factor ** i, max_delay)
+        if jitter:
+            delay *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+        yield delay
 
 
 def retry_call(fn: Callable[..., Any], *args: Any,
                attempts: int = 3, base_delay: float = 0.1,
                factor: float = 2.0, max_delay: float = 30.0,
+               jitter: float = 0.0,
                retry_on: tuple[type[BaseException], ...] = (OSError,),
                sleep: Callable[[float], None] = time.sleep,
                describe: str | None = None, **kwargs: Any) -> Any:
@@ -42,7 +59,8 @@ def retry_call(fn: Callable[..., Any], *args: Any,
     is Spark's maxFailures contract, not an infinite supervisor)."""
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
-    delays = list(backoff_delays(attempts, base_delay, factor, max_delay))
+    delays = list(backoff_delays(attempts, base_delay, factor, max_delay,
+                                 jitter))
     for i in range(attempts):
         try:
             return fn(*args, **kwargs)

@@ -13,6 +13,14 @@ job converges to the fault-free result.  Per-round data is derived from
 the ROUND INDEX alone (not a running RNG stream), so a resumed round
 refeeds exactly the batch the killed round would have seen.
 
+Elastic rig: ``--elastic`` lets the trainer resume a checkpoint written
+by a DIFFERENT worker count (the re-formed survivor set), ``--guard``
+arms the numerical-integrity guard (NaN/Inf → rollback), and the round
+loop is driven by ``tr.round`` so a guard rollback naturally replays the
+dropped round.  Heartbeats are published whenever the launcher sets
+SPARKNET_HEARTBEAT_DIR.  SIGTERM/SIGINT trigger one final round
+checkpoint before a clean exit (preemption contract, utils/signals.py).
+
 Invoked by sparknet_tpu.tools.launch (env contract) or standalone
 single-process with --local-devices N.
 """
@@ -45,10 +53,19 @@ def main() -> None:
     ap.add_argument("--local-devices", type=int, default=None,
                     help="single-process mode: virtual CPU device count")
     ap.add_argument("--expect-devices", type=int, default=4,
-                    help="global device count the mesh must have")
+                    help="global device count the mesh must have "
+                         "(0 = don't check — elastic worlds vary)")
     ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--global-batch", type=int, default=16)
     ap.add_argument("--ckpt-dir", default=None,
                     help="round-granular checkpoint/auto-resume directory")
+    ap.add_argument("--ckpt-every", type=int, default=1)
+    ap.add_argument("--elastic", action="store_true",
+                    help="allow resuming a checkpoint from a different "
+                         "worker count (degraded-mode re-form)")
+    ap.add_argument("--guard", action="store_true",
+                    help="arm the numerical-integrity guard (needs "
+                         "--ckpt-dir)")
     ap.add_argument("--fail-rank", type=int, default=None,
                     help="failure-path mode: this rank dies (exit 3) after "
                          "the first round")
@@ -74,6 +91,7 @@ def main() -> None:
     )
     from sparknet_tpu.proto import load_solver_prototxt_with_net
     from sparknet_tpu.utils import faults
+    from sparknet_tpu.utils.signals import SolverAction, preemption_guard
 
     distributed = init_cluster_from_env()
     if args.strategy == "hierarchical":
@@ -87,17 +105,21 @@ def main() -> None:
     else:
         mesh = make_mesh()
         n_devices = mesh.shape["data"]
-    assert n_devices == args.expect_devices, (
-        f"expected {args.expect_devices} global devices, got {n_devices}")
+    if args.expect_devices:
+        assert n_devices == args.expect_devices, (
+            f"expected {args.expect_devices} global devices, got {n_devices}")
 
-    GLOBAL_BATCH, TAU = 16, 2
+    GLOBAL_BATCH, TAU = args.global_batch, 2
     sp = load_solver_prototxt_with_net(
         'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n',
         lenet(GLOBAL_BATCH, GLOBAL_BATCH))
     tr = DistributedTrainer(
         sp, mesh,
         TrainerConfig(strategy=args.strategy, tau=TAU,
-                      checkpoint_dir=args.ckpt_dir, checkpoint_every=1),
+                      checkpoint_dir=args.ckpt_dir,
+                      checkpoint_every=args.ckpt_every,
+                      elastic=args.elastic,
+                      guard_numerics=args.guard),
         seed=0)
     rows = local_batch_slice(GLOBAL_BATCH)
     injector = faults.get_injector()
@@ -107,16 +129,37 @@ def main() -> None:
               f"{injector.attempt})", flush=True)
 
     losses = []
-    for r in range(tr.round, args.rounds):
-        injector.on_round(r, rank=rank)
-        x, y = round_batch(r, TAU, GLOBAL_BATCH)
-        losses.append(tr.train_round(
-            {"data": x[:, rows], "label": y[:, rows].astype(np.float32)}))
-        if r == 0 and args.fail_rank is not None \
-                and jax.process_index() == args.fail_rank:
-            print(f"driver: rank {args.fail_rank} dying (failure-path test)",
-                  flush=True)
-            os._exit(3)
+    preempted = False
+    with preemption_guard() as guard:
+        # driven by tr.round, not a range(): a guard rollback rewinds
+        # tr.round and the loop replays the dropped round
+        while tr.round < args.rounds:
+            action = guard.check()
+            if action in (SolverAction.SNAPSHOT, SolverAction.SNAPSHOT_STOP):
+                if args.ckpt_dir:
+                    print(f"driver: signal checkpoint at round {tr.round}",
+                          flush=True)
+                    tr.save_round_checkpoint()
+            if action in (SolverAction.STOP, SolverAction.SNAPSHOT_STOP):
+                print(f"driver: preempted; stopped cleanly at round "
+                      f"boundary {tr.round}", flush=True)
+                preempted = True
+                break
+            r = tr.round
+            injector.on_round(r, rank=rank)
+            x, y = round_batch(r, TAU, GLOBAL_BATCH)
+            loss = tr.train_round(
+                {"data": x[:, rows], "label": y[:, rows].astype(np.float32)})
+            losses.append(loss)
+            print(f"driver: round {r} done loss={loss:.4f}", flush=True)
+            if r == 0 and args.fail_rank is not None \
+                    and jax.process_index() == args.fail_rank:
+                print(f"driver: rank {args.fail_rank} dying "
+                      f"(failure-path test)", flush=True)
+                os._exit(3)
+
+    if preempted:
+        return  # clean exit: the relaunch resumes from the checkpoint
 
     erng = np.random.default_rng(2000)
     eval_y = erng.integers(0, 10, size=(GLOBAL_BATCH,))
@@ -132,6 +175,7 @@ def main() -> None:
             for i, b in enumerate(blobs):
                 flat[f"{lname}/{i}"] = np.asarray(b)
         flat["__losses__"] = np.asarray(losses)
+        flat["__guard_trips__"] = np.asarray(tr.guard_trips)
         flat["__scores__"] = np.asarray(
             [scores.get("loss", 0.0), scores.get("accuracy", 0.0)])
         np.savez(args.out, **flat)

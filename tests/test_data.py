@@ -173,6 +173,38 @@ def test_partitioned_dataset():
     assert co.num_partitions == 2 and co.count() == 10
 
 
+def test_partition_rebalance_recovers_all_records_in_order():
+    """The elastic re-shard primitive: dropping a dead worker's partition
+    then rebalancing over the survivors must re-cover EVERY record, keep
+    order, and balance sizes within 1."""
+    ds = PartitionedDataset([[0, 1, 2], [3, 4, 5], [6, 7, 8], [9, 10, 11]])
+    survivors = ds.without_partitions([3]).rebalance(3)
+    assert survivors.num_partitions == 3
+    assert survivors.partition_sizes() == [3, 3, 3]
+    flat = [x for p in survivors.partitions for x in p]
+    assert flat == list(range(9))            # order preserved, none lost
+    # re-covering the DEAD worker's records: rebalance the full set
+    reformed = ds.rebalance(3)
+    assert reformed.count() == 12
+    assert reformed.partition_sizes() == [4, 4, 4]
+    assert [x for p in reformed.partitions for x in p] == list(range(12))
+    # a rejoin at the next round boundary re-grows the partition count
+    regrown = reformed.rebalance(4)
+    assert regrown.partition_sizes() == [3, 3, 3, 3]
+    # uneven splits stay contiguous and within-1 balanced
+    odd = PartitionedDataset([list(range(10))]).rebalance(3)
+    assert odd.partition_sizes() == [4, 3, 3]
+    assert odd.partitions[0] == [0, 1, 2, 3]
+
+
+def test_partition_rebalance_validates():
+    ds = PartitionedDataset([[1], [2]])
+    with pytest.raises(IndexError, match="out of range"):
+        ds.without_partitions([5])
+    with pytest.raises(ValueError, match="num_partitions"):
+        ds.rebalance(0)
+
+
 # ---------------------------------------------------------------------------
 # synthgen: the generalization-bearing learning-proxy dataset
 # ---------------------------------------------------------------------------

@@ -44,6 +44,17 @@ def test_parse_faults_grammar():
     assert specs[3] == faults.FaultSpec("hang", round=5, attempt=2)
 
 
+def test_parse_faults_elastic_kinds():
+    specs = faults.parse_faults(
+        "perma_crash@rank:3, straggle:1.5s@round:2, nan_inject@round:4,"
+        "crash_in_ckpt@round:1")
+    assert specs[0] == faults.FaultSpec("perma_crash", rank=3)
+    assert specs[1].kind == "straggle" and specs[1].delay_s == 1.5
+    assert specs[1].round == 2
+    assert specs[2] == faults.FaultSpec("nan_inject", round=4)
+    assert specs[3] == faults.FaultSpec("crash_in_ckpt", round=1)
+
+
 @pytest.mark.parametrize("bad, msg", [
     ("explode@round:1", "unknown fault kind"),
     ("crash", "needs @round"),
@@ -52,10 +63,58 @@ def test_parse_faults_grammar():
     ("slow_feed", "needs a duration"),
     ("slow_feed:fast", "bad duration"),
     ("crash:3@round:1", "takes no ':' arg"),
+    ("straggle@round:1", "needs a duration"),
+    ("nan_inject", "needs @round"),
+    ("crash_in_ckpt", "needs @round"),
+    ("perma_crash", "needs @rank"),
 ])
 def test_parse_faults_rejects(bad, msg):
     with pytest.raises(ValueError, match=msg):
         faults.parse_faults(bad)
+
+
+def test_perma_crash_fires_on_every_attempt_matching_rank_only():
+    inj, calls = _injector("perma_crash@rank:2", attempt=5, rank=2)
+    with pytest.raises(_Exit):
+        inj.on_round(0, rank=2)            # any round, any attempt
+    assert calls["exit"] == [43]
+    inj2, calls2 = _injector("perma_crash@rank:2", attempt=5, rank=1)
+    inj2.on_round(0, rank=1)               # survivor ranks untouched
+    assert calls2["exit"] == []
+
+
+def test_straggle_sleeps_then_continues():
+    inj, calls = _injector("straggle:2.5s@round:1")
+    inj.on_round(0)                        # wrong round: no-op
+    assert calls["sleep"] == []
+    with pytest.raises(_Exit):             # test sleep raises to observe
+        inj.on_round(1)
+    assert calls["sleep"] == [2.5]
+    # one-shot default: the relaunched attempt runs clean
+    inj2, calls2 = _injector("straggle:2.5s@round:1", attempt=1)
+    inj2.on_round(1)
+    assert calls2["sleep"] == []
+
+
+def test_nan_inject_fires_once_per_process():
+    inj, _ = _injector("nan_inject@round:2")
+    assert not inj.nan_inject(1)
+    assert inj.nan_inject(2)
+    assert not inj.nan_inject(2)           # rollback replay runs clean
+    inj2, _ = _injector("nan_inject@round:2@rank:1", rank=0)
+    assert not inj2.nan_inject(2)          # other ranks unpoisoned
+
+
+def test_crash_in_ckpt_hook():
+    inj, calls = _injector("crash_in_ckpt@round:3")
+    inj.on_checkpoint_write(2)             # wrong round: no-op
+    assert calls["exit"] == []
+    with pytest.raises(_Exit):
+        inj.on_checkpoint_write(3)
+    assert calls["exit"] == [43]
+    inj1, calls1 = _injector("crash_in_ckpt@round:3", attempt=1)
+    inj1.on_checkpoint_write(3)            # restarted job writes clean
+    assert calls1["exit"] == []
 
 
 def test_duration_units():
@@ -139,8 +198,22 @@ def test_get_injector_tracks_env(monkeypatch):
 
 def test_restart_policy_backoff_sequence_and_cap():
     p = RestartPolicy(max_restarts=5, backoff_base=1.0, backoff_factor=3.0,
-                      backoff_max=10.0)
+                      backoff_max=10.0, jitter=0.0)
     assert [p.delay(i) for i in range(4)] == [1.0, 3.0, 9.0, 10.0]
+
+
+def test_restart_policy_jitter_spreads_but_bounds_delays():
+    """Jitter (on by default) must keep every delay inside
+    [d·(1-j), d·(1+j)] and actually decorrelate two runners — the
+    anti-thundering-herd contract."""
+    import random
+    p = RestartPolicy(backoff_base=4.0, jitter=0.25)
+    a = [p.delay(0, random.Random(1)) for _ in range(50)]
+    b = [p.delay(0, random.Random(2)) for _ in range(50)]
+    assert all(3.0 <= d <= 5.0 for d in a + b)
+    assert a[0] != b[0]                      # different rank seeds differ
+    deterministic = RestartPolicy(backoff_base=4.0, jitter=0.0)
+    assert deterministic.delay(0) == 4.0
 
 
 def test_runner_requires_exactly_one_mode():
@@ -164,7 +237,7 @@ def _fake_runner(monkeypatch, rcs):
     monkeypatch.setattr(R, "launch_local", fake_local)
     runner = ResilientRunner(
         ["job"], nprocs=2,
-        policy=RestartPolicy(max_restarts=3, backoff_base=0.5),
+        policy=RestartPolicy(max_restarts=3, backoff_base=0.5, jitter=0.0),
         sleep=lambda s: seen["sleeps"].append(s))
     return runner, seen
 
@@ -374,24 +447,27 @@ def test_launch_local_extra_env_reaches_children(tmp_path):
 # trainer round-granular checkpoint / resume (in-process, 4 virtual devices)
 # ---------------------------------------------------------------------------
 
-def _make_trainer(ckpt_dir, seed=0, every=1, keep=3):
+def _make_trainer(ckpt_dir, seed=0, every=1, keep=3, *, strategy="local_sgd",
+                  batch=16, workers=4, lr=0.05, **cfg_kw):
     from sparknet_tpu.models import lenet
     from sparknet_tpu.parallel import (
         DistributedTrainer, TrainerConfig, make_mesh,
     )
     from sparknet_tpu.proto import load_solver_prototxt_with_net
     sp = load_solver_prototxt_with_net(
-        'base_lr: 0.05\nmomentum: 0.9\nlr_policy: "fixed"\n', lenet(16, 16))
-    cfg = TrainerConfig(strategy="local_sgd", tau=2,
-                        checkpoint_dir=str(ckpt_dir), checkpoint_every=every,
-                        checkpoint_keep=keep)
-    return DistributedTrainer(sp, make_mesh(4), cfg, seed=seed)
+        f'base_lr: {lr}\nmomentum: 0.9\nlr_policy: "fixed"\n',
+        lenet(batch, batch))
+    cfg = TrainerConfig(strategy=strategy, tau=2,
+                        checkpoint_dir=str(ckpt_dir) if ckpt_dir else None,
+                        checkpoint_every=every, checkpoint_keep=keep,
+                        **cfg_kw)
+    return DistributedTrainer(sp, make_mesh(workers), cfg, seed=seed)
 
 
-def _batch(r):
+def _batch(r, batch=16):
     rng = np.random.default_rng(100 + r)
-    return {"data": rng.normal(size=(2, 16, 1, 28, 28)).astype(np.float32),
-            "label": rng.integers(0, 10, size=(2, 16)).astype(np.float32)}
+    return {"data": rng.normal(size=(2, batch, 1, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(2, batch)).astype(np.float32)}
 
 
 def test_round_checkpoint_resume_is_exact(tmp_path):
@@ -592,6 +668,381 @@ def test_hang_restart_recovers_via_timeout(tmp_path):
     assert rc == 0, f"hung job did not recover, rc={rc}"
     assert [a.returncode for a in runner.attempts] == [124, 0]
     assert os.path.exists(out)
+
+
+# ---------------------------------------------------------------------------
+# jittered retry backoff (satellite: anti-thundering-herd)
+# ---------------------------------------------------------------------------
+
+def test_backoff_delays_jitter_bounds_and_validation():
+    import random
+    base = list(backoff_delays(4, 1.0, 2.0, 10.0))
+    jittered = list(backoff_delays(4, 1.0, 2.0, 10.0, jitter=0.5,
+                                   rng=random.Random(7)))
+    assert len(jittered) == len(base) == 3
+    for d, j in zip(base, jittered):
+        assert d * 0.5 <= j <= d * 1.5
+    assert jittered != base                  # jitter actually moved them
+    # two processes (different rng seeds) must NOT sleep in lockstep
+    a = list(backoff_delays(3, 1.0, jitter=0.3, rng=random.Random(1)))
+    b = list(backoff_delays(3, 1.0, jitter=0.3, rng=random.Random(2)))
+    assert a != b
+    with pytest.raises(ValueError, match="jitter"):
+        list(backoff_delays(3, 1.0, jitter=1.5))
+
+
+def test_retry_call_accepts_jitter():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise OSError("blip")
+        return "ok"
+
+    sleeps = []
+    assert retry_call(flaky, attempts=3, base_delay=1.0, jitter=0.5,
+                      sleep=sleeps.append) == "ok"
+    assert len(sleeps) == 1 and 0.5 <= sleeps[0] <= 1.5
+
+
+# ---------------------------------------------------------------------------
+# resume_latest edge cases (satellite)
+# ---------------------------------------------------------------------------
+
+def test_resume_latest_empty_and_missing_dir(tmp_path):
+    tr = _make_trainer(tmp_path / "empty")          # dir never written to
+    assert tr.resumed is None and tr.round == 0
+    assert tr.resume_latest(str(tmp_path / "never_created")) is None
+
+
+def test_resume_latest_all_manifests_corrupt(tmp_path):
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)
+    for r in range(2):
+        tr.train_round(_batch(r))
+    for f in os.listdir(d):
+        if f.startswith("manifest_"):
+            (d / f).write_text("{ not json at all")
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is None and tr2.round == 0   # fresh start, no crash
+
+
+def test_resume_latest_mixed_valid_and_corrupt(tmp_path):
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)
+    for r in range(3):
+        tr.train_round(_batch(r))
+    # newest manifest: unparsable JSON; next: points at a missing file;
+    # round 1 stays intact — resume must land exactly there
+    (d / "manifest_00000003.json").write_text("!!")
+    m2 = json.loads((d / "manifest_00000002.json").read_text())
+    m2["file"] = "ckpt_round_99999999.npz"
+    (d / "manifest_00000002.json").write_text(json.dumps(m2))
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None
+    assert tr2.round == 1
+    assert tr2.resumed["file"] == "ckpt_round_00000001.npz"
+
+
+def test_pruning_keeps_exactly_checkpoint_keep_newest(tmp_path):
+    d = tmp_path / "ck"
+    tr = _make_trainer(d, keep=2)
+    for r in range(5):
+        tr.train_round(_batch(r))
+    rounds = sorted(int(f[len("manifest_"):-len(".json")])
+                    for f in os.listdir(d) if f.startswith("manifest_"))
+    assert rounds == [4, 5]
+    npzs = sorted(f for f in os.listdir(d) if f.endswith(".npz"))
+    assert npzs == ["ckpt_round_00000004.npz", "ckpt_round_00000005.npz"]
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpoint writes (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_kill_during_npz_write_leaves_no_referenced_garbage(tmp_path,
+                                                            monkeypatch):
+    """A worker killed INSIDE the npz write (before the atomic rename)
+    must leave no final-name npz, no manifest, and a resumable dir."""
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)
+    for r in range(2):
+        tr.train_round(_batch(r))
+
+    class _Killed(BaseException):
+        pass
+
+    real_replace = os.replace
+
+    def killed_replace(src, dst):
+        if dst.endswith(".npz"):           # die before the rename lands
+            raise _Killed()
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", killed_replace)
+    with pytest.raises(_Killed):
+        tr.train_round(_batch(2))
+    monkeypatch.setattr(os, "replace", real_replace)
+    names = set(os.listdir(d))
+    assert "ckpt_round_00000003.npz" not in names
+    assert "manifest_00000003.json" not in names
+    assert any(".tmp." in n for n in names)         # the orphan temp
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None and tr2.round == 2
+    # the next successful checkpoint sweeps the orphan temp away
+    tr2.train_round(_batch(2))
+    assert not any(".tmp." in n for n in os.listdir(d))
+
+
+@pytest.mark.chaos
+def test_crash_between_npz_and_manifest_is_invisible_to_resume(tmp_path,
+                                                               monkeypatch):
+    """The crash_in_ckpt fault kills in the torn-write window: npz
+    durable, manifest never written.  resume_latest must skip the orphan
+    npz (no manifest references it) and land on the previous round."""
+    d = tmp_path / "ck"
+    monkeypatch.setenv("SPARKNET_FAULT", "crash_in_ckpt@round:3")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+
+    class _Killed(BaseException):
+        pass
+
+    def fake_exit(code):
+        raise _Killed()
+
+    import sparknet_tpu.utils.faults as F
+    monkeypatch.setattr(F.get_injector(), "_exit", fake_exit)
+    tr = _make_trainer(d)
+    tr.train_round(_batch(0))
+    tr.train_round(_batch(1))
+    with pytest.raises(_Killed):
+        tr.train_round(_batch(2))          # dies mid-checkpoint of round 3
+    names = set(os.listdir(d))
+    assert "ckpt_round_00000003.npz" in names       # npz IS durable...
+    assert "manifest_00000003.json" not in names    # ...but unreferenced
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "1")   # the restart
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None and tr2.round == 2
+    # the restarted job replays round 2 and overwrites the orphan cleanly
+    tr2.train_round(_batch(2))
+    blob = load_checkpoint(str(d / "ckpt_round_00000003.npz"))
+    assert int(blob["round"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# elastic degraded-mode resume (tentpole: re-form on the survivors)
+# ---------------------------------------------------------------------------
+
+def test_elastic_resume_shrink_preserves_consensus_params(tmp_path):
+    """4-worker sync job checkpoints; a 3-worker elastic trainer resumes
+    it: the averaged params ARE the consensus and must restore exactly."""
+    d = tmp_path / "ck"
+    a = _make_trainer(d, strategy="sync", batch=24, workers=4, lr=0.005)
+    for r in range(2):
+        a.train_round(_batch(r, 24))
+    b = _make_trainer(d, seed=99, strategy="sync", batch=24, workers=3, lr=0.005,
+                      elastic=True)
+    assert b.resumed is not None
+    assert b.round == 2 and b.iter == a.iter
+    np.testing.assert_array_equal(np.asarray(b.params["conv1"][0]),
+                                  np.asarray(a.params["conv1"][0]))
+    # the degraded world trains on: 24-row batches over 3 workers
+    loss = b.train_round(_batch(2, 24))
+    assert np.isfinite(loss)
+
+
+def test_elastic_resume_without_flag_still_raises(tmp_path):
+    d = tmp_path / "ck"
+    a = _make_trainer(d, strategy="sync", batch=24, workers=4, lr=0.005)
+    a.train_round(_batch(0, 24))
+    with pytest.raises(ValueError, match="elastic"):
+        _make_trainer(d, seed=99, strategy="sync", batch=24, workers=3, lr=0.005)
+
+
+def test_elastic_retier_local_sgd_state_shrink_and_grow(tmp_path):
+    """Per-worker optimizer state re-tiers deterministically: survivor i
+    inherits saved row i mod saved_n (shrink drops the dead rows; a
+    rejoined worker is seeded from row 0)."""
+    d = tmp_path / "ck"
+    a = _make_trainer(d, batch=24, workers=4, lr=0.005)      # local_sgd
+    for r in range(2):
+        a.train_round(_batch(r, 24))
+
+    def rows(tr):
+        leaf = jax.tree_util.tree_leaves(tr.state)[0]
+        return np.asarray(leaf)
+
+    import jax
+    a_rows = rows(a)
+    assert a_rows.shape[0] == 4
+    b = _make_trainer(d, seed=99, batch=24, workers=3, lr=0.005, elastic=True)
+    b_rows = rows(b)
+    assert b_rows.shape[0] == 3
+    np.testing.assert_array_equal(b_rows, a_rows[:3])
+    loss = b.train_round(_batch(2, 24))            # degraded world trains
+    assert np.isfinite(loss)
+    # grow (rejoin): a 4-worker trainer resumes the 3-worker checkpoint
+    c = _make_trainer(d, seed=7, batch=24, workers=4, lr=0.005, elastic=True)
+    c_rows = rows(c)
+    assert c_rows.shape[0] == 4
+    np.testing.assert_array_equal(c_rows[3], c_rows[0])   # seeded from row 0
+    loss = c.train_round(_batch(c.round, 24))
+    assert np.isfinite(loss)
+
+
+@pytest.mark.chaos
+def test_elastic_reform_matches_native_3worker_run_bit_for_bit(tmp_path):
+    """THE elastic acceptance contract: from the re-form point, the
+    elastic continuation (4-worker checkpoint resumed on 3 workers) is
+    bit-for-bit the 3-worker fault-free run from the same consensus
+    state.  The 'native' side resumes a checkpoint REWRITTEN as a
+    genuine 3-worker checkpoint (elastic=False), so the two runs share
+    state but take entirely different resume paths."""
+    import jax
+    d4 = tmp_path / "ck4"
+    a = _make_trainer(d4, batch=24, workers=4, lr=0.005)     # local_sgd, the
+    for r in range(2):                             # re-tier-bearing case
+        a.train_round(_batch(r, 24))
+
+    # elastic side: resume the 4-worker checkpoint on 3 workers
+    b = _make_trainer(d4, seed=99, batch=24, workers=3, lr=0.005, elastic=True)
+    assert b.resumed is not None and b.round == 2
+
+    # native side: rewrite the same state as a true 3-worker checkpoint
+    blob = load_checkpoint(str(d4 / "ckpt_round_00000002.npz"))
+    blob["n_workers"] = np.int64(3)
+    blob["state"] = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[:3] if np.asarray(x).ndim else x,
+        blob["state"])
+    d3 = tmp_path / "ck3"
+    c = _make_trainer(None, seed=7, batch=24, workers=3, lr=0.005)
+    c._apply_blob(blob)
+    c.round = 2
+
+    for r in range(2, 4):                          # the shared continuation
+        lb = b.train_round(_batch(r, 24))
+        lc = c.train_round(_batch(r, 24))
+        assert lb == lc
+    for name in ("conv1", "ip2"):
+        np.testing.assert_array_equal(
+            np.asarray(b.params[name][0]), np.asarray(c.params[name][0]),
+            err_msg=f"elastic re-form diverged from the native 3-worker "
+                    f"run at {name}")
+
+
+# ---------------------------------------------------------------------------
+# numerical-integrity guard (tentpole: never checkpoint poisoned weights)
+# ---------------------------------------------------------------------------
+
+def test_guard_requires_checkpoint_dir():
+    with pytest.raises(ValueError, match="guard_numerics"):
+        _make_trainer(None, guard_numerics=True)
+
+
+@pytest.mark.chaos
+def test_nan_inject_rolls_back_and_matches_fault_free(tmp_path, monkeypatch):
+    """Acceptance: nan_inject at round 2 trips the guard, the poisoned
+    round is dropped, the checkpoint chain stays NaN/Inf-free, and the
+    run converges to the fault-free result EXACTLY (rollback restores
+    params+state+RNG, and the replayed round is clean)."""
+    clean_dir, chaos_dir = tmp_path / "clean", tmp_path / "chaos"
+    clean = _make_trainer(clean_dir, guard_numerics=True)
+    clean_losses = [clean.train_round(_batch(r)) for r in range(4)]
+
+    monkeypatch.setenv("SPARKNET_FAULT", "nan_inject@round:2")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    faults.reset_injector()      # re-arm the once-per-process fault
+    tr = _make_trainer(chaos_dir, guard_numerics=True)
+    losses = []
+    while tr.round < 4:
+        losses.append(tr.train_round(_batch(tr.round)))
+    assert tr.guard_trips == 1
+    assert sum(1 for l in losses if not np.isfinite(l)) == 1  # the dropped one
+    # checkpoint chain: every surviving snapshot is finite
+    for f in sorted(os.listdir(chaos_dir)):
+        if f.endswith(".npz"):
+            blob = load_checkpoint(str(chaos_dir / f))
+            import jax
+            for leaf in jax.tree_util.tree_leaves(blob["params"]):
+                assert np.all(np.isfinite(leaf)), f"NaN survived in {f}"
+    # exact recovery: the fault-free trajectory, bit for bit
+    np.testing.assert_array_equal(np.asarray(tr.params["conv1"][0]),
+                                  np.asarray(clean.params["conv1"][0]))
+    finite = [l for l in losses if np.isfinite(l)]
+    np.testing.assert_allclose(finite, clean_losses, rtol=1e-6)
+
+
+def test_guard_loss_spike_detection(tmp_path):
+    tr = _make_trainer(tmp_path / "ck", guard_numerics=True,
+                       loss_spike_factor=3.0)
+    tr._loss_history = [1.0, 1.1, 0.9]
+    assert tr._poison_reason(10.0) is not None        # 10 > 3 x ~1.0
+    assert tr._poison_reason(2.0) is None
+    assert tr._poison_reason(float("inf")) is not None
+    assert tr._poison_reason(float("nan")) is not None
+
+
+def test_guard_lr_backoff_applies_and_checkpoints(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARKNET_FAULT", "nan_inject@round:1")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    faults.reset_injector()      # re-arm the once-per-process fault
+    d = tmp_path / "ck"
+    tr = _make_trainer(d, guard_numerics=True, guard_lr_backoff=0.5)
+    while tr.round < 3:
+        tr.train_round(_batch(tr.round))
+    assert tr.guard_trips == 1
+    assert tr.lr_scale == pytest.approx(0.5)
+    # the backed-off scale persists through checkpoint/resume
+    monkeypatch.setenv("SPARKNET_FAULT", "")
+    tr2 = _make_trainer(d, seed=99, guard_numerics=True)
+    assert tr2.lr_scale == pytest.approx(0.5)
+
+
+def test_guard_max_trips_raises_training_diverged(tmp_path, monkeypatch):
+    from sparknet_tpu.parallel import TrainingDivergedError
+    monkeypatch.setenv("SPARKNET_FAULT", "nan_inject@round:1")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    faults.reset_injector()      # re-arm the once-per-process fault
+    tr = _make_trainer(tmp_path / "ck", guard_numerics=True,
+                       guard_max_trips=0)
+    tr.train_round(_batch(0))
+    with pytest.raises(TrainingDivergedError, match="guard_max_trips"):
+        tr.train_round(_batch(1))
+
+
+@pytest.mark.chaos
+def test_nan_inject_driver_end_to_end(tmp_path):
+    """The guard through the real driver: a single run (no relaunch —
+    rollback is in-process) absorbs the poison and lands on the
+    fault-free params bit-for-bit."""
+    base, out = str(tmp_path / "base.npz"), str(tmp_path / "chaos.npz")
+    saved = _clean_launch_env()
+    try:
+        from sparknet_tpu.tools.launch import launch_local
+        common = [sys.executable, DRIVER, "--strategy", "sync",
+                  "--local-devices", "4", "--rounds", "4", "--guard"]
+        rc = launch_local(
+            common + ["--out", base, "--ckpt-dir", str(tmp_path / "ck_a")],
+            nprocs=1, platform="cpu", timeout=300)
+        assert rc == 0
+        rc = launch_local(
+            common + ["--out", out, "--ckpt-dir", str(tmp_path / "ck_b")],
+            nprocs=1, platform="cpu", timeout=300,
+            extra_env={"SPARKNET_FAULT": "nan_inject@round:2"})
+        assert rc == 0
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    a, b = np.load(base), np.load(out)
+    assert int(b["__guard_trips__"]) == 1 and int(a["__guard_trips__"]) == 0
+    for k in a.files:
+        if k.startswith("__"):
+            continue
+        assert np.all(np.isfinite(b[k])), f"NaN reached final params at {k}"
+        np.testing.assert_array_equal(
+            a[k], b[k], err_msg=f"guard recovery diverged at {k}")
 
 
 @pytest.mark.chaos

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# The tier-1 gate, exactly as ROADMAP.md specifies it, plus the chaos
+# (fault-injection) subset — one entry point so CI and humans always run
+# the same command.  Usage:
+#   tools/run_tier1.sh            # tier-1 (everything not marked slow)
+#   tools/run_tier1.sh --chaos    # only the chaos marker subset
+#   tools/run_tier1.sh --all      # tier-1, then the chaos subset again
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+run_tier1() {
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+  rc=${PIPESTATUS[0]}
+  echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+  return "$rc"
+}
+
+run_chaos() {
+  timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'chaos and not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+case "${1:-}" in
+  --chaos) run_chaos ;;
+  --all)   run_tier1 && run_chaos ;;
+  "")      run_tier1 ;;
+  *) echo "usage: $0 [--chaos|--all]" >&2; exit 2 ;;
+esac
