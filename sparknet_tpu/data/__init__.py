@@ -1,6 +1,9 @@
 from .partition import PartitionedDataset
 from .minibatch import MinibatchSampler, make_minibatches
-from .prefetch import PrefetchIterator, device_feed
+from .prefetch import FeedStalled, PrefetchIterator, device_feed
+from .integrity import (
+    DataCorruptionError, Quarantine, QuarantineExceeded, QuarantinePolicy,
+)
 from .transforms import (
     center_crop, random_crop_mirror, subtract_mean, compute_mean_image,
 )

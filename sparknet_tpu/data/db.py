@@ -20,7 +20,10 @@ from typing import Any, Iterator
 import numpy as np
 
 from ..proto.caffe_pb import Phase
-from ..proto.wireformat import decode
+from ..proto.wireformat import WireError, decode
+from ..utils import faults
+from ..utils.retry import io_retry
+from .integrity import DataCorruptionError, Quarantine, QuarantinePolicy
 
 
 # ---------------------------------------------------------------------------
@@ -39,10 +42,24 @@ def open_db(source: str, backend: str = "LMDB"):
     raise ValueError(f"unknown DB backend {backend!r}")
 
 
-def datum_to_array(datum_bytes: bytes) -> tuple[np.ndarray, int]:
+def datum_to_array(datum_bytes: bytes, *, key: Any = None,
+                   source: str | None = None) -> tuple[np.ndarray, int]:
     """Serialized Datum -> ((C,H,W) float32, label) (reference:
-    data_transformer.cpp Transform(Datum) input handling)."""
-    m = decode(datum_bytes, "Datum")
+    data_transformer.cpp Transform(Datum) input handling).
+
+    Every malformed input — truncated protobuf, a payload whose byte
+    count contradicts channels×height×width, an undecodable encoded
+    image — raises :class:`~sparknet_tpu.data.integrity.
+    DataCorruptionError` carrying ``key``/``source`` attribution, never
+    an opaque numpy reshape error from three frames down.  ``key`` and
+    ``source`` are context-only (the DB key and DB path in the feed
+    path)."""
+    try:
+        m = decode(datum_bytes, "Datum")
+    except WireError as e:
+        raise DataCorruptionError(
+            f"undecodable Datum bytes ({len(datum_bytes)} bytes): {e}",
+            source=source, key=key) from e
     c = int(m.get("channels", 1))
     h = int(m.get("height", 1))
     w = int(m.get("width", 1))
@@ -53,19 +70,40 @@ def datum_to_array(datum_bytes: bytes) -> tuple[np.ndarray, int]:
             from .. import native
             img = native.decode_jpeg_resize(bytes(data), h, w)
             if img is None:
-                raise ValueError("undecodable encoded Datum")
+                raise DataCorruptionError(
+                    "undecodable encoded Datum", source=source, key=key)
             return img, label
         # natural size: decode without resize
         from io import BytesIO
 
         from PIL import Image
-        im = Image.open(BytesIO(bytes(data))).convert("RGB")
+        try:
+            im = Image.open(BytesIO(bytes(data))).convert("RGB")
+        except Exception as e:
+            raise DataCorruptionError(
+                f"undecodable encoded Datum: {e}",
+                source=source, key=key) from e
         arr = np.asarray(im, np.float32).transpose(2, 0, 1)
         return np.ascontiguousarray(arr), label
+    if c <= 0 or h <= 0 or w <= 0:
+        raise DataCorruptionError(
+            f"impossible Datum geometry channels={c} height={h} width={w}",
+            source=source, key=key)
     if data:
-        arr = np.frombuffer(bytes(data), np.uint8).astype(np.float32)
+        raw = bytes(data)
+        if len(raw) != c * h * w:
+            raise DataCorruptionError(
+                f"Datum payload is {len(raw)} bytes but "
+                f"channels*height*width = {c}*{h}*{w} = {c * h * w}",
+                source=source, key=key)
+        arr = np.frombuffer(raw, np.uint8).astype(np.float32)
         return arr.reshape(c, h, w), label
     floats = [float(v) for v in m.get_all("float_data")]
+    if len(floats) != c * h * w:
+        raise DataCorruptionError(
+            f"Datum float_data has {len(floats)} values but "
+            f"channels*height*width = {c}*{h}*{w} = {c * h * w}",
+            source=source, key=key)
     return np.asarray(floats, np.float32).reshape(c, h, w), label
 
 
@@ -190,10 +228,21 @@ def _cycle_items(reader):
 
 
 def db_feed(lp, phase: Phase, tops: list[str] | None = None,
-            seed: int = 0) -> Iterator[dict[str, np.ndarray]]:
+            seed: int = 0, quarantine: Quarantine | None = None,
+            ) -> Iterator[dict[str, np.ndarray]]:
     """Batch stream for a ``Data`` layer (LMDB/LevelDB backed).  The fast
     path parses the whole batch's Datums in one native call and transforms
-    them vectorized; mixed/encoded batches fall back per record."""
+    them vectorized; mixed/encoded batches fall back per record.
+
+    Every decoded record is validated (decode + geometry against the
+    source's first record); a record that fails is routed through
+    ``quarantine`` — skipped, counted per source, and replaced by the
+    next record, under a bounded per-epoch budget (exceeding it raises
+    ``QuarantineExceeded``).  The default quarantine takes its policy
+    from the SPARKNET_QUARANTINE_FRACTION / _RECORDS env knobs (default:
+    zero tolerance — detected corruption is attributed, not budgeted).
+    Pass an explicit :class:`~sparknet_tpu.data.integrity.Quarantine` to
+    set the policy in code and read ``quarantine.report()`` afterwards."""
     from .. import native
     p = lp.sub("data_param")
     source = str(p.get("source"))
@@ -203,17 +252,55 @@ def db_feed(lp, phase: Phase, tops: list[str] | None = None,
     tf = DataTransformer(lp.sub("transform_param"), phase, seed)
     tops = tops or list(lp.top) or ["data", "label"]
     cursor = _cycle_items(reader)
+    epoch_size = len(reader)
+    if quarantine is None:
+        quarantine = Quarantine(QuarantinePolicy.from_env(),
+                                epoch_size=epoch_size, source=source)
     # peek the first record for the batch-parse geometry
-    first_img, _ = datum_to_array(reader.first()[1])
+    first_img, _ = datum_to_array(reader.first()[1], source=source)
     c, h, w = first_img.shape
     use_native = True  # sticky: one -3/None verdict (e.g. encoded JPEG
     # records) disables the native attempt for this source — no point
     # paying the batch join + output allocation every batch forever
+    injector = faults.get_injector()
+    state = {"seq": 0}   # feed-lifetime record counter (epoch accounting
+    # + the deterministic corrupt_record coin flip)
+
+    def pull() -> tuple[Any, bytes, bool]:
+        """(key, value, injected) for the next record; rolls the
+        quarantine's epoch budget at each full pass over the source."""
+        key, val = next(cursor)
+        seq = state["seq"]
+        state["seq"] += 1
+        if seq and seq % epoch_size == 0:
+            quarantine.start_epoch()
+        if injector.corrupt_record(seq):
+            return key, faults.corrupt_bytes(val, seq), True
+        return key, val, False
+
+    def decode_one(key, val) -> tuple[np.ndarray, int] | None:
+        """Decoded + geometry-validated record, or None after the bad
+        record was quarantined (the caller pulls a replacement)."""
+        try:
+            img, label = datum_to_array(val, key=key, source=source)
+            if img.shape != (c, h, w):
+                raise DataCorruptionError(
+                    f"record shape {img.shape} != source geometry "
+                    f"({c}, {h}, {w})", source=source, key=key)
+        except DataCorruptionError as e:
+            quarantine.admit(e)   # raises QuarantineExceeded past budget
+            return None
+        return img, label
+
     while True:
-        records = [next(cursor)[1] for _ in range(batch)]
-        parsed = native.parse_datum_batch(records, c, h, w) \
-            if use_native else None
-        if parsed is None and use_native:
+        records = [pull() for _ in range(batch)]
+        # injected-corrupt records take the per-record path so the
+        # quarantine sees them; a clean batch keeps the native fast path
+        clean = not any(injected for _, _, injected in records)
+        parsed = native.parse_datum_batch(
+            [val for _, val, _ in records], c, h, w) \
+            if use_native and clean else None
+        if parsed is None and use_native and clean:
             use_native = False
         if parsed is not None:
             imgs, labels = parsed
@@ -223,10 +310,17 @@ def db_feed(lp, phase: Phase, tops: list[str] | None = None,
             yield out
             continue
         imgs_l, labels_l = [], []
-        for val in records:
-            img, label = datum_to_array(val)
-            imgs_l.append(tf(img))
-            labels_l.append(label)
+        for key, val, _ in records:
+            got = decode_one(key, val)
+            if got is not None:
+                imgs_l.append(tf(got[0]))
+                labels_l.append(got[1])
+        while len(imgs_l) < batch:   # replace quarantined records
+            key, val, _ = pull()
+            got = decode_one(key, val)
+            if got is not None:
+                imgs_l.append(tf(got[0]))
+                labels_l.append(got[1])
         yield _pack(tops, imgs_l, labels_l)
 
 
@@ -446,9 +540,15 @@ def read_image_list(source: str, root: str = "") -> list[tuple[str, int]]:
 def load_image(path: str, new_h: int, new_w: int, color: bool) -> np.ndarray:
     """Decode an image file to (C,H,W) float32 0-255; JPEG goes through
     the native libjpeg path (ScaleAndConvert.convertImage force-resize
-    semantics), everything else through PIL."""
-    with open(path, "rb") as f:
-        raw = f.read()
+    semantics), everything else through PIL.  The read retries transient
+    I/O errors at record granularity (SPARKNET_IO_RETRIES/_BACKOFF) — one
+    NFS blip costs one backoff, not the epoch."""
+
+    def read() -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    raw = io_retry(read, describe=f"read {path}")
     if raw[:2] == b"\xff\xd8" and new_h and new_w:
         from .. import native
         img = native.decode_jpeg_resize(raw, new_h, new_w)

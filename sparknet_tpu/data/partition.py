@@ -61,6 +61,28 @@ class PartitionedDataset:
                        ) -> "PartitionedDataset":
         return PartitionedDataset([fn(list(p)) for p in self.partitions])
 
+    def quarantine_map(self, fn: Callable[[Any], Any],
+                       quarantine) -> "PartitionedDataset":
+        """:meth:`map`, but a record whose ``fn`` raises
+        ``DataCorruptionError`` is routed through ``quarantine`` (a
+        ``data.integrity.Quarantine``): skipped and counted under
+        ``partition:<i>``, within the quarantine's bounded budget
+        (exceeding it raises ``QuarantineExceeded``).  This is the
+        decode-with-accounting analog of the reference's silent
+        undecodable-image drop (ScaleAndConvert.scala:23-25) — the same
+        forward progress, but every drop is attributed and bounded."""
+        from .integrity import DataCorruptionError
+        parts: list[list[Any]] = []
+        for pi, p in enumerate(self.partitions):
+            out = []
+            for rec in p:
+                try:
+                    out.append(fn(rec))
+                except DataCorruptionError as e:
+                    quarantine.admit(e, source=f"partition:{pi}")
+            parts.append(out)
+        return PartitionedDataset(parts)
+
     def coalesce(self, n: int) -> "PartitionedDataset":
         flat = [x for p in self.partitions for x in p]
         return PartitionedDataset.from_items(flat, n)

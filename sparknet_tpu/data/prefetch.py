@@ -1,4 +1,4 @@
-"""Background prefetch + async device transfer.
+"""Background prefetch + async device transfer, with a feeder watchdog.
 
 The reference's JavaData feed path is fully synchronous — every minibatch
 blocks the solver on a C→JVM callback, a CPU float copy, and a lazy CPU→GPU
@@ -11,11 +11,25 @@ util/blocking_queue.cpp) is bypassed by that path.
 Here we implement the double-buffering the reference lost: a daemon thread
 runs the host preprocessing and starts the host→HBM ``device_put`` ahead of
 time, so the TPU step overlaps with the feed — `device_feed` is the
-JavaDataLayer replacement."""
+JavaDataLayer replacement.
+
+Watchdog: Caffe's InternalThread has the same blind spot Spark's stage
+supervision has — a prefetch thread that dies silently (or blocks forever
+in a read) leaves the solver waiting on an empty BlockingQueue until some
+outer timeout kills the whole job as a "straggler".  Here the consumer
+never blocks unboundedly: every wait is a short poll that checks feeder
+liveness (thread death AND, with ``stall_timeout``, hang), a failed feeder
+is restarted once (it re-attaches to the same source iterator — fault
+hooks and real pre-pull failures lose no records), and a feed that is
+still dead after the restart raises :class:`FeedStalled` AFTER publishing
+a ``feed_stalled`` heartbeat — so the supervisor's straggler monitor sees
+a live rank whose *feed* is the culprit, not a silent rank to kill."""
 
 from __future__ import annotations
 
+import os
 import queue
+import sys
 import threading
 import time
 from typing import Any, Callable, Iterator, Mapping
@@ -23,6 +37,13 @@ from typing import Any, Callable, Iterator, Mapping
 import jax
 
 from ..utils import faults
+
+
+class FeedStalled(RuntimeError):
+    """The prefetch feeder stopped producing (thread death or a stall past
+    the timeout) and the one-shot restart did not bring it back.  By the
+    time this raises, a ``feed_stalled`` heartbeat has been published (if
+    the health plane is on), attributing the stall to the feed."""
 
 
 class PrefetchIterator:
@@ -33,46 +54,132 @@ class PrefetchIterator:
     otherwise stay blocked on the full queue holding device memory for the
     rest of the process (the explicit lifecycle Caffe's InternalThread
     gives its prefetch thread; reference: internal_thread.hpp:29-42).
-    Usable as a context manager."""
+    Usable as a context manager.
+
+    Watchdog knobs:
+
+    - ``stall_timeout`` — seconds the consumer will wait for a batch
+      before declaring the feeder hung (None: no hang deadline, but a
+      *dead* feeder thread is still detected by the liveness poll).
+      Defaults from ``SPARKNET_FEED_STALL_S`` when unset.  Set it above
+      the worst healthy batch latency.
+    - ``restarts`` — how many times a dead/hung feeder is restarted
+      before :class:`FeedStalled` (default 1: the one-shot restart).
+      A restarted feeder re-attaches to the same source iterator under a
+      lock, and a superseded feeder never touches the source again — a
+      hang between pulls therefore loses no records.
+    """
 
     _SENTINEL = object()
 
     def __init__(self, it: Iterator[Any], depth: int = 2,
-                 transform: Callable[[Any], Any] | None = None):
+                 transform: Callable[[Any], Any] | None = None,
+                 stall_timeout: float | None = None, restarts: int = 1):
+        self._source = iter(it)
+        self._transform = transform
         self._q: queue.Queue[Any] = queue.Queue(maxsize=depth)
         self._err: BaseException | None = None
         self._stop = threading.Event()
         self._done = False
+        # _gen_lock guards the generation counter and every source pull:
+        # only the CURRENT generation's feeder may advance the iterator,
+        # so an abandoned (hung) feeder that wakes up late exits without
+        # consuming — the restart is lossless
+        self._gen_lock = threading.Lock()
+        self._generation = 0
+        self._restarts_left = int(restarts)
+        self._produced = 0    # records pulled from the source (feeder side)
+        self._delivered = 0   # batches handed to the consumer
+        if stall_timeout is None:
+            env = os.environ.get("SPARKNET_FEED_STALL_S", "")
+            stall_timeout = float(env) if env else None
+        self._stall_timeout = stall_timeout
         # chaos hook: SPARKNET_FAULT=slow_feed:<dur> models a degraded
         # input pipeline by delaying every produced batch (utils.faults)
-        feed_delay = faults.get_injector().feed_delay()
+        self._feed_delay = faults.get_injector().feed_delay()
+        self._threads: list[threading.Thread] = []
+        self._spawn()
 
-        def put(item: Any) -> bool:
-            while not self._stop.is_set():
-                try:
-                    self._q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+    # -- feeder side ------------------------------------------------------
+    def _current(self, gen: int) -> bool:
+        return not self._stop.is_set() and gen == self._generation
 
-        def run() -> None:
+    def _spawn(self) -> None:
+        gen = self._generation
+        t = threading.Thread(target=self._run, args=(gen,), daemon=True)
+        self._thread = t              # the live feeder (tests poke this)
+        self._threads.append(t)
+        t.start()
+
+    def _put(self, item: Any, gen: int) -> bool:
+        while self._current(gen):
             try:
-                for item in it:
-                    if self._stop.is_set():
-                        return
-                    if feed_delay:
-                        time.sleep(feed_delay)
-                    if not put(transform(item) if transform else item):
-                        return
-            except BaseException as e:  # surfaced on next()
-                self._err = e
-            finally:
-                put(self._SENTINEL)
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
+    def _run(self, gen: int) -> None:
+        injector = faults.get_injector()
+        try:
+            while self._current(gen):
+                # chaos hooks fire BEFORE the pull, so neither a die nor
+                # a hang ever strands a pulled-but-unqueued record
+                ev = injector.feeder_event(self._produced)
+                if ev is not None:
+                    kind, dur = ev
+                    if kind == "die":
+                        return      # silent thread death: no sentinel
+                    time.sleep(dur)  # hang; loop re-checks the generation
+                    continue
+                with self._gen_lock:
+                    if not self._current(gen):
+                        return
+                    try:
+                        item = next(self._source)
+                        self._produced += 1
+                    except StopIteration:
+                        item = self._SENTINEL
+                if item is self._SENTINEL:
+                    self._put(item, gen)
+                    return
+                if self._feed_delay:
+                    time.sleep(self._feed_delay)
+                out = self._transform(item) if self._transform else item
+                if not self._put(out, gen):
+                    return
+        except BaseException as e:  # surfaced on next()
+            self._err = e
+            self._put(self._SENTINEL, gen)
 
+    # -- watchdog ---------------------------------------------------------
+    def _revive(self, reason: str) -> None:
+        """Restart the feeder, or raise FeedStalled once the budget is
+        spent.  The generation bump invalidates the old feeder either
+        way — it can never race the replacement on the source."""
+        with self._gen_lock:
+            self._generation += 1
+            spent = self._restarts_left <= 0
+            if not spent:
+                self._restarts_left -= 1
+        if spent:
+            self._done = True
+            self._err = FeedStalled(
+                f"prefetch feed stalled after {self._delivered} delivered "
+                f"batches: {reason} (restart budget spent)")
+            # attribution on the health plane: the consumer is ALIVE and
+            # names the feed as the culprit — the straggler monitor must
+            # not read this rank's silence as a hung worker
+            from ..parallel import health
+            health.maybe_beat(self._delivered, "feed_stalled")
+            raise self._err
+        print(f"prefetch: {reason}; restarting feeder "
+              f"({self._restarts_left} restarts left)",
+              file=sys.stderr, flush=True)
+        self._spawn()
+
+    # -- consumer side ----------------------------------------------------
     def __iter__(self) -> "PrefetchIterator":
         return self
 
@@ -81,16 +188,40 @@ class PrefetchIterator:
             if self._err is not None:
                 raise self._err
             raise StopIteration
-        item = self._q.get()
-        if item is self._SENTINEL:
-            self._done = True
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        return item
+        deadline = (time.monotonic() + self._stall_timeout
+                    if self._stall_timeout is not None else None)
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration
+                if not self._thread.is_alive() and self._q.empty():
+                    if self._err is not None:
+                        # feeder errored but its sentinel was lost
+                        self._done = True
+                        raise self._err
+                    self._revive("feeder thread died without finishing "
+                                 "its source")
+                    deadline = (time.monotonic() + self._stall_timeout
+                                if self._stall_timeout is not None else None)
+                elif deadline is not None and time.monotonic() > deadline:
+                    self._revive(f"no batch within the "
+                                 f"{self._stall_timeout:g}s stall timeout")
+                    deadline = time.monotonic() + self._stall_timeout
+                continue
+            if item is self._SENTINEL:
+                self._done = True
+                if self._err is not None:
+                    raise self._err
+                raise StopIteration
+            self._delivered += 1
+            return item
 
     def close(self) -> None:
-        """Stop the producer and release staged items."""
+        """Stop the producer (every generation of it) and release staged
+        items.  Safe to call concurrently with a watchdog restart: the
+        stop event gates both the old and the freshly-spawned feeder."""
         self._stop.set()
         self._done = True
         while True:
@@ -98,7 +229,8 @@ class PrefetchIterator:
                 self._q.get_nowait()
             except queue.Empty:
                 break
-        self._thread.join(timeout=5.0)
+        for t in self._threads:
+            t.join(timeout=5.0)
 
     def __enter__(self) -> "PrefetchIterator":
         return self
@@ -108,10 +240,13 @@ class PrefetchIterator:
 
 
 def device_feed(batches: Iterator[Mapping[str, Any]], depth: int = 2,
-                sharding: Any | None = None) -> Iterator[dict[str, jax.Array]]:
+                sharding: Any | None = None,
+                stall_timeout: float | None = None,
+                restarts: int = 1) -> Iterator[dict[str, jax.Array]]:
     """Prefetch host batches and issue async ``device_put`` ahead of
     consumption — data is in HBM (with the requested sharding) by the time
-    the train step asks for it."""
+    the train step asks for it.  ``stall_timeout``/``restarts`` are the
+    feeder watchdog knobs (see :class:`PrefetchIterator`)."""
 
     def put(batch: Mapping[str, Any]) -> dict[str, jax.Array]:
         if sharding is None:
@@ -119,4 +254,5 @@ def device_feed(batches: Iterator[Mapping[str, Any]], depth: int = 2,
         from ..parallel.mesh import stage_local
         return {k: stage_local(v, sharding) for k, v in batch.items()}
 
-    return PrefetchIterator(batches, depth=depth, transform=put)
+    return PrefetchIterator(batches, depth=depth, transform=put,
+                            stall_timeout=stall_timeout, restarts=restarts)

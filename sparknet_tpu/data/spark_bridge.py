@@ -32,9 +32,12 @@ def _spill_partitions(rdd: Any, spill_dir: str,
                       transform: Callable[[Any], Any] | None,
                       ) -> list[tuple[int, int]]:
     """Write each partition executor-side (task-local, like
-    foreachPartition); only (index, count) metadata returns to the
+    foreachPartition); only (index, count, crc32) metadata returns to the
     driver.  An existing spill (``_meta.json`` present) is reused so
-    every host of a multi-process run shares ONE spill pass."""
+    every host of a multi-process run shares ONE spill pass.  The per-
+    file crc32 is the read-side integrity check: a spill that rots on
+    the shared filesystem is detected at read time (``_read_spill``), not
+    fed into training as garbage pickles."""
     import json
     import os
     meta_path = os.path.join(spill_dir, "_meta.json")
@@ -54,34 +57,78 @@ def _spill_partitions(rdd: Any, spill_dir: str,
     def spill(i: int, it: Iterable[Any]):
         import os
         import pickle
+        import zlib
         n = 0
+        crc = 0
         tmp = _spill_path(spill_dir, i) + ".tmp"
         with open(tmp, "wb") as f:
             for rec in it:
-                pickle.dump(transform(rec) if transform else rec, f,
-                            protocol=pickle.HIGHEST_PROTOCOL)
+                blob = pickle.dumps(transform(rec) if transform else rec,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                crc = zlib.crc32(blob, crc)
+                f.write(blob)
                 n += 1
         os.replace(tmp, _spill_path(spill_dir, i))  # atomic publish
-        return [(i, n)]
+        return [(i, n, crc & 0xFFFFFFFF)]
 
     meta = list(rdd.mapPartitionsWithIndex(spill).collect())
     tmp = meta_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"num_partitions": n_parts,
-                   "counts": [[int(i), int(n)] for i, n in meta]}, f)
+                   "counts": [[int(i), int(n)] for i, n, _ in meta],
+                   "crc32": {str(int(i)): int(c) for i, _, c in meta}}, f)
     os.replace(tmp, meta_path)
-    return meta
+    return [(i, n) for i, n, _ in meta]
 
 
-def _read_spill(spill_dir: str, index: int) -> list[Any]:
+def _read_spill(spill_dir: str, index: int,
+                expect_crc: int | None = None) -> list[Any]:
+    """Read one spilled partition back, retrying transient I/O at file
+    granularity and verifying the spill-time crc32 when known; a durable
+    mismatch raises ``DataCorruptionError`` naming the partition file."""
+    import os
     import pickle
+    import zlib
+
+    from ..utils.retry import io_retry
+    from .integrity import DataCorruptionError
+    path = _spill_path(spill_dir, index)
+
+    def read() -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    raw = io_retry(read, describe=f"read spill {os.path.basename(path)}")
+    if expect_crc is not None:
+        got = zlib.crc32(raw) & 0xFFFFFFFF
+        if got != expect_crc:
+            raise DataCorruptionError(
+                f"spilled partition failed its crc32 "
+                f"({got:#010x} != {expect_crc:#010x}, {len(raw)} bytes) — "
+                f"the spill rotted on the shared filesystem; clear "
+                f"{spill_dir!r} and re-spill", source=path, key=index)
     out = []
-    with open(_spill_path(spill_dir, index), "rb") as f:
-        while True:
-            try:
-                out.append(pickle.load(f))
-            except EOFError:
-                return out
+    import io as _io
+    f = _io.BytesIO(raw)
+    while True:
+        try:
+            out.append(pickle.load(f))
+        except EOFError:
+            return out
+
+
+def _spill_crcs(spill_dir: str) -> dict[int, int]:
+    """The per-partition crc32 index of an existing spill ({} for spills
+    written before checksums existed — those read unverified)."""
+    import json
+    import os
+    meta_path = os.path.join(spill_dir, "_meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        return {int(i): int(c) for i, c in meta.get("crc32", {}).items()}
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
 
 
 def _require_rdd(rdd: Any) -> None:
@@ -180,9 +227,10 @@ class SparkPartitionBridge:
 
         if spill_dir is not None:
             meta = dict(_spill_partitions(self.rdd, spill_dir, transform))
+            crcs = _spill_crcs(spill_dir)
             parts = []
             for i in sorted(owned):
-                parts.append(_read_spill(spill_dir, i)
+                parts.append(_read_spill(spill_dir, i, crcs.get(i))
                              if meta.get(i, 0) else [])
             return PartitionedDataset(parts)
 

@@ -45,6 +45,9 @@ class Heartbeat:
     rank: int
     round: int
     phase: str          # "init" | "round_start" | "round_end" | "final"
+                        # | "feed_stalled" (the prefetch watchdog's
+                        # attribution beat: the worker is ALIVE, its data
+                        # feed is the culprit — see data.prefetch)
     time: float         # writer's epoch seconds
     pid: int
     attempt: int

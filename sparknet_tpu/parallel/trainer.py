@@ -134,6 +134,24 @@ class TrainerConfig:
     # traced input of the compiled round — changing it never recompiles.
     guard_lr_backoff: float = 1.0
     guard_max_trips: int = 3
+    # Cross-replica parameter audit: every ``audit_every`` rounds (0 =
+    # off), BEFORE the round runs, each replica computes a cheap
+    # fingerprint of its resident parameter copy (uint32 bitcast
+    # tree-sum — one fused pass, one all_gather) and the mesh compares.
+    # Replicated params are an *invariant the hardware can silently
+    # break* (a flipped HBM bit, a diverged host): a mismatch means some
+    # replica's copy rotted since the last audit, and the next averaging
+    # collective would fold it into the master weights forever.  On
+    # mismatch the trainer takes the guard's rollback path to the newest
+    # checkpoint at or before the last PASSED audit (params/state/iter/
+    # RNG restored — the replay is exact and, with one-shot faults,
+    # clean), so a bit flip costs at most one audit interval.  Requires
+    # ``checkpoint_dir``; shares ``guard_max_trips``.  Note: local_sgd
+    # re-averages params every round boundary, which folds (hides) a
+    # flip at the next boundary — audit_every=1 is the right cadence
+    # there; sync/hierarchical keep per-replica divergence resident, so
+    # a coarser cadence still detects.
+    audit_every: int = 0
 
 
 class TrainingDivergedError(RuntimeError):
@@ -264,6 +282,12 @@ class DistributedTrainer:
         self.guard_trips = 0
         self._loss_history: list[float] = []
         self._finite_check = None
+        # -- cross-replica audit state: compiled fingerprint fn, trip
+        # count, and the newest round whose audit PASSED (the rollback
+        # horizon — checkpoints at or before it are divergence-free)
+        self.audit_trips = 0
+        self._audit_fn = None
+        self._last_audit_ok = 0
         if self.config.checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got "
@@ -272,11 +296,30 @@ class DistributedTrainer:
             raise ValueError(
                 "guard_numerics needs checkpoint_dir — rollback is the "
                 "guard's only recovery action")
+        if self.config.audit_every < 0:
+            raise ValueError(
+                f"audit_every must be >= 0, got {self.config.audit_every}")
+        if self.config.audit_every:
+            if not self.config.checkpoint_dir:
+                raise ValueError(
+                    "audit_every needs checkpoint_dir — rollback is the "
+                    "audit's only recovery action")
+            horizon = (self.config.checkpoint_every
+                       * max(self.config.checkpoint_keep - 1, 0))
+            if horizon < self.config.audit_every:
+                raise ValueError(
+                    f"audit_every={self.config.audit_every} outruns the "
+                    f"checkpoint retention (checkpoint_every="
+                    f"{self.config.checkpoint_every} x (checkpoint_keep="
+                    f"{self.config.checkpoint_keep} - 1) = {horizon} "
+                    f"rounds): by the time a mismatch is detected, every "
+                    f"pre-divergence checkpoint may be pruned")
         if self.config.checkpoint_dir:
             self.resumed = self.resume_latest(self.config.checkpoint_dir)
-            if self.config.guard_numerics and self.resumed is None:
-                # baseline snapshot: the guard can always roll back, even
-                # when the very first round is the poisoned one
+            if ((self.config.guard_numerics or self.config.audit_every)
+                    and self.resumed is None):
+                # baseline snapshot: the guard/audit can always roll
+                # back, even when the very first round is the poisoned one
                 self.save_round_checkpoint()
         from . import health
         health.maybe_beat(self.round, "init")
@@ -466,6 +509,24 @@ class DistributedTrainer:
                     f"{local_workers} local workers")
         round_idx = self.round
         health.maybe_beat(round_idx, "round_start")
+        # deterministic chaos hook: rot one replica's resident param copy
+        # (a flipped HBM bit between rounds — the event the audit exists
+        # to catch before the next averaging folds it in)
+        flip = faults.get_injector().bitflip_rank(round_idx)
+        if flip is not None:
+            print(f"FAULT: bitflip_params corrupting replica {flip}'s "
+                  f"params at round {round_idx}", file=sys.stderr,
+                  flush=True)
+            self._inject_bitflip(flip)
+        if (self.config.audit_every
+                and round_idx % self.config.audit_every == 0):
+            fps = self.audit_params()
+            if np.unique(fps).size > 1:
+                # round dropped BEFORE it runs; self.round rewinds to the
+                # rollback point, so a while-trainer.round driver replays
+                self._audit_trip(round_idx, fps)
+                return float("nan")
+            self._last_audit_ok = round_idx
         # deterministic chaos hook: poison THIS rank's feed with NaNs (the
         # guard must catch the poison after averaging, no matter which
         # rank produced it — exactly a flaky-HBM / bad-DMA event)
@@ -562,6 +623,98 @@ class DistributedTrainer:
             self.lr_scale *= self.config.guard_lr_backoff
             print(f"guard: LR scale backed off to {self.lr_scale:g}",
                   file=sys.stderr, flush=True)
+
+    # -- cross-replica parameter audit (see TrainerConfig.audit_every) ----
+    def _build_audit(self):
+        """Compile the fingerprint collective: each replica bit-casts its
+        float param leaves to uint32 and tree-sums them (mod 2**32 — any
+        single flipped bit changes the sum), then one all_gather over the
+        batch axes returns every replica's fingerprint, replicated, so
+        all processes reach the same verdict without extra traffic."""
+        axes = self._batch_axes
+
+        def fingerprint(params):
+            total = jnp.zeros((), jnp.uint32)
+            for leaf in jax.tree_util.tree_leaves(params):
+                if jnp.issubdtype(leaf.dtype, jnp.floating):
+                    f32 = (leaf if leaf.dtype == jnp.float32
+                           else leaf.astype(jnp.float32))
+                    bits = lax.bitcast_convert_type(f32, jnp.uint32)
+                elif jnp.issubdtype(leaf.dtype, jnp.integer):
+                    bits = leaf.astype(jnp.uint32)
+                else:
+                    continue
+                total = total + jnp.sum(bits, dtype=jnp.uint32)
+            return lax.all_gather(total, axes).reshape(-1)
+
+        mapped = shard_map(fingerprint, mesh=self.mesh, in_specs=(P(),),
+                           out_specs=P(), **_SM_NOCHECK)
+        return jax.jit(mapped)
+
+    def audit_params(self) -> np.ndarray:
+        """Per-replica parameter fingerprints, one uint32 per mesh
+        position (replicas of a healthy mesh all return the same value —
+        the replication invariant, made checkable)."""
+        if self._audit_fn is None:
+            self._audit_fn = self._build_audit()
+        return np.asarray(self._audit_fn(self.params))
+
+    def _audit_trip(self, round_idx: int, fps: np.ndarray) -> None:
+        """A replica's params diverged: roll back to the newest
+        checkpoint at or before the last PASSED audit (that state was
+        verified consistent; anything newer may carry the rot) — the
+        guard's rollback path, RNG replay and all."""
+        self.audit_trips += 1
+        self.guard_trips += 1
+        vals, counts = np.unique(fps, return_counts=True)
+        majority = vals[int(np.argmax(counts))]
+        culprits = [i for i, f in enumerate(fps) if f != majority]
+        print(f"audit: round {round_idx} REJECTED — cross-replica param "
+              f"fingerprints diverge (replicas {culprits} vs the "
+              f"majority: {[hex(int(f)) for f in fps]}); rolling back to "
+              f"a round <= {self._last_audit_ok} checkpoint "
+              f"(trip {self.guard_trips}/{self.config.guard_max_trips})",
+              file=sys.stderr, flush=True)
+        if self.guard_trips > self.config.guard_max_trips:
+            raise TrainingDivergedError(
+                f"audit tripped at round {round_idx} and the trip budget "
+                f"is spent ({self.guard_trips} > guard_max_trips="
+                f"{self.config.guard_max_trips}); replicas {culprits} "
+                f"keep diverging")
+        manifest = self.resume_latest(self.config.checkpoint_dir,
+                                      max_round=self._last_audit_ok)
+        if manifest is None:
+            raise TrainingDivergedError(
+                f"round {round_idx}: replicas {culprits} diverged and no "
+                f"checkpoint at round <= {self._last_audit_ok} remains "
+                f"in {self.config.checkpoint_dir!r}")
+
+    def _inject_bitflip(self, replica: int) -> None:
+        """Chaos hook (``bitflip_params@rank:R@round:N``): flip one
+        mantissa bit in replica ``replica``'s resident copy of the first
+        non-empty param leaf — the replicas now disagree by one bit,
+        exactly what a flaky HBM cell produces.  The flipped value stays
+        finite, so the numerical guard can NOT catch it; only the audit
+        can.  Multi-host: each process flips only the shard it owns."""
+        target = tuple(self.mesh.devices.flat)[replica % self.n_workers]
+        leaf = None
+        for name in sorted(self.params):
+            blobs = self.params[name]
+            if blobs and blobs[0].size and blobs[0].dtype == jnp.float32:
+                leaf = blobs[0]
+                break
+        if leaf is None:
+            return
+        arrays = []
+        for shard in leaf.addressable_shards:
+            data = np.asarray(shard.data)
+            if shard.device == target:
+                data = np.array(data)       # writable copy
+                flat = data.reshape(-1).view(np.uint32)
+                flat[0] ^= np.uint32(1 << 22)
+            arrays.append(jax.device_put(data, shard.device))
+        self.params[name][0] = jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, arrays)
 
     def test(self, feed: Iterator[Mapping[str, Any]], num_steps: int,
              ) -> dict[str, Any]:
@@ -815,18 +968,23 @@ class DistributedTrainer:
             except OSError:
                 pass
 
-    def resume_latest(self, directory: str) -> dict[str, Any] | None:
+    def resume_latest(self, directory: str,
+                      max_round: int | None = None) -> dict[str, Any] | None:
         """Restore from the newest manifest whose checkpoint validates
         (file sha256 against the manifest, then the in-file content
         checksum).  Corrupt or partial snapshots are skipped with a
         warning, falling back to the next-older manifest; a checkpoint
         from an INCOMPATIBLE config (strategy/mesh mismatch) raises — that
-        is a config error, not corruption.  Returns the manifest resumed
-        from, or None when no valid checkpoint exists."""
+        is a config error, not corruption.  ``max_round`` bounds the
+        search (the audit's rollback horizon: newer checkpoints may carry
+        an unverified divergence).  Returns the manifest resumed from, or
+        None when no valid checkpoint exists."""
         from ..utils.checkpoint import CheckpointError, load_checkpoint
         for mpath in sorted(
                 glob.glob(os.path.join(directory, "manifest_*.json")),
                 key=_manifest_round, reverse=True):
+            if max_round is not None and _manifest_round(mpath) > max_round:
+                continue
             try:
                 with open(mpath) as f:
                     manifest = json.load(f)
@@ -857,6 +1015,9 @@ class DistributedTrainer:
             self._apply_blob(blob)
             self.round = int(manifest.get("round", self.round))
             self.data_cursor = manifest.get("data_cursor")
+            # the restore re-broadcasts params to every replica, so the
+            # mesh is consistent by construction from here
+            self._last_audit_ok = self.round
             print(f"resume: restored round {self.round} "
                   f"(iter {self.iter}) from "
                   f"{os.path.basename(manifest['file'])}",

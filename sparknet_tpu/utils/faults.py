@@ -25,14 +25,35 @@ first-class, deterministic test input.  Faults are described by the
                                 N: after the npz is durable but BEFORE the
                                 manifest (the worst torn-write window —
                                 resume must skip the orphan)
+              | corrupt_record — arg = probability p in (0, 1]: flip bytes
+                                in (and truncate) p·100% of the records a
+                                DB feed decodes — rotting storage; the
+                                quarantine layer must skip-and-count
+              | feeder_die    — the prefetch feeder thread dies silently
+                                (no error, no sentinel) before producing
+                                batch N — the watchdog must detect the
+                                dead thread and restart it once
+              | feeder_hang   — arg = duration: the feeder blocks that
+                                long before producing batch N (a stuck
+                                read; the watchdog's stall timeout must
+                                fire, not the job timeout)
+              | bitflip_params — flip one mantissa bit in REPLICA R's
+                                resident copy of the params at the start
+                                of round N (@rank names the replica, not
+                                the process — a flaky-HBM event; the
+                                cross-replica audit must catch it before
+                                the next averaging folds it in)
 
 Scoping:
   @round:N   — fire at round N (required for crash/hang/straggle/
-               nan_inject/crash_in_ckpt; for corrupt_ckpt it names the
-               checkpointed round; optional for perma_crash — default
-               every round; slow_feed ignores it)
+               nan_inject/crash_in_ckpt/bitflip_params; for feeder_die/
+               feeder_hang N is the prefetch BATCH index; for
+               corrupt_ckpt it names the checkpointed round; optional
+               for perma_crash — default every round; slow_feed and
+               corrupt_record ignore it)
   @rank:R    — only on process R (default: every rank; REQUIRED for
-               perma_crash)
+               perma_crash; for bitflip_params R names the target
+               REPLICA on the mesh, not the process)
   @attempt:A — only on job attempt A.  The ResilientRunner stamps every
                (re)launch with SPARKNET_FAULT_ATTEMPT; crash / hang /
                straggle / corrupt_ckpt / crash_in_ckpt / nan_inject
@@ -43,14 +64,16 @@ Scoping:
                (they model degradation and permanent loss, not a
                transient death).
 
-nan_inject additionally fires at most once per process even without a
-restart: the guard's in-process rollback replays the same round index,
-and the replay must run clean (the deterministic replacement for "the
-cosmic ray does not strike twice").
+nan_inject, bitflip_params, feeder_die, and feeder_hang additionally fire
+at most once per process even without a restart: the guard/audit rollback
+replays the same round index (and the restarted feeder replays the same
+batch index), and the replay must run clean (the deterministic
+replacement for "the cosmic ray does not strike twice").
 
 Hook points: ``FaultInjector.on_round`` in training drivers,
-``feed_delay`` in ``data.prefetch.PrefetchIterator``, and
-``nan_inject`` / ``corrupt_checkpoint`` / ``on_checkpoint_write`` in
+``feed_delay`` / ``feeder_event`` in ``data.prefetch.PrefetchIterator``,
+``corrupt_record`` in ``data.db.db_feed``, and ``nan_inject`` /
+``bitflip_rank`` / ``corrupt_checkpoint`` / ``on_checkpoint_write`` in
 ``parallel.trainer.DistributedTrainer``.
 """
 
@@ -60,17 +83,23 @@ import dataclasses
 import os
 import sys
 import time
+import zlib
 from typing import Callable, Mapping
 
 KINDS = ("crash", "perma_crash", "hang", "straggle", "slow_feed",
-         "nan_inject", "corrupt_ckpt", "crash_in_ckpt")
+         "nan_inject", "corrupt_ckpt", "crash_in_ckpt", "corrupt_record",
+         "feeder_die", "feeder_hang", "bitflip_params")
 
 # kinds that keep firing on every job attempt unless @attempt pins one
-_EVERY_ATTEMPT = ("slow_feed", "perma_crash")
+_EVERY_ATTEMPT = ("slow_feed", "perma_crash", "corrupt_record")
 # kinds whose ':' arg is a duration
-_DURATION_ARG = ("slow_feed", "straggle")
-# kinds that must name a round
-_NEED_ROUND = ("crash", "hang", "straggle", "nan_inject", "crash_in_ckpt")
+_DURATION_ARG = ("slow_feed", "straggle", "feeder_hang")
+# kinds whose ':' arg is a probability in (0, 1]
+_PROB_ARG = ("corrupt_record",)
+# kinds that must name a round (for feeder_* the "round" is the batch
+# sequence index the prefetch feeder is about to produce)
+_NEED_ROUND = ("crash", "hang", "straggle", "nan_inject", "crash_in_ckpt",
+               "feeder_die", "feeder_hang", "bitflip_params")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,7 +108,8 @@ class FaultSpec:
     round: int | None = None
     rank: int | None = None
     attempt: int | None = None     # None => kind-specific default (see doc)
-    delay_s: float = 0.0           # slow_feed / straggle only
+    delay_s: float = 0.0           # slow_feed / straggle / feeder_hang only
+    prob: float = 0.0              # corrupt_record only
 
 
 def _parse_duration(text: str) -> float:
@@ -110,10 +140,24 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
             raise ValueError(f"unknown fault kind {kind!r} in {raw!r} "
                              f"(known: {', '.join(KINDS)})")
         delay = 0.0
+        prob = 0.0
         if kind in _DURATION_ARG:
             if not arg:
                 raise ValueError(f"{kind} needs a duration arg in {raw!r}")
             delay = _parse_duration(arg)
+        elif kind in _PROB_ARG:
+            if not arg:
+                raise ValueError(
+                    f"{kind} needs a probability arg in {raw!r}")
+            try:
+                prob = float(arg)
+            except ValueError:
+                raise ValueError(
+                    f"bad probability {arg!r} in {raw!r}") from None
+            if not 0.0 < prob <= 1.0:
+                raise ValueError(
+                    f"{kind} probability must be in (0, 1], got {prob} "
+                    f"({raw!r})")
         elif arg:
             raise ValueError(f"{kind} takes no ':' arg (got {raw!r})")
         fields: dict[str, int] = {}
@@ -134,10 +178,15 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
             raise ValueError(
                 f"perma_crash needs @rank:R ({raw!r}) — a rankless "
                 f"permanent crash means no survivor set to re-form with")
+        if kind == "bitflip_params" and "rank" not in fields:
+            raise ValueError(
+                f"bitflip_params needs @rank:R ({raw!r}) — it must name "
+                f"WHICH replica's resident copy rots, or the audit has "
+                f"nothing to disagree about")
         specs.append(FaultSpec(kind=kind, round=fields.get("round"),
                                rank=fields.get("rank"),
                                attempt=fields.get("attempt"),
-                               delay_s=delay))
+                               delay_s=delay, prob=prob))
     return tuple(specs)
 
 
@@ -247,6 +296,58 @@ class FaultInjector:
             self._exit(43)
             return  # only reached with a test-injected _exit
 
+    def corrupt_record(self, seq: int, rank: int | None = None) -> bool:
+        """True when decoded record number ``seq`` (a feed-lifetime
+        sequence counter) should be handed corrupted bytes.  The choice is
+        a pure function of ``seq`` so a restarted feed re-corrupts the
+        SAME records — corruption on disk does not move around."""
+        for spec in self.specs:
+            if spec.kind != "corrupt_record" or not self._active(spec, rank):
+                continue
+            # deterministic per-record coin flip at probability spec.prob
+            h = zlib.crc32(f"corrupt_record:{seq}".encode()) & 0xFFFFFFFF
+            if h < spec.prob * 2**32:
+                return True
+        return False
+
+    def feeder_event(self, batch_idx: int,
+                     rank: int | None = None) -> tuple[str, float] | None:
+        """("die", 0) / ("hang", duration) when the prefetch feeder should
+        fail before producing batch ``batch_idx``, else None.  Fires at
+        most once per process per spec: the watchdog's one-shot feeder
+        restart replays the same batch index and must run clean."""
+        for spec in self.specs:
+            if (spec.kind not in ("feeder_die", "feeder_hang")
+                    or spec.round != batch_idx or spec in self._fired
+                    or not self._active(spec, rank)):
+                continue
+            self._fired.add(spec)
+            who = self.rank if rank is None else rank
+            print(f"FAULT: {spec.kind} before batch {batch_idx} on rank "
+                  f"{who} (attempt {self.attempt})", file=sys.stderr,
+                  flush=True)
+            if spec.kind == "feeder_die":
+                return ("die", 0.0)
+            return ("hang", spec.delay_s)
+
+    def bitflip_rank(self, round_idx: int) -> int | None:
+        """The replica index whose resident params should get a bit
+        flipped at the start of round ``round_idx``, or None.  NOTE:
+        unlike every other kind, @rank names the target REPLICA (mesh
+        position), not the calling process — a single-process mesh of N
+        virtual devices still has N replicas to rot.  Fires at most once
+        per process per spec (the audit's rollback replay runs clean)."""
+        for spec in self.specs:
+            if (spec.kind != "bitflip_params" or spec.round != round_idx
+                    or spec in self._fired):
+                continue
+            want = spec.attempt if spec.attempt is not None else 0
+            if want != self.attempt:
+                continue
+            self._fired.add(spec)
+            return spec.rank
+        return None
+
 
 _CACHE: tuple[tuple[str, ...], FaultInjector] | None = None
 
@@ -270,6 +371,24 @@ def reset_injector() -> None:
     """Drop the process-wide injector (and its fired-once memory)."""
     global _CACHE
     _CACHE = None
+
+
+def corrupt_bytes(raw: bytes, seq: int) -> bytes:
+    """Deterministically rot one record: XOR-flip three bytes at
+    seq-derived positions and drop the final byte (a torn read).  The
+    truncation guarantees a length-delimited decoder notices — a flip
+    that lands inside pixel payload alone would be silent corruption,
+    which is the object-store checksum tier's job to catch, not the
+    decoder's."""
+    if not raw:
+        return raw
+    buf = bytearray(raw[:-1] if len(raw) > 1 else raw)
+    for i in range(3):
+        if not buf:
+            break
+        pos = zlib.crc32(f"corrupt_bytes:{seq}:{i}".encode()) % len(buf)
+        buf[pos] ^= 0x5A
+    return bytes(buf)
 
 
 def scribble(path: str) -> None:

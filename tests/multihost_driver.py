@@ -66,6 +66,9 @@ def main() -> None:
     ap.add_argument("--guard", action="store_true",
                     help="arm the numerical-integrity guard (needs "
                          "--ckpt-dir)")
+    ap.add_argument("--audit-every", type=int, default=0,
+                    help="cross-replica parameter audit cadence in rounds "
+                         "(0 = off; needs --ckpt-dir)")
     ap.add_argument("--fail-rank", type=int, default=None,
                     help="failure-path mode: this rank dies (exit 3) after "
                          "the first round")
@@ -119,7 +122,8 @@ def main() -> None:
                       checkpoint_dir=args.ckpt_dir,
                       checkpoint_every=args.ckpt_every,
                       elastic=args.elastic,
-                      guard_numerics=args.guard),
+                      guard_numerics=args.guard,
+                      audit_every=args.audit_every),
         seed=0)
     rows = local_batch_slice(GLOBAL_BATCH)
     injector = faults.get_injector()
@@ -176,6 +180,7 @@ def main() -> None:
                 flat[f"{lname}/{i}"] = np.asarray(b)
         flat["__losses__"] = np.asarray(losses)
         flat["__guard_trips__"] = np.asarray(tr.guard_trips)
+        flat["__audit_trips__"] = np.asarray(tr.audit_trips)
         flat["__scores__"] = np.asarray(
             [scores.get("loss", 0.0), scores.get("accuracy", 0.0)])
         np.savez(args.out, **flat)

@@ -25,9 +25,21 @@ run_chaos() {
     -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+# 2-run chaos soak smoke (tools/soak.py) — opt-in via SPARKNET_SOAK=1 so
+# the default tier-1 wall time is untouched; CI rigs that can afford it
+# get randomized-but-seeded fault schedules checked for exact recovery.
+maybe_soak() {
+  if [ "${SPARKNET_SOAK:-}" = "1" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python tools/soak.py --runs 2 --seed "${SPARKNET_SOAK_SEED:-0}" \
+      --out /tmp/_soak.json
+  fi
+}
+
 case "${1:-}" in
   --chaos) run_chaos ;;
-  --all)   run_tier1 && run_chaos ;;
-  "")      run_tier1 ;;
-  *) echo "usage: $0 [--chaos|--all]" >&2; exit 2 ;;
+  --soak)  SPARKNET_SOAK=1 maybe_soak ;;
+  --all)   run_tier1 && run_chaos && maybe_soak ;;
+  "")      run_tier1 && maybe_soak ;;
+  *) echo "usage: $0 [--chaos|--soak|--all]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,174 @@
+"""Chaos soak runner: N short supervised training runs under randomized —
+but seeded — fault schedules, each checked for exact recovery, with a
+JSON verdict.
+
+The per-fault chaos tests (tests/test_resilience.py, marker ``chaos``)
+pin one failure mode each; this runner is the composition check the
+ROADMAP's production posture needs: pick a fault *schedule* at random
+(crash, torn checkpoint write, NaN poison, replica bit flip, straggle ...
+each with a random round/rank), run the standard 4-round driver workload
+under ResilientRunner supervision, and assert the finished params are
+bit-for-bit the fault-free baseline of the same configuration.  The
+randomness is fully derived from ``--seed``, so any red verdict is
+replayable with the same command line.
+
+Usage:
+  python tools/soak.py --runs 8 --seed 0 --out soak.json
+  SPARKNET_SOAK=1 tools/run_tier1.sh     # the 2-run CI smoke
+
+Exit code 0 iff every run recovered exactly; the JSON verdict names each
+run's schedule, exit code, attempt count, and whether the params matched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+DRIVER = os.path.join(REPO, "tests", "multihost_driver.py")
+
+
+def _schedules(rng):
+    """One randomized-but-seeded fault schedule: (name, SPARKNET_FAULT
+    value, extra driver flags).  Rounds land in [1, 3) so the 4-round
+    workload always has a checkpoint before and rounds after the fault."""
+    r = int(rng.integers(1, 3))
+    return [
+        ("crash", f"crash@round:{r}", []),
+        ("crash_in_ckpt", f"crash_in_ckpt@round:{r}", []),
+        ("corrupt_ckpt", f"corrupt_ckpt@round:{r}", []),
+        ("nan_inject", f"nan_inject@round:{r}", ["--guard"]),
+        ("bitflip_params",
+         f"bitflip_params@rank:{int(rng.integers(0, 4))}@round:{r}",
+         ["--audit-every", "1"]),
+        ("straggle+crash",
+         f"straggle:0.5s@round:{r},crash@round:{r}@attempt:0", []),
+    ]
+
+
+def _clean_env():
+    os.environ.pop("XLA_FLAGS", None)
+    for k in list(os.environ):
+        if k.startswith("SPARKNET_") and k != "SPARKNET_SOAK":
+            os.environ.pop(k)
+
+
+def _run_driver(out, ckpt, flags, fault=None, max_restarts=2):
+    from sparknet_tpu.parallel.resilience import ResilientRunner, RestartPolicy
+    cmd = [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
+           "--local-devices", "4", "--rounds", "4"] + flags
+    if ckpt:
+        cmd += ["--ckpt-dir", ckpt]
+    runner = ResilientRunner(
+        cmd, nprocs=1, platform="cpu", timeout=300,
+        policy=RestartPolicy(max_restarts=max_restarts, backoff_base=0.2),
+        extra_env={"SPARKNET_FAULT": fault} if fault else None)
+    rc = runner.run()
+    return rc, len(runner.attempts)
+
+
+def _params_match(base_npz, out_npz):
+    import numpy as np
+    a, b = np.load(base_npz), np.load(out_npz)
+    for k in a.files:
+        if k.startswith("__"):
+            continue
+        if not np.array_equal(a[k], b[k]):
+            return False, k
+    return True, None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="chaos soak runner")
+    ap.add_argument("--runs", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="write the JSON verdict here (default: stdout)")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a TemporaryDirectory)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    _clean_env()
+    rng = np.random.default_rng(args.seed)
+
+    own_tmp = args.workdir is None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sparknet_soak_")
+    os.makedirs(workdir, exist_ok=True)
+
+    baselines: dict[tuple[str, ...], str] = {}
+
+    def baseline_for(flags):
+        """Fault-free reference run per flag set (cached — the guard and
+        audit change checkpoint traffic but not the training math, so
+        matching flags keeps the comparison honest)."""
+        key = tuple(flags)
+        if key not in baselines:
+            path = os.path.join(workdir, f"base_{len(baselines)}.npz")
+            ck = os.path.join(workdir, f"base_ck_{len(baselines)}")
+            rc, _ = _run_driver(path, ck if flags else None, list(flags))
+            if rc != 0:
+                raise RuntimeError(f"fault-free baseline failed rc={rc} "
+                                   f"(flags={flags})")
+            baselines[key] = path
+        return baselines[key]
+
+    runs = []
+    t0 = time.monotonic()
+    for i in range(args.runs):
+        options = _schedules(rng)
+        name, fault, flags = options[int(rng.integers(0, len(options)))]
+        out = os.path.join(workdir, f"run_{i}.npz")
+        ck = os.path.join(workdir, f"ck_{i}")
+        verdict = {"run": i, "schedule": name, "fault": fault,
+                   "flags": flags}
+        try:
+            base = baseline_for(flags)
+            rc, attempts = _run_driver(out, ck, list(flags), fault=fault)
+            verdict.update(rc=rc, attempts=attempts)
+            if rc == 0:
+                match, bad_key = _params_match(base, out)
+                verdict.update(match=match,
+                               **({"diverged_at": bad_key}
+                                  if not match else {}))
+            else:
+                verdict.update(match=False)
+        except Exception as e:   # a broken run is a red verdict, not a crash
+            verdict.update(rc=-1, attempts=0, match=False, error=str(e))
+        verdict["ok"] = bool(verdict.get("rc") == 0 and verdict["match"])
+        runs.append(verdict)
+        print(f"soak: run {i} [{fault}] -> "
+              f"{'OK' if verdict['ok'] else 'FAIL'} "
+              f"(rc={verdict.get('rc')}, attempts="
+              f"{verdict.get('attempts')})", flush=True)
+
+    passed = sum(1 for r in runs if r["ok"])
+    report = {"seed": args.seed, "runs": runs, "passed": passed,
+              "failed": len(runs) - passed,
+              "elapsed_s": round(time.monotonic() - t0, 1),
+              "ok": passed == len(runs)}
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"soak: verdict written to {args.out} "
+              f"({passed}/{len(runs)} passed)")
+    else:
+        print(text)
+    if own_tmp and report["ok"]:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not report["ok"]:
+        print(f"soak: scratch kept at {workdir} for post-mortem",
+              file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
