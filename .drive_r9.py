@@ -1,0 +1,234 @@
+"""Drive the PR-4 parallel input pipeline end-to-end (public surface).
+
+Run: python .drive_r9.py   (from the repo root; prints DRIVE OK)
+
+Flows: (1) training THROUGH the parallel feed path — db_feed(workers=2) →
+device_feed(u8 cast path exercised separately) → Solver.step, loss drops;
+(2) serial-vs-parallel bit-identity incl. corrupt_record quarantine parity;
+(3) DeviceFeed: deep depth, uint8 staging + on-device cast, per-stage
+stats, watchdog (feeder_die) still lossless through the new staging tier;
+(4) DistributedTrainer.input_feed on an 8-virtual-device mesh;
+(5) PartitionedDataset.cached() multi-epoch decode-once;
+(6) typed error paths: DecodeWorkerError on a dead pool, bad knob values.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # the only reliable CPU route here
+
+import numpy as np
+
+from sparknet_tpu.data import (
+    DecodePool, DecodeWorkerError, FeedStats, PartitionedDataset,
+    Quarantine, QuarantinePolicy, device_feed, feed_depth, feed_workers,
+)
+from sparknet_tpu.data.db import array_to_datum, db_feed
+from sparknet_tpu.data.lmdb_io import write_lmdb
+from sparknet_tpu.models.dsl import layer
+from sparknet_tpu.proto.caffe_pb import Phase
+from sparknet_tpu.utils import faults
+
+checks = 0
+
+
+def ok(cond, what):
+    global checks
+    assert cond, what
+    checks += 1
+    print(f"  ok: {what}")
+
+
+# -- a tiny LMDB ------------------------------------------------------------
+tmp = "/tmp/drive_r9"
+os.makedirs(tmp, exist_ok=True)
+db = os.path.join(tmp, "lmdb")
+rng = np.random.default_rng(0)
+N = 64
+imgs = rng.integers(0, 256, size=(N, 3, 12, 12)).astype(np.uint8)
+labels = rng.integers(0, 10, size=N)
+if not os.path.exists(db):
+    write_lmdb(db, [(b"%08d" % i, array_to_datum(imgs[i], int(labels[i])))
+                    for i in range(N)])
+LP = dict(data_param={"source": db, "batch_size": 8, "backend": "LMDB"},
+          transform_param={"scale": 1 / 255.0})
+
+
+def make_feed(workers, quarantine=None, stats=None):
+    lp = layer("d", "Data", [], ["data", "label"], **LP)
+    return db_feed(lp, Phase.TRAIN, seed=3, quarantine=quarantine,
+                   workers=workers, stats=stats)
+
+
+# -- (1) train through the parallel pipeline --------------------------------
+print("[1] train through db_feed(workers=2) -> device_feed -> Solver")
+from sparknet_tpu.proto import load_net_prototxt, load_solver_prototxt_with_net
+
+net_txt = """
+name: "drv"
+layer { name: "data" type: "Input" top: "data" top: "label"
+        input_param { shape { dim: 8 dim: 3 dim: 12 dim: 12 }
+                      shape { dim: 8 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 10
+                              weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+        top: "loss" }
+"""
+from sparknet_tpu.solvers import Solver
+
+sp = load_solver_prototxt_with_net("base_lr: 0.05\nmomentum: 0.9\n",
+                                   load_net_prototxt(net_txt))
+solver = Solver(sp, seed=0)
+stats = FeedStats()
+feed = device_feed(make_feed(2, stats=stats), depth=4, stats=stats)
+solver.set_train_data(feed)
+l0 = solver.step(3)
+l1 = solver.step(25)
+feed.close()
+ok(np.isfinite(l0) and np.isfinite(l1) and l1 < l0,
+   f"loss dropped through the parallel feed ({l0:.3f} -> {l1:.3f})")
+snap = stats.snapshot()
+ok(snap["batches"] > 0 and snap["device_put_s"] > 0 and snap["decode_s"] > 0,
+   f"per-stage stats populated: {snap}")
+
+# -- (2) serial vs parallel bit-identity (clean + corrupt) ------------------
+print("[2] serial-vs-parallel bit-identity, clean + corrupt_record")
+
+
+def stream(workers, n=10, quarantine=None):
+    f = make_feed(workers, quarantine=quarantine)
+    out = [next(f) for _ in range(n)]
+    f.close()
+    return out
+
+
+for b_s, b_p in zip(stream(0), stream(4)):
+    assert all(np.array_equal(b_s[k], b_p[k]) for k in b_s)
+ok(True, "clean streams bit-identical (workers=0 vs 4)")
+
+os.environ["SPARKNET_FAULT"] = "corrupt_record:0.2"
+os.environ["SPARKNET_FAULT_ATTEMPT"] = "0"
+reports = []
+streams = []
+for w in (0, 4):
+    faults.reset_injector()
+    q = Quarantine(QuarantinePolicy(max_fraction=0.5), epoch_size=N,
+                   source=db)
+    streams.append(stream(w, quarantine=q))
+    r = q.report()
+    r.pop("examples")
+    reports.append(r)
+del os.environ["SPARKNET_FAULT"]
+faults.reset_injector()
+for b_s, b_p in zip(*streams):
+    assert all(np.array_equal(b_s[k], b_p[k]) for k in b_s)
+ok(reports[0]["total_bad"] > 0 and reports[0] == reports[1],
+   f"quarantine parity under faults: {reports[0]['total_bad']} bad, "
+   f"identical accounting")
+
+# -- (3) DeviceFeed: u8 cast, watchdog through the staging tier -------------
+print("[3] DeviceFeed: uint8 staging + device cast; feeder_die lossless")
+import jax.numpy as jnp
+
+host = [{"data": np.full((4, 2), i, np.uint8)} for i in range(6)]
+with device_feed(iter(host), depth=feed_depth(),
+                 device_cast={"data": jnp.float32}) as df:
+    got = list(df)
+ok(len(got) == 6 and all(b["data"].dtype == jnp.float32 for b in got)
+   and all(float(np.asarray(b["data"]).max()) == i
+           for i, b in enumerate(got)),
+   "uint8 shipped, f32 on device, order and values intact")
+
+os.environ["SPARKNET_FAULT"] = "feeder_die@round:3"
+os.environ["SPARKNET_FAULT_ATTEMPT"] = "0"
+faults.reset_injector()
+with device_feed(iter([{"x": np.full(2, i, np.float32)}
+                       for i in range(8)]), depth=2) as df:
+    vals = [int(np.asarray(b["x"])[0]) for b in df]
+del os.environ["SPARKNET_FAULT"]
+faults.reset_injector()
+ok(vals == list(range(8)),
+   "feeder death mid-stream: watchdog restart lost no batches through "
+   "the staging pool")
+
+# -- (4) DistributedTrainer.input_feed on the 8-device mesh -----------------
+print("[4] DistributedTrainer.input_feed round path")
+from sparknet_tpu.parallel.trainer import DistributedTrainer, TrainerConfig
+
+tr = DistributedTrainer(sp, config=TrainerConfig(strategy="local_sgd",
+                                                 tau=2), seed=0)
+gb = 8 * tr.n_workers
+
+
+def rounds():
+    while True:
+        yield {"data": rng.normal(size=(2, gb, 3, 12, 12)
+                                  ).astype(np.float32),
+               "label": rng.integers(0, 10, size=(2, gb)
+                                     ).astype(np.float32)}
+
+
+with tr.input_feed(rounds(), depth=2) as rit:
+    losses = [tr.train_round(next(rit)) for _ in range(3)]
+ok(all(np.isfinite(l) for l in losses),
+   f"3 sharded rounds through input_feed: losses {['%.3f' % l for l in losses]}")
+
+# -- (5) decoded-shard cache ------------------------------------------------
+print("[5] PartitionedDataset.cached: decode once per shard")
+
+
+class Counting(list):
+    mat = 0
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            type(self).mat += 1
+        return super().__getitem__(i)
+
+
+parts = [Counting([(imgs[j], int(labels[j])) for j in range(16)])
+         for _ in range(3)]
+ds = PartitionedDataset(parts).cached(max_shards=3)
+for _epoch in range(4):
+    for p in range(3):
+        _ = list(ds.partitions[p])
+ok(Counting.mat == 3, f"3 shards materialized once across 4 epochs "
+   f"(got {Counting.mat})")
+
+# -- (6) typed error paths --------------------------------------------------
+print("[6] error paths")
+pool = DecodePool(lambda x: x, workers=2)
+pool.submit(1)
+pool._closed = True
+pool.close()
+try:
+    pool.submit(2)
+    raise SystemExit("closed pool accepted work")
+except RuntimeError:
+    ok(True, "closed pool rejects submit")
+
+boom = DecodePool(lambda x: 1 // 0, workers=2)
+boom.submit(1)
+try:
+    boom.result()
+    raise SystemExit("pool ate the work-fn exception")
+except ZeroDivisionError:
+    ok(True, "work-fn exception re-raised at its ordinal")
+boom.close()
+
+try:
+    feed_workers_bad = int(os.environ.setdefault("SPARKNET_FEED_WORKERS",
+                                                 "-2"))
+    feed_workers()
+    raise SystemExit("negative SPARKNET_FEED_WORKERS accepted")
+except ValueError:
+    ok(True, "negative SPARKNET_FEED_WORKERS raises")
+finally:
+    del os.environ["SPARKNET_FEED_WORKERS"]
+
+print(f"DRIVE OK ({checks} checks)")

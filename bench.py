@@ -215,27 +215,58 @@ def run_child() -> None:
         producer in BOTH the feed-alone leg and the in-loop source
         iterator, so a rig whose raw transfer is near-free (CPU
         platform) can still exercise and assert the non-degenerate
-        overlap regime deterministically."""
+        overlap regime deterministically.
+
+        Pipeline knobs under measurement: the feed leg runs the parallel
+        pipeline defaults (SPARKNET_FEED_WORKERS / SPARKNET_FEED_DEPTH),
+        ships pixels as uint8 with a post-transfer device cast
+        (BENCH_FEED_U8=0 restores f32 staging — 4× the bytes), and
+        reports the per-stage breakdown (decode_s / transform_s /
+        device_put_s per batch) from data.pipeline.FeedStats so BENCH_r*
+        files track WHERE feed time goes across PRs."""
         import itertools
 
         from sparknet_tpu.data import device_feed
+        from sparknet_tpu.data.pipeline import (
+            FeedStats, feed_depth, feed_workers,
+        )
 
         fbatch = int(os.environ.get("BENCH_FEED_BATCH", BATCH))
         fdelay = float(os.environ.get("BENCH_FEED_DELAY_S", 0))
+        use_u8 = os.environ.get("BENCH_FEED_U8", "1") != "0"
+        depth = feed_depth()
         solver = Solver(sp, seed=0,
                         compute_dtype=jnp.bfloat16 if dtype == "bf16" else None)
         m = 4
-        host = [{"data": rng.normal(size=(fbatch,) + in_shape
-                                    ).astype(np.float32),
-                 "label": rng.integers(0, classes, size=fbatch
-                                       ).astype(np.float32)}
-                for _ in range(m)]
+        # real images leave decode as uint8 — ship them that way (4× less
+        # host→HBM traffic than f32) and cast on device, unless pinned off
+        if use_u8:
+            host = [{"data": rng.integers(0, 256, size=(fbatch,) + in_shape
+                                          ).astype(np.uint8),
+                     "label": rng.integers(0, classes, size=fbatch
+                                           ).astype(np.float32)}
+                    for _ in range(m)]
+            cast = {"data": jnp.float32}
+        else:
+            host = [{"data": rng.normal(size=(fbatch,) + in_shape
+                                        ).astype(np.float32),
+                     "label": rng.integers(0, classes, size=fbatch
+                                           ).astype(np.float32)}
+                    for _ in range(m)]
+            cast = None
         feed_iters = int(os.environ.get("BENCH_FEED_ITERS", 8))
+
+        def stage(hb) -> dict:
+            out = {k: jax.device_put(v) for k, v in hb.items()}
+            if cast:
+                out = {k: (v.astype(cast[k]) if k in cast else v)
+                       for k, v in out.items()}
+            return out
 
         # compute-alone: per-step dispatch on device-resident batches —
         # the in-loop measurement's cost with the feed leg removed
         # (includes the rig's per-dispatch RPC, as the in-loop steps do)
-        dev = [jax.device_put(hb) for hb in host]
+        dev = [stage(hb) for hb in host]
         jax.block_until_ready(dev)
         solver.set_train_data(itertools.cycle(dev))
         solver.step(2)  # warmup/compile at this batch
@@ -245,42 +276,49 @@ def run_child() -> None:
         del dev
 
         # feed-alone: host work (BENCH_FEED_DELAY_S decode stand-in) +
-        # host->HBM transfer time per batch with the transfers
-        # dispatched back-to-back (pipelined, like the prefetch thread
-        # issues them) — a per-batch synchronous measure would overstate
-        # the baseline and inflate the overlap figure
+        # host->HBM transfer (+ the device-side u8→f32 cast) per batch
+        # with the transfers dispatched back-to-back (pipelined, like
+        # the staging pool issues them) — a per-batch synchronous
+        # measure would overstate the baseline and inflate the overlap
         t0 = time.perf_counter()
         staged = []
         for hb in host:
             if fdelay:
                 time.sleep(fdelay)
-            staged.append(jax.device_put(hb))
+            staged.append(stage(hb))
         jax.block_until_ready(staged)
         feed_alone = (time.perf_counter() - t0) / m
         del staged
 
+        stats = FeedStats()
+
         def source():
-            # the producer (prefetch thread) pays the same per-batch
-            # host delay as the feed-alone leg
+            # the producer pays the same per-batch host delay as the
+            # feed-alone leg; it books as the pipeline's decode stage
             for hb in itertools.islice(itertools.cycle(host),
                                        feed_iters + 4):
                 if fdelay:
-                    time.sleep(fdelay)
+                    with stats.timed("decode"):
+                        time.sleep(fdelay)
                 yield hb
 
         solver2 = Solver(sp, seed=0,
                          compute_dtype=jnp.bfloat16 if dtype == "bf16"
                          else None)
-        solver2.set_train_data(device_feed(source()))
+        feed = device_feed(source(), depth=depth, device_cast=cast,
+                           stats=stats)
+        solver2.set_train_data(feed)
         solver2.step(2)  # warmup/compile
         t0 = time.perf_counter()
         solver2.step(feed_iters)
         total = (time.perf_counter() - t0) / feed_iters
+        feed.close()
         # overlap fraction: 1.0 when total == max(feed, compute) (perfect
         # pipeline), 0.0 when total == feed + compute (fully serial)
         denom = min(feed_alone, compute_s) or 1.0
         overlap = (feed_alone + compute_s - total) / denom * 100.0
         bound = "feed" if feed_alone > compute_s else "compute"
+        stages = stats.per_batch()
         out = {
             "batch": fbatch,
             "images_per_sec": round(fbatch / total, 1),
@@ -290,11 +328,24 @@ def run_child() -> None:
             "bound": bound,
             "feed_compute_ratio": round(feed_alone / max(compute_s, 1e-9), 2),
             "overlap_pct": round(max(0.0, min(100.0, overlap)), 1),
+            # per-stage breakdown (s/batch, averaged over the whole leg
+            # incl. warmup) + the pipeline config that produced it
+            "decode_s": stages["decode_s"],
+            "transform_s": stages["transform_s"],
+            "device_put_s": stages["device_put_s"],
+            "workers": feed_workers(),
+            "depth": depth,
+            "staged_dtype": "uint8" if use_u8 else "float32",
         }
         _log(f"[{dtype}] feed-in-loop @ b{fbatch}: "
              f"{out['images_per_sec']} img/s (feed-alone {feed_alone:.3f}s, "
              f"compute {compute_s:.4f}s, {bound}-bound, "
-             f"overlap {out['overlap_pct']}%)")
+             f"overlap {out['overlap_pct']}%; stages decode "
+             f"{stages['decode_s']:.4f}s / transform "
+             f"{stages['transform_s']:.4f}s / put "
+             f"{stages['device_put_s']:.4f}s per batch, "
+             f"staged {out['staged_dtype']}, workers {out['workers']}, "
+             f"depth {depth})")
         return out
 
     dtypes = [DTYPE] if DTYPE in ("f32", "bf16") else ["bf16", "f32"]
@@ -366,7 +417,9 @@ def _load_last_good() -> dict | None:
 _CONFIG_ENVS = ("BENCH_PLATFORM", "BENCH_MODEL", "BENCH_BATCH",
                 "BENCH_ITERS", "BENCH_REPS", "BENCH_WINDOWS",
                 "BENCH_DTYPE", "BENCH_SCAN", "BENCH_FEED_BATCH",
-                "BENCH_FEED_ITERS", "BENCH_FEED_DELAY_S")
+                "BENCH_FEED_ITERS", "BENCH_FEED_DELAY_S",
+                "BENCH_FEED_U8", "SPARKNET_FEED_WORKERS",
+                "SPARKNET_FEED_DEPTH", "SPARKNET_FEED_PUTTERS")
 
 
 def _save_last_good(result: dict) -> None:

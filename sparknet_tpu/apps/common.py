@@ -27,7 +27,6 @@ from typing import Any, Callable, Iterator, Sequence
 import numpy as np
 
 from ..data.partition import PartitionedDataset
-from ..data.prefetch import device_feed
 from ..parallel.trainer import DistributedTrainer
 from ..utils.timing import PhaseLogger
 
@@ -155,7 +154,7 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
                  test_interval: int = 10,
                  logger: PhaseLogger | None = None,
                  snapshot_path: str | None = None,
-                 prefetch_depth: int = 1) -> dict[str, Any]:
+                 prefetch_depth: int | None = None) -> dict[str, Any]:
     """The outer while-loop (reference: CifarApp.scala:87-128 — infinite
     there; bounded by ``rounds`` here).  SIGINT stops cleanly (snapshotting
     first when a path is given), SIGHUP snapshots and continues — the
@@ -163,15 +162,15 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
     caffe/src/caffe/util/signal_handler.cpp, solver.cpp:270-281).
 
     Round feeds are prefetched and device_put off-thread (``prefetch_depth``
-    rounds ahead; default 1 — a τ×global_batch round is large in HBM), so
-    the host never serializes with the compiled round — the fix for the
-    reference's synchronous JavaData feed.  Returns the last eval scores."""
+    rounds ahead; default ``SPARKNET_FEED_DEPTH`` when set, else 1 — a
+    τ×global_batch round is large in HBM), so the host never serializes
+    with the compiled round — the fix for the reference's synchronous
+    JavaData feed.  Returns the last eval scores."""
     from ..utils.signals import SignalGuard, SolverAction
 
     log = logger or PhaseLogger()
     last_scores: dict[str, Any] = {}
-    round_iter = device_feed(feed.rounds(), depth=prefetch_depth,
-                             sharding=trainer.input_sharding)
+    round_iter = trainer.input_feed(feed.rounds(), depth=prefetch_depth)
 
     def maybe_snapshot(reason: str) -> None:
         if snapshot_path:

@@ -1,6 +1,10 @@
 from .partition import PartitionedDataset
 from .minibatch import MinibatchSampler, make_minibatches
-from .prefetch import FeedStalled, PrefetchIterator, device_feed
+from .prefetch import DeviceFeed, FeedStalled, PrefetchIterator, device_feed
+from .pipeline import (
+    BufferRing, DecodePool, DecodeWorkerError, FeedStats, ShardCache,
+    feed_depth, feed_workers,
+)
 from .integrity import (
     DataCorruptionError, Quarantine, QuarantineExceeded, QuarantinePolicy,
 )

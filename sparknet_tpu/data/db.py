@@ -15,6 +15,7 @@ alternative that swaps these for externally-fed inputs.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Iterator
 
 import numpy as np
@@ -162,6 +163,11 @@ class DataTransformer:
             values = [float(v) for v in p.get_all("mean_value")]
             if values:
                 self.mean = np.asarray(values, np.float32).reshape(-1, 1, 1)
+        # reusable full-size f32 scratch for the batch mean-subtract
+        # intermediate (consumed within batch() — it never escapes).
+        # NOT thread-safe: batch() runs on the one feed/consumer thread;
+        # the decode POOL parallelizes records, not transforms.
+        self._scratch: np.ndarray | None = None
 
     def __call__(self, img: np.ndarray) -> np.ndarray:
         out = img.astype(np.float32)
@@ -181,16 +187,36 @@ class DataTransformer:
             out = out * self.scale
         return np.ascontiguousarray(out)
 
-    def batch(self, imgs: np.ndarray) -> np.ndarray:
+    def _sub_mean(self, x: np.ndarray) -> np.ndarray:
+        """``x - mean`` into the reusable scratch buffer (no allocation
+        in steady state).  The result aliases internal state — callers
+        must consume it within the same ``batch()`` call."""
+        if self._scratch is None or self._scratch.shape != x.shape:
+            self._scratch = np.empty(x.shape, np.float32)
+        np.subtract(x, self.mean, out=self._scratch)
+        return self._scratch
+
+    def batch(self, imgs: np.ndarray,
+              out: np.ndarray | None = None) -> np.ndarray:
         """Vectorized transform of an [n, c, h, w] batch — one pass
         through the native crop/mirror kernel instead of n Python-level
-        transforms (the batched half of the native data path)."""
+        transforms (the batched half of the native data path).  This is
+        the feed pipeline's PRIMARY transform: per-record paths stack raw
+        decodes and come through here too.
+
+        ``out``: optional preallocated result buffer (the caller owns the
+        rotation/aliasing contract — see ``pipeline.BufferRing``); the
+        mean-subtract intermediate reuses an internal scratch either way,
+        so the steady state allocates nothing."""
+        from . import transforms
         from .. import native
-        out = imgs.astype(np.float32, copy=False)
-        if self.mean is not None:
-            out = out - self.mean
-        n, _c, h, w = out.shape
+        x = np.asarray(imgs, np.float32)   # no copy when already f32
+        n, _c, h, w = x.shape
         if self.crop:
+            if self.mean is not None:
+                # full-size subtract == window subtract; scratch is
+                # consumed by the crop below, never escapes
+                x = self._sub_mean(x)
             if self.phase == Phase.TRAIN:
                 ys = self.rng.integers(0, h - self.crop + 1, size=n)
                 xs = self.rng.integers(0, w - self.crop + 1, size=n)
@@ -200,16 +226,31 @@ class DataTransformer:
             flips = (self.rng.integers(0, 2, size=n)
                      if self.mirror and self.phase == Phase.TRAIN
                      else np.zeros(n))
-            out = native.crop_batch(out, self.crop, ys.astype(np.int32),
+            res = native.crop_batch(x, self.crop, ys.astype(np.int32),
                                     xs.astype(np.int32),
-                                    flips.astype(np.int32))
-        elif self.mirror and self.phase == Phase.TRAIN:
+                                    flips.astype(np.int32), out=out)
+            if self.scale != 1.0:
+                np.multiply(res, self.scale, out=res)
+            return res
+        owned = False   # does res own its memory (safe to mutate)?
+        res = x
+        if self.mean is not None:
+            res = transforms.subtract_mean(x, self.mean, out=out)
+            owned = True
+        if self.mirror and self.phase == Phase.TRAIN:
             flips = self.rng.integers(0, 2, size=n).astype(bool)
-            out = out.copy()
-            out[flips] = out[flips, :, :, ::-1]
+            if not owned:
+                res = transforms._take(out, x.shape)
+                res[...] = x
+                owned = True
+            res[flips] = res[flips, :, :, ::-1]
         if self.scale != 1.0:
-            out = out * self.scale
-        return np.ascontiguousarray(out)
+            if owned:
+                np.multiply(res, self.scale, out=res)
+            else:
+                res = transforms.scale(x, self.scale, out=out)
+                owned = True
+        return np.ascontiguousarray(res)
 
 
 # ---------------------------------------------------------------------------
@@ -229,10 +270,21 @@ def _cycle_items(reader):
 
 def db_feed(lp, phase: Phase, tops: list[str] | None = None,
             seed: int = 0, quarantine: Quarantine | None = None,
+            workers: int | None = None, stats=None, buffers: int = 0,
             ) -> Iterator[dict[str, np.ndarray]]:
     """Batch stream for a ``Data`` layer (LMDB/LevelDB backed).  The fast
-    path parses the whole batch's Datums in one native call and transforms
-    them vectorized; mixed/encoded batches fall back per record.
+    path parses the whole batch's Datums in one native call; otherwise
+    decode + integrity checks fan out over a ``pipeline.DecodePool`` of
+    ``workers`` threads (default ``SPARKNET_FEED_WORKERS``; 0 = the
+    serial reference path).  Either way the batch is transformed in ONE
+    vectorized ``DataTransformer.batch`` pass — never per image.
+
+    Determinism: records are PULLED serially on the consumer thread (DB
+    cursor order, the fault injector's per-seq corruption coin, and the
+    quarantine's epoch accounting are all pull-side), and pool results
+    come back in submission order — so for a fixed seed the parallel
+    stream is bit-identical to the serial one, including which records
+    get quarantined and which replacement records are pulled.
 
     Every decoded record is validated (decode + geometry against the
     source's first record); a record that fails is routed through
@@ -242,8 +294,15 @@ def db_feed(lp, phase: Phase, tops: list[str] | None = None,
     from the SPARKNET_QUARANTINE_FRACTION / _RECORDS env knobs (default:
     zero tolerance — detected corruption is attributed, not budgeted).
     Pass an explicit :class:`~sparknet_tpu.data.integrity.Quarantine` to
-    set the policy in code and read ``quarantine.report()`` afterwards."""
+    set the policy in code and read ``quarantine.report()`` afterwards.
+
+    ``stats``: optional ``pipeline.FeedStats`` receiving per-stage
+    decode/transform seconds.  ``buffers``: > 0 rotates the batch output
+    through that many preallocated buffers (``pipeline.BufferRing``) —
+    opt-in, because a consumer that holds more than ``buffers - 1``
+    batches concurrently would see them overwritten."""
     from .. import native
+    from .pipeline import BufferRing, DecodePool
     p = lp.sub("data_param")
     source = str(p.get("source"))
     batch = int(p.get("batch_size", 1))
@@ -265,6 +324,7 @@ def db_feed(lp, phase: Phase, tops: list[str] | None = None,
     injector = faults.get_injector()
     state = {"seq": 0}   # feed-lifetime record counter (epoch accounting
     # + the deterministic corrupt_record coin flip)
+    ring = BufferRing(buffers) if buffers else None
 
     def pull() -> tuple[Any, bytes, bool]:
         """(key, value, injected) for the next record; rolls the
@@ -278,50 +338,91 @@ def db_feed(lp, phase: Phase, tops: list[str] | None = None,
             return key, faults.corrupt_bytes(val, seq), True
         return key, val, False
 
-    def decode_one(key, val) -> tuple[np.ndarray, int] | None:
-        """Decoded + geometry-validated record, or None after the bad
-        record was quarantined (the caller pulls a replacement)."""
-        try:
-            img, label = datum_to_array(val, key=key, source=source)
-            if img.shape != (c, h, w):
-                raise DataCorruptionError(
-                    f"record shape {img.shape} != source geometry "
-                    f"({c}, {h}, {w})", source=source, key=key)
-        except DataCorruptionError as e:
-            quarantine.admit(e)   # raises QuarantineExceeded past budget
-            return None
+    def decode_one(kv) -> tuple[np.ndarray, int]:
+        """Decode + geometry-validate one record (runs on pool workers);
+        corruption raises DataCorruptionError, re-raised by the pool at
+        this record's ordinal — quarantine admission happens on the
+        consumer side, in pull order."""
+        key, val = kv
+        img, label = datum_to_array(val, key=key, source=source)
+        if img.shape != (c, h, w):
+            raise DataCorruptionError(
+                f"record shape {img.shape} != source geometry "
+                f"({c}, {h}, {w})", source=source, key=key)
         return img, label
 
-    while True:
-        records = [pull() for _ in range(batch)]
-        # injected-corrupt records take the per-record path so the
-        # quarantine sees them; a clean batch keeps the native fast path
-        clean = not any(injected for _, _, injected in records)
-        parsed = native.parse_datum_batch(
-            [val for _, val, _ in records], c, h, w) \
-            if use_native and clean else None
-        if parsed is None and use_native and clean:
-            use_native = False
-        if parsed is not None:
-            imgs, labels = parsed
-            out = {tops[0]: tf.batch(imgs)}
+    # window >= batch: the feed submits a whole batch before collecting,
+    # so a smaller window would deadlock the consumer on its own
+    # backpressure (replacement pulls add at most one in-flight record)
+    pool = DecodePool(decode_one, workers=workers, name=f"db:{source}",
+                      stats=stats, stage="decode", window=batch + 2)
+
+    def transform(imgs) -> np.ndarray:
+        t0 = time.perf_counter() if stats is not None else 0.0
+        if isinstance(imgs, list):
+            imgs = np.stack(imgs)
+        n = imgs.shape[0]
+        shape = (n, c, tf.crop, tf.crop) if tf.crop else (n, c, h, w)
+        data = tf.batch(imgs, out=ring.take(shape) if ring else None)
+        if stats is not None:
+            stats.note("transform", time.perf_counter() - t0)
+            stats.count_batch(n)
+        return data
+
+    def collect_one(imgs_l: list, labels_l: list) -> None:
+        """Consume the pool's next result in order; a corrupt record is
+        admitted to the quarantine (pull order preserved) and simply not
+        appended — the caller pulls a replacement."""
+        try:
+            img, label = pool.result()
+        except DataCorruptionError as e:
+            quarantine.admit(e)   # raises QuarantineExceeded past budget
+            return
+        imgs_l.append(img)
+        labels_l.append(label)
+
+    try:
+        while True:
+            records = [pull() for _ in range(batch)]
+            # injected-corrupt records take the per-record path so the
+            # quarantine sees them; a clean batch keeps the native fast
+            # path (one C call: parse + stack, GIL released)
+            parsed = None
+            if use_native and not any(inj for _, _, inj in records):
+                if stats is not None:
+                    with stats.timed("decode"):
+                        parsed = native.parse_datum_batch(
+                            [val for _, val, _ in records], c, h, w)
+                else:
+                    parsed = native.parse_datum_batch(
+                        [val for _, val, _ in records], c, h, w)
+                if parsed is None:
+                    use_native = False
+            if parsed is not None:
+                imgs, labels = parsed
+                out = {tops[0]: transform(imgs)}
+                if len(tops) > 1:
+                    out[tops[1]] = labels.astype(np.float32)
+                yield out
+                continue
+            # per-record path: decode fans out over the pool; results and
+            # quarantine admissions stay in pull order
+            for key, val, _ in records:
+                pool.submit((key, val))
+            imgs_l: list[np.ndarray] = []
+            labels_l: list[int] = []
+            for _ in range(batch):
+                collect_one(imgs_l, labels_l)
+            while len(imgs_l) < batch:   # replace quarantined records
+                key, val, _ = pull()
+                pool.submit((key, val))
+                collect_one(imgs_l, labels_l)
+            out = {tops[0]: transform(imgs_l)}
             if len(tops) > 1:
-                out[tops[1]] = labels.astype(np.float32)
+                out[tops[1]] = np.asarray(labels_l, np.float32)
             yield out
-            continue
-        imgs_l, labels_l = [], []
-        for key, val, _ in records:
-            got = decode_one(key, val)
-            if got is not None:
-                imgs_l.append(tf(got[0]))
-                labels_l.append(got[1])
-        while len(imgs_l) < batch:   # replace quarantined records
-            key, val, _ = pull()
-            got = decode_one(key, val)
-            if got is not None:
-                imgs_l.append(tf(got[0]))
-                labels_l.append(got[1])
-        yield _pack(tops, imgs_l, labels_l)
+    finally:
+        pool.close()
 
 
 def image_data_feed(lp, phase: Phase, seed: int = 0
@@ -515,7 +616,9 @@ def feed_for_net(net_param, phase: Phase, seed: int = 0):
 # ---------------------------------------------------------------------------
 
 def _pack(tops, imgs, labels) -> dict[str, np.ndarray]:
-    out = {tops[0]: np.stack(imgs).astype(np.float32)}
+    # asarray, not astype: the stack is already f32 when its inputs are
+    # (the common case) — no second whole-batch copy
+    out = {tops[0]: np.asarray(np.stack(imgs), np.float32)}
     if len(tops) > 1:
         out[tops[1]] = np.asarray(labels, np.float32)
     return out

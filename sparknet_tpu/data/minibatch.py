@@ -64,5 +64,9 @@ def batch_feed(minibatches: Iterator[tuple[np.ndarray, np.ndarray]],
     for images, labels in minibatches:
         if preprocess is not None:
             images = preprocess(images)
-        yield {data_key: images.astype(np.float32),
-               label_key: labels.astype(np.float32)}
+        # asarray, not astype: when the sampler already holds f32 (every
+        # preprocessed path does) this is a no-op instead of a whole-batch
+        # copy per step — the feed hot loop must not pay a memcpy for a
+        # dtype it already has
+        yield {data_key: np.asarray(images, np.float32),
+               label_key: np.asarray(labels, np.float32)}

@@ -129,6 +129,22 @@ class PartitionedDataset:
             at += size
         return PartitionedDataset(parts)
 
+    def cached(self, max_shards: int | None = None,
+               cache=None) -> "PartitionedDataset":
+        """A view whose partitions materialize through a shared
+        ``pipeline.ShardCache`` LRU: multi-epoch training over lazy
+        partitions (``imagenet.LazyTarPartition`` decodes per access)
+        pays decode once per shard instead of once per epoch, bounded to
+        ``max_shards`` resident shards (default: all of them — the
+        whole-dataset cache).  Pass an existing :class:`ShardCache` to
+        share one budget across datasets (e.g. train + test views)."""
+        from .pipeline import CachedPartition, ShardCache
+        if cache is None:
+            cache = ShardCache(max_shards or self.num_partitions or 1)
+        return PartitionedDataset(
+            [CachedPartition(p, key, cache)
+             for key, p in enumerate(self.partitions)])
+
     def iterator(self, partition: int) -> Iterator[Any]:
         return iter(self.partitions[partition])
 

@@ -12,11 +12,28 @@ caffe/src/caffe/data_transformer.cpp).
 These run vectorized over whole minibatches (the reference loops per image
 per pixel through JNA — its measured hot spot, CallbackBenchmarkSpec).  An
 optional C++ fast path lives in sparknet_tpu.native.
+
+Allocation discipline (the feed-pipeline hot path): every function takes
+``np.asarray(..., np.float32)`` — a no-op when the input is already f32,
+where the old ``.astype`` unconditionally copied — and accepts an optional
+preallocated ``out`` buffer (pair with ``pipeline.BufferRing`` for an
+allocation-free steady state; the ring's aliasing contract is the
+caller's).
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+
+def _take(out: np.ndarray | None, shape: tuple) -> np.ndarray:
+    """``out`` when it matches (f32, C-contiguous, right shape), else a
+    fresh buffer — a wrong buffer silently degrades to an allocation, it
+    never degrades to wrong results."""
+    if (out is not None and out.shape == shape and out.dtype == np.float32
+            and out.flags["C_CONTIGUOUS"]):
+        return out
+    return np.empty(shape, np.float32)
 
 
 def compute_mean_image(images: np.ndarray) -> np.ndarray:
@@ -26,14 +43,19 @@ def compute_mean_image(images: np.ndarray) -> np.ndarray:
     return images.astype(np.float64).mean(axis=0).astype(np.float32)
 
 
-def subtract_mean(batch: np.ndarray, mean: np.ndarray | float) -> np.ndarray:
-    return batch.astype(np.float32) - mean
+def subtract_mean(batch: np.ndarray, mean: np.ndarray | float,
+                  out: np.ndarray | None = None) -> np.ndarray:
+    x = np.asarray(batch, np.float32)
+    dest = _take(out, x.shape)
+    np.subtract(x, mean, out=dest)
+    return dest
 
 
 def random_crop_mirror(batch: np.ndarray, crop: int,
                        rng: np.random.Generator,
                        mirror: bool = True,
-                       mean: np.ndarray | float | None = None) -> np.ndarray:
+                       mean: np.ndarray | float | None = None,
+                       out: np.ndarray | None = None) -> np.ndarray:
     """Random crop to (crop, crop) + horizontal mirror
     (DataTransformer train path; ImageNetApp train preprocessing closure).
     Runs through the C++ pipeline when available."""
@@ -47,24 +69,26 @@ def random_crop_mirror(batch: np.ndarray, crop: int,
         # Full-size mean: Caffe's DataTransformer indexes the mean at each
         # sample's crop window (data_transformer.cpp Transform, data_index
         # uses h_off/w_off), i.e. crop(img - mean) — subtract before crop.
-        batch = batch.astype(np.float32) - np.asarray(mean, np.float32)
+        batch = np.asarray(batch, np.float32) - np.asarray(mean, np.float32)
         mean = None
-    return native.crop_batch(batch.astype(np.float32, copy=False), crop,
-                             ys, xs, flips, mean)
+    return native.crop_batch(np.asarray(batch, np.float32), crop,
+                             ys, xs, flips, mean, out=out)
 
 
 def center_crop(batch: np.ndarray, crop: int,
-                mean: np.ndarray | float | None = None) -> np.ndarray:
+                mean: np.ndarray | float | None = None,
+                out: np.ndarray | None = None) -> np.ndarray:
     """Deterministic center crop (test path; ImageNetApp.scala:117-131)."""
-    _, _, h, w = batch.shape
+    n, c, h, w = batch.shape
     y = (h - crop) // 2
     x = (w - crop) // 2
-    out = batch[:, :, y:y + crop, x:x + crop].astype(np.float32)
+    dest = _take(out, (n, c, crop, crop))
+    dest[...] = batch[:, :, y:y + crop, x:x + crop]
     if mean is not None:
         if isinstance(mean, np.ndarray) and mean.shape[-2:] != (crop, crop):
             mean = center_crop_mean(mean, crop)
-        out = out - mean
-    return out
+        np.subtract(dest, mean, out=dest)
+    return dest
 
 
 def center_crop_mean(mean: np.ndarray, crop: int) -> np.ndarray:
@@ -73,6 +97,10 @@ def center_crop_mean(mean: np.ndarray, crop: int) -> np.ndarray:
     return mean[..., y:y + crop, x:x + crop]
 
 
-def scale(batch: np.ndarray, factor: float) -> np.ndarray:
+def scale(batch: np.ndarray, factor: float,
+          out: np.ndarray | None = None) -> np.ndarray:
     """DataTransformer `scale` (e.g. 1/255 for LeNet/MNIST)."""
-    return batch.astype(np.float32) * factor
+    x = np.asarray(batch, np.float32)
+    dest = _take(out, x.shape)
+    np.multiply(x, factor, out=dest)
+    return dest

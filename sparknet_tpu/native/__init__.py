@@ -113,14 +113,20 @@ def decode_cifar(records: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 def crop_batch(batch: np.ndarray, crop: int, ys: np.ndarray, xs: np.ndarray,
                flips: np.ndarray, mean: np.ndarray | float | None = None,
-               ) -> np.ndarray:
+               out: np.ndarray | None = None) -> np.ndarray:
     """Crop+mirror+mean-subtract a f32 NCHW batch (ByteImage.cropInto,
-    batched)."""
+    batched).  ``out``: optional preallocated (n, c, crop, crop) f32
+    C-contiguous result buffer (e.g. from ``pipeline.BufferRing``) —
+    shape/dtype mismatches fall back to a fresh allocation."""
     batch = np.ascontiguousarray(batch, np.float32)
     n, c, h, w = batch.shape
     ys = np.ascontiguousarray(ys, np.int32)
     xs = np.ascontiguousarray(xs, np.int32)
     flips = np.ascontiguousarray(flips, np.int32)
+    if (out is None or out.shape != (n, c, crop, crop)
+            or out.dtype != np.float32
+            or not out.flags["C_CONTIGUOUS"]):
+        out = np.empty((n, c, crop, crop), np.float32)
     mean_arr: np.ndarray | None = None
     if mean is not None:
         m = np.asarray(mean, np.float32)
@@ -131,14 +137,12 @@ def crop_batch(batch: np.ndarray, crop: int, ys: np.ndarray, xs: np.ndarray,
                 np.broadcast_to(m, (c, crop, crop)), np.float32)
     lib = get_lib()
     if lib is None:
-        out = np.empty((n, c, crop, crop), np.float32)
         for i in range(n):
             img = batch[i, :, ys[i]:ys[i] + crop, xs[i]:xs[i] + crop]
             out[i] = img[:, :, ::-1] if flips[i] else img
         if mean_arr is not None:
             out -= (mean_arr if mean_arr.size > 1 else mean_arr[0])
         return out
-    out = np.empty((n, c, crop, crop), np.float32)
     mean_ptr = mean_arr.ctypes.data_as(ctypes.c_void_p) if mean_arr is not None else None
     rc = lib.sn_crop_batch_f32(
         batch.reshape(-1), n, c, h, w, out.reshape(-1), crop, ys, xs, flips,

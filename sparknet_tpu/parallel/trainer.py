@@ -477,6 +477,24 @@ class DistributedTrainer:
         (gradient accumulation, reference: solver.cpp:221-224)."""
         return self.config.tau * self.sp.iter_size
 
+    def input_feed(self, rounds: Iterator[Mapping[str, Any]],
+                   depth: int | None = None, stats=None,
+                   stall_timeout: float | None = None, restarts: int = 1):
+        """Stage a host round stream for this trainer through the
+        parallel feed pipeline (``data.prefetch.device_feed``) with the
+        trainer's ``input_sharding`` — decode/transform/transfer overlap
+        the compiled round, and ``train_round``'s own device_put becomes
+        a no-op.  ``depth`` defaults to ``SPARKNET_FEED_DEPTH`` when set,
+        else 1: a [τ, global_batch, ...] round is large in HBM, so the
+        deep default that suits per-step feeds is opt-in here.  Close the
+        returned feed (context manager) after the loop."""
+        from ..data.pipeline import feed_depth
+        from ..data.prefetch import device_feed
+        return device_feed(rounds,
+                           depth=feed_depth(1) if depth is None else depth,
+                           sharding=self.input_sharding, stats=stats,
+                           stall_timeout=stall_timeout, restarts=restarts)
+
     def train_round(self, batches: Mapping[str, Any]) -> float:
         """Run one round (τ steps, each accumulating iter_size
         micro-batches).  ``batches`` maps input blob names to arrays with a

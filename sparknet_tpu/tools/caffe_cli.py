@@ -170,8 +170,7 @@ def _train_multi(args, sp) -> int:
     # same device_feed path the single-device _train uses); closed after
     # the loop — the producer thread over the endless generator must not
     # outlive training holding staged rounds in HBM
-    from ..data.prefetch import device_feed
-    rounds = device_feed(host_rounds(), sharding=trainer.input_sharding)
+    rounds = trainer.input_feed(host_rounds())
 
     # eval runs on the trainer's shared-definition test net; dedicated
     # test_net definitions have no distributed analog here (the reference

@@ -276,6 +276,12 @@ def main(argv=None) -> int:
                          "declared hung and killed (needs --heartbeat-dir)")
     ap.add_argument("--log-dir", default=None,
                     help="tee each rank's output to rank_<i>.log here")
+    ap.add_argument("--feed-workers", type=int, default=None,
+                    help="decode-pool width per worker (exported as "
+                         "SPARKNET_FEED_WORKERS; 0 = serial feed path)")
+    ap.add_argument("--feed-depth", type=int, default=None,
+                    help="prefetch depth per worker (exported as "
+                         "SPARKNET_FEED_DEPTH)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to run (prefix with --)")
     args = ap.parse_args(argv)
@@ -284,8 +290,20 @@ def main(argv=None) -> int:
         ap.error("no command given")
     if args.round_deadline and not args.heartbeat_dir:
         ap.error("--round-deadline requires --heartbeat-dir")
+    if args.feed_workers is not None and args.feed_workers < 0:
+        ap.error("--feed-workers must be >= 0")
+    if args.feed_depth is not None and args.feed_depth < 1:
+        ap.error("--feed-depth must be >= 1")
+    # feed-pipeline knobs ride the same env contract every other
+    # per-process setting uses (consumed by data.pipeline at feed build)
+    feed_env = {}
+    if args.feed_workers is not None:
+        feed_env["SPARKNET_FEED_WORKERS"] = args.feed_workers
+    if args.feed_depth is not None:
+        feed_env["SPARKNET_FEED_DEPTH"] = args.feed_depth
     health = dict(heartbeat_dir=args.heartbeat_dir,
-                  round_deadline=args.round_deadline, log_dir=args.log_dir)
+                  round_deadline=args.round_deadline, log_dir=args.log_dir,
+                  extra_env=feed_env or None)
     if args.hosts:
         return launch_ssh(cmd, args.hosts.split(","), timeout=args.timeout,
                           **health)

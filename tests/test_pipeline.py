@@ -1,0 +1,406 @@
+"""Parallel feed-pipeline tests: decode-pool ordering and failure
+semantics, serial-vs-parallel bit-identity (clean AND under
+corrupt_record faults — the quarantine accounting must match the serial
+reference exactly), batch-level transform buffers, the decoded-shard LRU
+cache, and the deep device feed (cast + stats)."""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import (
+    BufferRing, DecodePool, DecodeWorkerError, FeedStats, PartitionedDataset,
+    ShardCache,
+)
+from sparknet_tpu.data.db import array_to_datum, db_feed
+from sparknet_tpu.data.integrity import (
+    DataCorruptionError, Quarantine, QuarantinePolicy,
+)
+from sparknet_tpu.data.lmdb_io import write_lmdb
+from sparknet_tpu.models.dsl import layer
+from sparknet_tpu.proto.caffe_pb import Phase
+from sparknet_tpu.utils import faults
+
+
+# ---------------------------------------------------------------------------
+# DecodePool
+# ---------------------------------------------------------------------------
+
+def test_decode_pool_preserves_order_under_parallelism():
+    """Items with adversarial per-item latency must come back in
+    submission order — the whole determinism story rests on this."""
+    def slow_decode(i):
+        time.sleep(0.002 if i % 3 == 0 else 0.0)
+        return i * i
+
+    with DecodePool(slow_decode, workers=4) as pool:
+        out = list(pool.imap(iter(range(50))))
+    assert out == [i * i for i in range(50)]
+
+
+def test_decode_pool_serial_mode_is_threadless():
+    pool = DecodePool(lambda x: x + 1, workers=0)
+    assert pool._threads == []
+    assert list(pool.imap(iter(range(10)))) == list(range(1, 11))
+
+
+def test_decode_pool_exception_surfaces_at_its_ordinal():
+    """A work-function exception must be re-raised at the failing item's
+    position, with good items before AND after still delivered — that is
+    what lets the quarantine admit bad records in pull order."""
+    def decode(i):
+        if i == 3:
+            raise DataCorruptionError("rotten", key=i)
+        return i
+
+    with DecodePool(decode, workers=3) as pool:
+        for i in range(3):
+            pool.submit(i)
+        pool.submit(3)
+        pool.submit(4)
+        assert [pool.result() for _ in range(3)] == [0, 1, 2]
+        with pytest.raises(DataCorruptionError, match="rotten"):
+            pool.result()
+        assert pool.result() == 4
+
+
+def test_decode_pool_worker_crash_is_typed_error_not_hang():
+    """A worker thread that DIES (injected thread kill — distinct from a
+    raising work function) must surface as DecodeWorkerError on the
+    consumer within the liveness poll, never a hang."""
+    release = threading.Event()
+
+    def decode(i):
+        release.wait(5.0)
+        return i
+
+    pool = DecodePool(decode, workers=2)
+    try:
+        pool.submit(0)
+        # kill the pool out from under the in-flight item: close() stops
+        # every worker; the consumer's poll must then raise, not wait
+        for _ in pool._threads:
+            pool._in.put(object())  # noqa: SLF001 — wedge replaced by STOP
+        release.set()
+        pool._closed = True
+        pool.close()
+        t0 = time.monotonic()
+        with pytest.raises(DecodeWorkerError, match="died"):
+            pool.result()
+        assert time.monotonic() - t0 < 5.0, "worker death took too long"
+    finally:
+        release.set()
+        pool.close()
+
+
+def test_decode_pool_imap_source_error_after_drain():
+    """An exception from the SOURCE iterator surfaces after every
+    already-submitted item is yielded (drain-then-fail, the
+    PrefetchIterator contract)."""
+    def src():
+        yield 1
+        yield 2
+        raise ValueError("source died")
+
+    with DecodePool(lambda x: x * 10, workers=2) as pool:
+        it = pool.imap(src())
+        assert next(it) == 10
+        assert next(it) == 20
+        with pytest.raises(ValueError, match="source died"):
+            next(it)
+
+
+# ---------------------------------------------------------------------------
+# db_feed: serial-vs-parallel bit-identity
+# ---------------------------------------------------------------------------
+
+def _write_db(tmp_path, n=48, c=3, h=8, w=8, seed=0):
+    rng = np.random.default_rng(seed)
+    imgs = rng.integers(0, 256, size=(n, c, h, w)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=n)
+    path = str(tmp_path / "lmdb")
+    write_lmdb(path, [(b"%08d" % i, array_to_datum(imgs[i], int(labels[i])))
+                      for i in range(n)])
+    return path
+
+
+def _stream(path, workers, n_batches, phase=Phase.TRAIN, seed=7,
+            quarantine=None, transform=None):
+    lp = layer("d", "Data", [], ["data", "label"],
+               data_param={"source": path, "batch_size": 8,
+                           "backend": "LMDB"},
+               transform_param=transform or {})
+    faults.reset_injector()
+    feed = db_feed(lp, phase, seed=seed, quarantine=quarantine,
+                   workers=workers)
+    out = [next(feed) for _ in range(n_batches)]
+    feed.close()
+    return out
+
+
+@pytest.mark.parametrize("force_per_record", [False, True])
+def test_parallel_stream_bit_identical_to_serial(tmp_path, monkeypatch,
+                                                 force_per_record):
+    """Fixed seed ⇒ the parallel pipeline's batch stream is bit-identical
+    to the serial reference — through the native batch parser AND the
+    per-record pool path (native force-disabled)."""
+    if force_per_record:
+        from sparknet_tpu import native
+        monkeypatch.setattr(native, "parse_datum_batch",
+                            lambda *a, **k: None)
+    path = _write_db(tmp_path)
+    transform = {"crop_size": 6, "mirror": True, "scale": 0.5,
+                 "mean_value": [10.0, 20.0, 30.0]}
+    serial = _stream(path, 0, 12, transform=transform)
+    parallel = _stream(path, 4, 12, transform=transform)
+    for bs, bp in zip(serial, parallel):
+        for k in bs:
+            np.testing.assert_array_equal(bs[k], bp[k])
+            assert bs[k].dtype == bp[k].dtype
+
+
+@pytest.mark.chaos
+def test_parallel_parity_holds_under_corrupt_record_faults(tmp_path,
+                                                           monkeypatch):
+    """With corrupt_record faults active the parallel path must quarantine
+    the SAME records (counts, sources, epoch accounting) and pull the
+    SAME replacements as the serial path — the PR-3 semantics, untouched
+    by parallelism."""
+    monkeypatch.setenv("SPARKNET_FAULT", "corrupt_record:0.15")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    path = _write_db(tmp_path)
+    reports = {}
+    streams = {}
+    for name, workers in (("serial", 0), ("parallel", 4)):
+        q = Quarantine(QuarantinePolicy(max_fraction=0.5), epoch_size=48,
+                       source=path)
+        streams[name] = _stream(path, workers, 10, quarantine=q)
+        reports[name] = q.report()
+    for bs, bp in zip(streams["serial"], streams["parallel"]):
+        for k in bs:
+            np.testing.assert_array_equal(bs[k], bp[k])
+    rs, rp = reports["serial"], reports["parallel"]
+    assert rs["total_bad"] > 0, "fault injection produced no corruption"
+    assert rs == rp
+
+
+def test_worker_crash_in_db_feed_decode_is_typed(tmp_path, monkeypatch):
+    """A non-corruption failure inside decode (a bug, not bad data) must
+    propagate as itself — NOT be eaten by the quarantine, NOT hang."""
+    from sparknet_tpu import native
+    from sparknet_tpu.data import db as db_mod
+    monkeypatch.setattr(native, "parse_datum_batch", lambda *a, **k: None)
+    real = db_mod.datum_to_array
+    calls = {"n": 0}
+
+    def flaky(val, **kw):
+        calls["n"] += 1
+        if calls["n"] == 12:   # past the geometry peek + first records
+            raise RuntimeError("decoder bug, not data rot")
+        return real(val, **kw)
+
+    monkeypatch.setattr(db_mod, "datum_to_array", flaky)
+    path = _write_db(tmp_path)
+    with pytest.raises(RuntimeError, match="decoder bug"):
+        _stream(path, 3, 4)
+
+
+# ---------------------------------------------------------------------------
+# transforms: buffers and copy discipline
+# ---------------------------------------------------------------------------
+
+def test_buffer_ring_rotates_and_restarts_on_shape_change():
+    ring = BufferRing(3)
+    a = ring.take((2, 4))
+    b = ring.take((2, 4))
+    c = ring.take((2, 4))
+    assert a is not b and b is not c
+    assert ring.take((2, 4)) is a          # rotation wraps
+    d = ring.take((3, 3))                  # new shape: new rotation
+    assert d.shape == (3, 3)
+    with pytest.raises(ValueError):
+        BufferRing(1)
+
+
+def test_transformer_batch_writes_into_out_buffer():
+    from sparknet_tpu.data.db import DataTransformer
+    lp = layer("d", "Data", [], ["data"], transform_param={
+        "crop_size": 6, "mean_value": [10.0], "scale": 2.0})
+    imgs = np.random.default_rng(0).integers(
+        0, 256, size=(4, 1, 8, 8)).astype(np.float32)
+    tf = DataTransformer(lp.sub("transform_param"), Phase.TEST)
+    ref = tf.batch(imgs.copy())
+    out = np.empty((4, 1, 6, 6), np.float32)
+    got = tf.batch(imgs.copy(), out=out)
+    assert got is out
+    np.testing.assert_array_equal(got, ref)
+    # the expected math, independently: center-crop(img - mean) * scale
+    manual = (imgs[:, :, 1:7, 1:7] - 10.0) * 2.0
+    np.testing.assert_allclose(ref, manual, rtol=1e-6)
+
+
+def test_transforms_no_copy_when_dtype_matches():
+    from sparknet_tpu.data.minibatch import batch_feed
+    from sparknet_tpu.data.transforms import scale, subtract_mean
+    x = np.ones((2, 3, 4, 4), np.float32)
+    y = np.zeros(2, np.float32)
+    fed = next(batch_feed(iter([(x, y)])))
+    assert fed["data"] is x, "batch_feed copied an already-f32 batch"
+    assert fed["label"] is y
+    out = np.empty_like(x)
+    assert subtract_mean(x, 1.0, out=out) is out
+    assert scale(x, 2.0, out=out) is out
+    # wrong buffer shape degrades to allocation, never to wrong results
+    bad = np.empty((5, 5), np.float32)
+    np.testing.assert_array_equal(subtract_mean(x, 1.0, out=bad), x - 1.0)
+
+
+# ---------------------------------------------------------------------------
+# ShardCache
+# ---------------------------------------------------------------------------
+
+class _CountingPartition:
+    def __init__(self, items):
+        self.items = list(items)
+        self.materializations = 0
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            self.materializations += 1
+        return self.items[idx]
+
+
+def test_shard_cache_decodes_once_per_partition():
+    parts = [_CountingPartition(range(i * 10, i * 10 + 10))
+             for i in range(3)]
+    ds = PartitionedDataset(parts).cached()
+    for _epoch in range(3):
+        for pi in range(3):
+            assert list(ds.partitions[pi]) == list(parts[pi].items)
+    assert [p.materializations for p in parts] == [1, 1, 1]
+
+
+def test_shard_cache_lru_eviction_and_stats():
+    stats = FeedStats()
+    cache = ShardCache(max_shards=2, stats=stats)
+    parts = [_CountingPartition([i]) for i in range(3)]
+    ds = PartitionedDataset(parts).cached(cache=cache)
+    _ = ds.partitions[0][0], ds.partitions[1][0]   # fill: {0, 1}
+    _ = ds.partitions[2][0]                        # evicts 0
+    assert len(cache) == 2
+    _ = ds.partitions[1][0]                        # hit
+    _ = ds.partitions[0][0]                        # miss: re-materialize
+    assert parts[0].materializations == 2
+    assert parts[1].materializations == 1
+    assert cache.hits >= 1 and cache.misses == 4
+    assert stats.snapshot()["cache_misses"] == 4
+
+
+# ---------------------------------------------------------------------------
+# device feed: cast, stats, lifecycle
+# ---------------------------------------------------------------------------
+
+def test_device_feed_casts_on_device_and_counts_stats():
+    import jax.numpy as jnp
+
+    from sparknet_tpu.data import device_feed
+    host = [{"data": np.full((2, 3), i, np.uint8),
+             "label": np.ones(2, np.float32)} for i in range(5)]
+    stats = FeedStats()
+    with device_feed(iter(host), depth=2,
+                     device_cast={"data": jnp.float32},
+                     stats=stats) as feed:
+        got = list(feed)
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        assert b["data"].dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(b["data"]),
+                                      np.full((2, 3), i, np.float32))
+    snap = stats.snapshot()
+    assert snap["batches"] == 5
+    assert snap["device_put_s"] > 0.0
+
+
+def test_device_feed_depth_env_default(monkeypatch):
+    from sparknet_tpu.data import device_feed, feed_depth
+    monkeypatch.setenv("SPARKNET_FEED_DEPTH", "6")
+    assert feed_depth() == 6
+    feed = device_feed(iter([{"x": np.zeros(1, np.float32)}]))
+    assert feed._pf._q.maxsize == 6
+    feed.close()
+    monkeypatch.setenv("SPARKNET_FEED_DEPTH", "0")
+    with pytest.raises(ValueError, match="SPARKNET_FEED_DEPTH"):
+        device_feed(iter([]))
+
+
+def test_device_feed_source_error_propagates():
+    from sparknet_tpu.data import device_feed
+
+    def bad():
+        yield {"x": np.zeros(2, np.float32)}
+        raise RuntimeError("feed source exploded")
+
+    with device_feed(bad(), depth=1) as feed:
+        next(feed)
+        with pytest.raises(RuntimeError, match="feed source exploded"):
+            next(feed)
+
+
+def test_feed_workers_env_knob(monkeypatch):
+    from sparknet_tpu.data import feed_workers
+    monkeypatch.setenv("SPARKNET_FEED_WORKERS", "3")
+    assert feed_workers() == 3
+    monkeypatch.setenv("SPARKNET_FEED_WORKERS", "0")
+    assert feed_workers() == 0
+    monkeypatch.setenv("SPARKNET_FEED_WORKERS", "-1")
+    with pytest.raises(ValueError):
+        feed_workers()
+    monkeypatch.delenv("SPARKNET_FEED_WORKERS")
+    assert feed_workers(default=5) == 5
+
+
+def test_launcher_exports_feed_knobs(monkeypatch):
+    """--feed-workers/--feed-depth ride the child env contract."""
+    import sparknet_tpu.tools.launch as launch
+    seen = {}
+
+    def fake_local(cmd, nprocs, **kw):
+        seen.update(kw)
+        return 0
+
+    monkeypatch.setattr(launch, "launch_local", fake_local)
+    assert launch.main(["--nprocs", "2", "--feed-workers", "4",
+                        "--feed-depth", "8", "--", "true"]) == 0
+    assert seen["extra_env"] == {"SPARKNET_FEED_WORKERS": 4,
+                                 "SPARKNET_FEED_DEPTH": 8}
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 feed-parity smoke (fast, non-slow): tools/feedbench.py
+# ---------------------------------------------------------------------------
+
+def test_feedbench_smoke_parity(tmp_path, monkeypatch):
+    """The CI gate's own logic, on a tiny budget: serial vs parallel must
+    report parity ok (this is the in-tree smoke of the SPARKNET_FEEDBENCH
+    gate in tools/run_tier1.sh)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "feedbench", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "feedbench.py"))
+    fb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fb)
+    out = tmp_path / "verdict.json"
+    rc = fb.main(["--seconds", "0.4", "--records", "64", "--batch", "16",
+                  "--workers", "2", "--out", str(out)])
+    assert rc == 0
+    import json
+    verdict = json.loads(out.read_text())
+    assert verdict["ok"] is True
+    assert verdict["batches"] > 0

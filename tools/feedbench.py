@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Serial-vs-parallel feed microbench + parity gate (the CI teeth of the
+parallel input pipeline).
+
+Builds a small synthetic LMDB, then streams the SAME batches through
+``db_feed`` twice — once on the serial reference path (``workers=0``) and
+once through the decode pool — and verifies the parallel stream is
+bit-identical: same pixels, same labels, and (with ``--corrupt``) the same
+quarantine accounting (same records quarantined, same replacement pulls).
+Any divergence is a correctness regression in the pipeline's ordering
+guarantees and fails the run (exit 1).
+
+Wall time is bounded (default ~2 s): the serial leg runs until its time
+budget, the parallel leg replays the same batch count — parity needs equal
+streams, not equal durations.  Prints ONE JSON verdict line on stdout.
+
+Usage:
+  python tools/feedbench.py [--seconds 2] [--batch 32] [--records 256]
+                            [--workers N] [--corrupt] [--out FILE]
+Wired into tools/run_tier1.sh behind SPARKNET_FEEDBENCH=1 (or --feedbench).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_db(path: str, n: int, shape=(3, 16, 16), seed: int = 0) -> None:
+    from sparknet_tpu.data.db import array_to_datum
+    from sparknet_tpu.data.lmdb_io import write_lmdb
+    rng = np.random.default_rng(seed)
+    imgs = rng.integers(0, 256, size=(n,) + shape).astype(np.uint8)
+    labels = rng.integers(0, 10, size=n)
+    write_lmdb(path, [(b"%08d" % i, array_to_datum(imgs[i], int(labels[i])))
+                      for i in range(n)])
+
+
+def run_leg(path: str, batch: int, workers: int, n_batches: int | None,
+            seconds: float, seed: int, records: int = 0) -> dict:
+    """Stream batches off one fresh db_feed; returns arrays + quarantine
+    report + throughput.  Bounded by ``n_batches`` when given (the parity
+    replay), else by the time budget."""
+    from sparknet_tpu.data.db import db_feed
+    from sparknet_tpu.data.integrity import Quarantine, QuarantinePolicy
+    from sparknet_tpu.data.pipeline import FeedStats
+    from sparknet_tpu.models.dsl import layer
+    from sparknet_tpu.proto.caffe_pb import Phase
+    from sparknet_tpu.utils import faults
+
+    faults.reset_injector()   # each leg re-arms one-shot fault state
+    lp = layer("d", "Data", [], ["data", "label"],
+               data_param={"source": path, "batch_size": batch,
+                           "backend": "LMDB"},
+               transform_param={"scale": 0.5, "mean_value": [16.0]})
+    quarantine = Quarantine(QuarantinePolicy(max_fraction=0.5),
+                            epoch_size=records or None, source=path)
+    stats = FeedStats()
+    feed = db_feed(lp, Phase.TRAIN, seed=seed, quarantine=quarantine,
+                   workers=workers, stats=stats)
+    batches = []
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    while (len(batches) < n_batches if n_batches is not None
+           else time.perf_counter() < deadline):
+        b = next(feed)
+        # copy: db_feed may rotate/reuse buffers; the parity compare
+        # holds every batch at once
+        batches.append({k: np.array(v) for k, v in b.items()})
+    dt = time.perf_counter() - t0
+    feed.close()
+    images = sum(b["data"].shape[0] for b in batches)
+    return {"batches": batches, "quarantine": quarantine.report(),
+            "stats": stats.snapshot(), "seconds": round(dt, 3),
+            "img_s": round(images / dt, 1) if dt > 0 else 0.0}
+
+
+def compare(serial: dict, parallel: dict) -> list[str]:
+    errs = []
+    a, b = serial["batches"], parallel["batches"]
+    if len(a) != len(b):
+        return [f"batch count mismatch: serial {len(a)} vs parallel "
+                f"{len(b)}"]
+    for i, (x, y) in enumerate(zip(a, b)):
+        for k in x:
+            if not np.array_equal(x[k], y[k]):
+                errs.append(f"batch {i} key {k!r} differs "
+                            f"(max abs diff "
+                            f"{np.abs(x[k] - y[k]).max():.3g})")
+    qa, qb = dict(serial["quarantine"]), dict(parallel["quarantine"])
+    for q in (qa, qb):   # examples carry reprs; counts are the contract
+        q.pop("examples", None)
+    if qa != qb:
+        errs.append(f"quarantine accounting differs: serial {qa} vs "
+                    f"parallel {qb}")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="wall budget for the serial leg (default 2)")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--records", type=int, default=256)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="parallel-leg pool width (default "
+                         "SPARKNET_FEED_WORKERS, min 2 so the pool is "
+                         "actually exercised)")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="run with corrupt_record:0.1 fault injection — "
+                         "parity must hold through the quarantine path")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.corrupt:
+        os.environ["SPARKNET_FAULT"] = "corrupt_record:0.1"
+        os.environ["SPARKNET_FAULT_ATTEMPT"] = "0"
+
+    from sparknet_tpu.data.pipeline import feed_workers
+    workers = args.workers if args.workers is not None \
+        else max(2, feed_workers())
+
+    with tempfile.TemporaryDirectory() as tmp:
+        db = os.path.join(tmp, "lmdb")
+        build_db(db, args.records, seed=args.seed)
+        serial = run_leg(db, args.batch, 0, None, args.seconds / 2,
+                         args.seed, records=args.records)
+        parallel = run_leg(db, args.batch, workers,
+                           len(serial["batches"]), args.seconds, args.seed,
+                           records=args.records)
+    errs = compare(serial, parallel)
+    verdict = {
+        "metric": "feed_parity",
+        "ok": not errs,
+        "errors": errs,
+        "batches": len(serial["batches"]),
+        "batch": args.batch,
+        "workers": workers,
+        "corrupt": bool(args.corrupt),
+        "serial_img_s": serial["img_s"],
+        "parallel_img_s": parallel["img_s"],
+        "speedup": round(parallel["img_s"] / serial["img_s"], 2)
+        if serial["img_s"] else None,
+        "quarantined": serial["quarantine"]["total_bad"],
+    }
+    line = json.dumps(verdict)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if errs:
+        for e in errs:
+            print(f"feedbench: PARITY FAIL: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

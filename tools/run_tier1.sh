@@ -36,10 +36,27 @@ maybe_soak() {
   fi
 }
 
+# ~2-second serial-vs-parallel feed microbench (tools/feedbench.py) —
+# opt-in via SPARKNET_FEEDBENCH=1.  Fails the gate on any parity
+# mismatch: the parallel pipeline must be bit-identical to the serial
+# reference, including quarantine accounting under corrupt_record
+# faults.  (A fast in-tree smoke of the same parity contract always
+# runs inside tier-1: tests/test_pipeline.py.)
+maybe_feedbench() {
+  if [ "${SPARKNET_FEEDBENCH:-}" = "1" ]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      python tools/feedbench.py --seconds 2 --out /tmp/_feedbench.json \
+      && timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python tools/feedbench.py --seconds 2 --corrupt \
+          --out /tmp/_feedbench_corrupt.json
+  fi
+}
+
 case "${1:-}" in
   --chaos) run_chaos ;;
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
-  --all)   run_tier1 && run_chaos && maybe_soak ;;
-  "")      run_tier1 && maybe_soak ;;
-  *) echo "usage: $0 [--chaos|--soak|--all]" >&2; exit 2 ;;
+  --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
+  --all)   run_tier1 && run_chaos && maybe_soak && maybe_feedbench ;;
+  "")      run_tier1 && maybe_soak && maybe_feedbench ;;
+  *) echo "usage: $0 [--chaos|--soak|--feedbench|--all]" >&2; exit 2 ;;
 esac
