@@ -1,0 +1,151 @@
+"""Drive the PR-5 zero-stall outer loop surfaces end-to-end (CPU mesh).
+
+Run from the repo root: python .drive_r10.py   -> expect "DRIVE OK".
+
+Flows: (1) pipelined trainer (harvest_lag=2 + async ckpt writer) with
+ckpt+guard+audit all on is bit-identical to the synchronous loop and
+shrinks per-round host stalls; (2) a deferred guard trip (nan_inject
+harvested 2 rounds late) rolls back + replays to the fault-free result
+bit-for-bit; (3) crash_in_ckpt on the WRITER thread leaves the torn
+window (npz durable, no manifest) and resume skips the orphan;
+(4) SPARKNET_ASYNC_CKPT=0 escape hatch restores synchronous durability;
+(5) bench round_overhead leg emits the stall JSON (BENCH_r06-ready).
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from sparknet_tpu.models import lenet
+from sparknet_tpu.parallel import DistributedTrainer, TrainerConfig, make_mesh
+from sparknet_tpu.proto import load_solver_prototxt_with_net
+from sparknet_tpu.utils import faults
+
+SP = 'base_lr: 0.005\nmomentum: 0.9\nlr_policy: "fixed"\n'
+
+
+def make(d, lag, **kw):
+    cfg = TrainerConfig(strategy="local_sgd", tau=2, checkpoint_dir=d,
+                        checkpoint_keep=4, harvest_lag=lag, **kw)
+    return DistributedTrainer(load_solver_prototxt_with_net(SP, lenet(16, 16)),
+                              make_mesh(4), cfg, seed=0)
+
+
+def batch(r):
+    rng = np.random.default_rng(100 + r)
+    return {"data": rng.normal(size=(2, 16, 1, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(2, 16)).astype(np.float32)}
+
+
+def run(d, lag, rounds=5, **kw):
+    tr = make(d, lag, **kw)
+    while tr.round < rounds:
+        tr.train_round(batch(tr.round))
+    losses = tr.drain()
+    while tr.round < rounds:      # a drain-trip rewinds; replay
+        while tr.round < rounds:
+            tr.train_round(batch(tr.round))
+        losses = tr.drain()
+    return tr, losses
+
+
+# 1) parity: sync vs pipelined with every safety feature on
+with tempfile.TemporaryDirectory() as d1, tempfile.TemporaryDirectory() as d2:
+    sync, sl = run(d1, 0, guard_numerics=True, audit_every=1)
+    pipe, pl = run(d2, 2, guard_numerics=True, audit_every=1)
+    assert [pl[r] for r in range(5)] == [sl[r] for r in range(5)], "losses"
+    np.testing.assert_array_equal(np.asarray(sync.params["conv1"][0]),
+                                  np.asarray(pipe.params["conv1"][0]))
+    s_stall = sum(sync.stall_s.values())
+    p_stall = sum(pipe.stall_s.values())
+    assert p_stall < s_stall, (s_stall, p_stall)
+    print(f"1) parity: 5 rounds bit-identical; host stall "
+          f"{s_stall:.3f}s sync -> {p_stall:.3f}s pipelined")
+
+# 2) deferred guard trip bit-for-bit vs fault-free
+with tempfile.TemporaryDirectory() as d1, tempfile.TemporaryDirectory() as d2:
+    clean, cl = run(d1, 0, guard_numerics=True)
+    os.environ["SPARKNET_FAULT"] = "nan_inject@round:2"
+    faults.reset_injector()
+    tr, losses = run(d2, 2, guard_numerics=True)
+    os.environ.pop("SPARKNET_FAULT")
+    faults.reset_injector()
+    assert tr.guard_trips == 1
+    assert [losses[r] for r in range(5)] == [cl[r] for r in range(5)]
+    np.testing.assert_array_equal(np.asarray(tr.params["ip2"][0]),
+                                  np.asarray(clean.params["ip2"][0]))
+    print("2) guard trip harvested 2 rounds late: rollback+replay "
+          "bit-for-bit vs fault-free")
+
+# 3) crash_in_ckpt on the writer thread: torn window + resume skips orphan
+with tempfile.TemporaryDirectory() as d:
+    os.environ["SPARKNET_FAULT"] = "crash_in_ckpt@round:2"
+    faults.reset_injector()
+
+    class _Killed(BaseException):
+        pass
+
+    inj = faults.get_injector()
+    inj._exit = lambda code: (_ for _ in ()).throw(_Killed())
+    tr = make(d, 0)
+    tr.train_round(batch(0))
+    tr.train_round(batch(1))
+    try:
+        tr.flush_checkpoints()
+        raise AssertionError("writer crash did not surface at flush")
+    except _Killed:
+        pass
+    names = set(os.listdir(d))
+    assert "ckpt_round_00000002.npz" in names
+    assert "manifest_00000002.json" not in names
+    os.environ["SPARKNET_FAULT_ATTEMPT"] = "1"
+    faults.reset_injector()
+    tr2 = make(d, 0)
+    assert tr2.resumed is not None and tr2.round == 1
+    os.environ.pop("SPARKNET_FAULT")
+    os.environ.pop("SPARKNET_FAULT_ATTEMPT")
+    faults.reset_injector()
+    print("3) crash_in_ckpt on writer thread: npz orphaned, no manifest, "
+          "error at flush, resume lands round 1")
+
+# 4) escape hatch: synchronous durability, no writer thread
+with tempfile.TemporaryDirectory() as d:
+    os.environ["SPARKNET_ASYNC_CKPT"] = "0"
+    tr = make(d, 0)
+    tr.train_round(batch(0))
+    assert tr._ckpt_writer is None
+    assert "manifest_00000001.json" in os.listdir(d)
+    os.environ.pop("SPARKNET_ASYNC_CKPT")
+    print("4) SPARKNET_ASYNC_CKPT=0: durable before return, no writer")
+
+# 5) bench round_overhead leg emits BENCH_r06-ready JSON
+env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_MODEL="lenet",
+           BENCH_BATCH="16", BENCH_ITERS="2", BENCH_REPS="1",
+           BENCH_WINDOWS="1", BENCH_DTYPE="f32", BENCH_FEED="0",
+           BENCH_ROUND_N="2", BENCH_ROUND_TAU="2", BENCH_ROUND_BATCH="16")
+env.pop("XLA_FLAGS", None)
+out = subprocess.run([sys.executable, "bench.py", "--child"],
+                     capture_output=True, timeout=500, env=env,
+                     cwd=os.path.dirname(os.path.abspath(__file__)))
+assert out.returncode == 0, out.stderr.decode()[-500:]
+rec = json.loads(out.stdout.decode().strip().splitlines()[-1])
+ro = rec["round_overhead"]
+assert {"bare", "sync", "async", "stall_reduction_x"} <= set(ro), ro
+assert ro["sync"]["stall_total_s_per_round"] > 0
+print(f"5) bench round_overhead: sync stall "
+      f"{ro['sync']['stall_total_s_per_round']}s/round -> async "
+      f"{ro['async']['stall_total_s_per_round']}s/round "
+      f"({ro['stall_reduction_x']}x)")
+
+print("DRIVE OK")

@@ -32,7 +32,10 @@ Env knobs (for smoke-testing): BENCH_PLATFORM=cpu, BENCH_MODEL=lenet,
 BENCH_BATCH, BENCH_ITERS, BENCH_REPS, BENCH_TIMEOUT_S, BENCH_ATTEMPTS,
 BENCH_DTYPE=f32|bf16 (restrict to one compute dtype); feed tier:
 BENCH_FEED_BATCH, BENCH_FEED_ITERS, BENCH_FEED_DELAY_S (per-batch host
-decode stand-in, see measure_feed).
+decode stand-in, see measure_feed); round-overhead tier (outer-loop
+host stalls with ckpt+guard+audit on, sync vs async — see
+measure_round_overhead): BENCH_ROUND=0 to skip, BENCH_ROUND_N/_TAU/
+_LAG/_BATCH/_EVERY.
 """
 
 from __future__ import annotations
@@ -348,6 +351,99 @@ def run_child() -> None:
              f"depth {depth})")
         return out
 
+    def measure_round_overhead() -> dict:
+        """The zero-stall-outer-loop leg: training throughput with every
+        safety feature enabled (round checkpointing + numerics guard +
+        cross-replica audit) vs bare rounds, for the SYNCHRONOUS outer
+        loop (every round blocks on the loss fetch, the finite-check,
+        the audit fingerprint, and the checkpoint write) vs the ASYNC
+        one (AsyncCheckpointWriter + TrainerConfig.harvest_lag round
+        pipelining).  The compiled round is identical across legs — the
+        difference is pure host bookkeeping, which is exactly what this
+        leg isolates.  Per-component stall seconds come straight from
+        ``DistributedTrainer.stall_s`` (loss_fetch / finite_check /
+        audit_fetch / checkpoint), so BENCH_r* files record WHERE the
+        between-round time goes and by how much the async loop shrinks
+        it.  Runs f32 (DistributedTrainer is the f32 outer-loop path);
+        the overhead ratios are dtype-independent.  Knobs:
+        BENCH_ROUND_N (timed rounds), BENCH_ROUND_TAU, BENCH_ROUND_LAG,
+        BENCH_ROUND_BATCH, BENCH_ROUND_EVERY (checkpoint cadence);
+        BENCH_ROUND=0 skips the leg."""
+        import tempfile
+
+        from sparknet_tpu.parallel import (
+            DistributedTrainer, TrainerConfig, make_mesh,
+        )
+
+        rounds_n = int(os.environ.get("BENCH_ROUND_N", 4))
+        tau = int(os.environ.get("BENCH_ROUND_TAU", 4))
+        lag = int(os.environ.get("BENCH_ROUND_LAG", 2))
+        rbatch = int(os.environ.get("BENCH_ROUND_BATCH", BATCH))
+        every = int(os.environ.get("BENCH_ROUND_EVERY", 2))
+        mesh = make_mesh()
+        feed = {"data": rng.normal(size=(tau, rbatch) + in_shape
+                                   ).astype(np.float32),
+                "label": rng.integers(0, classes, size=(tau, rbatch)
+                                      ).astype(np.float32)}
+        # retention must cover the harvest lag (TrainerConfig validates)
+        keep = max(3, (lag + 1 + every - 1 + every - 1) // every + 1)
+
+        def leg(name: str, async_on: bool, instrumented: bool) -> dict:
+            saved = os.environ.get("SPARKNET_ASYNC_CKPT")
+            os.environ["SPARKNET_ASYNC_CKPT"] = "1" if async_on else "0"
+            try:
+                with tempfile.TemporaryDirectory() as ck:
+                    cfg = TrainerConfig(
+                        strategy="local_sgd", tau=tau,
+                        harvest_lag=lag if async_on else 0,
+                        checkpoint_dir=ck if instrumented else None,
+                        checkpoint_every=every, checkpoint_keep=keep,
+                        guard_numerics=instrumented,
+                        audit_every=1 if instrumented else 0)
+                    tr = DistributedTrainer(sp, mesh, cfg, seed=0)
+                    tr.train_round(feed)   # compile + warmup
+                    tr.drain()
+                    tr.stall_s = {k: 0.0 for k in tr.stall_s}
+                    t0 = time.perf_counter()
+                    for _ in range(rounds_n):
+                        tr.train_round(feed)
+                    tr.drain()
+                    dt = time.perf_counter() - t0
+            finally:
+                if saved is None:
+                    os.environ.pop("SPARKNET_ASYNC_CKPT", None)
+                else:
+                    os.environ["SPARKNET_ASYNC_CKPT"] = saved
+            stalls = {k: round(v / rounds_n, 4)
+                      for k, v in tr.stall_s.items()}
+            out = {"img_s": round(rbatch * tau * rounds_n / dt, 1),
+                   "round_s": round(dt / rounds_n, 4),
+                   "stall_s_per_round": stalls,
+                   "stall_total_s_per_round": round(sum(stalls.values()),
+                                                    4)}
+            _log(f"round_overhead[{name}]: {out['img_s']} img/s "
+                 f"({out['round_s']}s/round, stalls {stalls})")
+            return out
+
+        bare = leg("bare", async_on=True, instrumented=False)
+        sync = leg("sync", async_on=False, instrumented=True)
+        async_ = leg("async", async_on=True, instrumented=True)
+        return {
+            "batch": rbatch, "tau": tau, "rounds": rounds_n,
+            "harvest_lag": lag, "checkpoint_every": every,
+            "workers": mesh.shape["data"], "dtype": "f32",
+            "bare": bare, "sync": sync, "async": async_,
+            "sync_overhead_pct": round(
+                (sync["round_s"] - bare["round_s"])
+                / bare["round_s"] * 100, 1),
+            "async_overhead_pct": round(
+                (async_["round_s"] - bare["round_s"])
+                / bare["round_s"] * 100, 1),
+            "stall_reduction_x": round(
+                sync["stall_total_s_per_round"]
+                / max(async_["stall_total_s_per_round"], 1e-6), 1),
+        }
+
     dtypes = [DTYPE] if DTYPE in ("f32", "bf16") else ["bf16", "f32"]
     runs = {d: measure(d) for d in dtypes}
     best = max(dtypes, key=lambda d: runs[d]["images_per_sec"])
@@ -359,6 +455,13 @@ def run_child() -> None:
         except Exception as e:  # the feed tier must not sink the bench
             _log(f"feed measurement failed: {e}")
             feed = {"error": str(e)}
+    round_overhead = None
+    if os.environ.get("BENCH_ROUND", "1") != "0":
+        try:
+            round_overhead = measure_round_overhead()
+        except Exception as e:  # this tier must not sink the bench either
+            _log(f"round_overhead measurement failed: {e}")
+            round_overhead = {"error": str(e)}
     result = {
         "metric": f"{MODEL}_train_images_per_sec",
         "value": b["images_per_sec"],
@@ -382,6 +485,7 @@ def run_child() -> None:
         "windows": windows,
         "by_dtype": runs,
         "feed_in_loop": feed,
+        "round_overhead": round_overhead,
     }
     print(json.dumps(result), flush=True)
 
@@ -419,7 +523,10 @@ _CONFIG_ENVS = ("BENCH_PLATFORM", "BENCH_MODEL", "BENCH_BATCH",
                 "BENCH_DTYPE", "BENCH_SCAN", "BENCH_FEED_BATCH",
                 "BENCH_FEED_ITERS", "BENCH_FEED_DELAY_S",
                 "BENCH_FEED_U8", "SPARKNET_FEED_WORKERS",
-                "SPARKNET_FEED_DEPTH", "SPARKNET_FEED_PUTTERS")
+                "SPARKNET_FEED_DEPTH", "SPARKNET_FEED_PUTTERS",
+                "BENCH_ROUND_N", "BENCH_ROUND_TAU", "BENCH_ROUND_LAG",
+                "BENCH_ROUND_BATCH", "BENCH_ROUND_EVERY",
+                "SPARKNET_ASYNC_CKPT")
 
 
 def _save_last_good(result: dict) -> None:

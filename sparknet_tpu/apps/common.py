@@ -177,6 +177,10 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
             trainer.snapshot(snapshot_path)
             log.log(f"snapshot ({reason}) -> {snapshot_path}")
 
+    # pipelined trainers (TrainerConfig.harvest_lag > 0) keep rounds in
+    # flight; drain() is the barrier that settles every deferred
+    # guard/audit verdict and async checkpoint write — required before
+    # an eval (params must be validated state) and before returning
     with round_iter, SignalGuard() as guard:
         for r in range(rounds):
             action = guard.check()
@@ -186,9 +190,11 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
                 why = ("SIGTERM/preemption"
                        if action == SolverAction.SNAPSHOT_STOP else "SIGINT")
                 log.log(f"stop requested ({why}); halting at round boundary")
+                trainer.drain()
                 maybe_snapshot("stop")
                 return last_scores
             if test_interval and r % test_interval == 0 and r > 0:
+                trainer.drain()
                 log.log("testing")
                 totals = trainer.test(test_factory(), test_steps)
                 last_scores = normalize_scores(totals, test_steps)
@@ -198,6 +204,7 @@ def run_training(trainer: DistributedTrainer, feed: RoundFeed,
             loss = trainer.train_round(batches)
             log.log(f"round {r}: tau={trainer.config.tau} "
                     f"loss={loss:.4f} ({time.perf_counter() - t0:.2f}s)")
+    trainer.drain()
     totals = trainer.test(test_factory(), test_steps)
     last_scores = normalize_scores(totals, test_steps)
     log.log(f"final eval: {last_scores}")

@@ -12,7 +12,13 @@ watches the worker set, and on any nonzero exit relaunches the WHOLE job
 with jittered exponential backoff under a bounded restart budget.
 Recovery is round-granular: the relaunched job finds the newest valid
 checkpoint manifest on disk (``DistributedTrainer``'s ``checkpoint_dir``
-auto-resume) and replays from that round boundary.
+auto-resume) and replays from that round boundary.  This holds under
+the zero-stall outer loop too: async checkpoint writes keep the
+tmp+rename/manifest-checksum layout, so a worker killed mid-background-
+write leaves an orphan the resume scan skips, and with a harvest lag of
+K a crash can additionally cost the up-to-K rounds whose verdicts were
+still in flight — bounded by the same retention the trainer validates
+at init (``TrainerConfig.harvest_lag``).
 
 **Re-form (elastic degraded mode)** — SparkNet's parameter average over
 k-1 workers is still a valid consensus, so a job whose restart budget is

@@ -40,6 +40,7 @@ averages weights after it (per-worker optimizer states).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import glob
 import hashlib
@@ -47,6 +48,7 @@ import inspect
 import json
 import os
 import sys
+import time
 from typing import Any, Callable, Iterator, Mapping
 
 import jax
@@ -152,6 +154,29 @@ class TrainerConfig:
     # there; sync/hierarchical keep per-replica divergence resident, so
     # a coarser cadence still detects.
     audit_every: int = 0
+    # Zero-stall outer loop: with ``harvest_lag`` K > 0 the loss, the
+    # guard's finite-check verdict, and the audit fingerprints stay
+    # ON-DEVICE as futures and are harvested up to K rounds late, so the
+    # host never synchronizes with the steady-state round — up to K
+    # compiled rounds stay in flight (round pipelining) while host
+    # bookkeeping overlaps device compute.  Safety semantics are
+    # unchanged, only deferred: a guard/audit trip detected while
+    # harvesting round r rolls back to a checkpoint at round <= r (the
+    # same exact-RNG replay path), and every in-flight round after r is
+    # discarded and replayed.  Checkpoint retention must therefore cover
+    # the lag (validated at init: K more rounds may complete before a
+    # poison is detected, so the pre-poison checkpoint must outlive
+    # them).  0 = today's fully synchronous behavior, bit-identical.
+    harvest_lag: int = 0
+    # Async checkpointing: round checkpoints snapshot with a
+    # NON-BLOCKING device→host copy and serialize/checksum/rename on a
+    # background writer thread (utils.checkpoint.AsyncCheckpointWriter),
+    # preserving the tmp+rename crash-safety, manifest checksums,
+    # pruning, and orphan-tmp sweep byte-for-byte.  Rollback, resume,
+    # preemption and fault-injection windows flush the writer first, so
+    # recovery semantics are exact.  ``SPARKNET_ASYNC_CKPT=0`` overrides
+    # to the synchronous path regardless of this field.
+    async_checkpoint: bool = True
 
 
 class TrainingDivergedError(RuntimeError):
@@ -288,6 +313,19 @@ class DistributedTrainer:
         self.audit_trips = 0
         self._audit_fn = None
         self._last_audit_ok = 0
+        # -- zero-stall outer loop state: in-flight rounds awaiting
+        # harvest (device futures: loss, finite verdict, audit
+        # fingerprints), per-round harvested losses, the async checkpoint
+        # writer (lazy), and per-component host-stall accounting that
+        # bench.py's round_overhead leg reads
+        self._pending: collections.deque = collections.deque()
+        self.round_losses: dict[int, float] = {}
+        self._ckpt_writer = None
+        self.stall_s = {"loss_fetch": 0.0, "finite_check": 0.0,
+                        "audit_fetch": 0.0, "checkpoint": 0.0}
+        if self.config.harvest_lag < 0:
+            raise ValueError(
+                f"harvest_lag must be >= 0, got {self.config.harvest_lag}")
         if self.config.checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got "
@@ -314,6 +352,28 @@ class DistributedTrainer:
                     f"{self.config.checkpoint_keep} - 1) = {horizon} "
                     f"rounds): by the time a mismatch is detected, every "
                     f"pre-divergence checkpoint may be pruned")
+        if self.config.harvest_lag and (self.config.guard_numerics
+                                        or self.config.audit_every):
+            # retention-vs-lag: a poison at round r surfaces up to
+            # harvest_lag rounds later (plus up to audit_every rounds of
+            # audit cadence), during which fresh checkpoints keep landing
+            # and pruning keeps trimming — the newest pre-poison
+            # checkpoint (within checkpoint_every-1 rounds of r) must
+            # still be on disk when the trip finally asks for it
+            horizon = (self.config.checkpoint_every
+                       * max(self.config.checkpoint_keep - 1, 0))
+            need = (self.config.harvest_lag + self.config.audit_every
+                    + self.config.checkpoint_every - 1)
+            if horizon < need:
+                raise ValueError(
+                    f"harvest_lag={self.config.harvest_lag} outruns the "
+                    f"checkpoint retention (checkpoint_every="
+                    f"{self.config.checkpoint_every} x (checkpoint_keep="
+                    f"{self.config.checkpoint_keep} - 1) = {horizon} < "
+                    f"{need} rounds of detection latency): by the time a "
+                    f"deferred guard/audit verdict trips, every "
+                    f"pre-poison checkpoint may be pruned — raise "
+                    f"checkpoint_keep or lower harvest_lag")
         if self.config.checkpoint_dir:
             self.resumed = self.resume_latest(self.config.checkpoint_dir)
             if ((self.config.guard_numerics or self.config.audit_every)
@@ -485,13 +545,17 @@ class DistributedTrainer:
         trainer's ``input_sharding`` — decode/transform/transfer overlap
         the compiled round, and ``train_round``'s own device_put becomes
         a no-op.  ``depth`` defaults to ``SPARKNET_FEED_DEPTH`` when set,
-        else 1: a [τ, global_batch, ...] round is large in HBM, so the
-        deep default that suits per-step feeds is opt-in here.  Close the
-        returned feed (context manager) after the loop."""
+        else ``harvest_lag + 1``: a [τ, global_batch, ...] round is large
+        in HBM, so the deep default that suits per-step feeds is opt-in
+        here — but a pipelined loop (``harvest_lag`` K > 0) keeps K
+        compiled rounds in flight and needs that many staged feeds to
+        never be the bottleneck.  Close the returned feed (context
+        manager) after the loop."""
         from ..data.pipeline import feed_depth
         from ..data.prefetch import device_feed
-        return device_feed(rounds,
-                           depth=feed_depth(1) if depth is None else depth,
+        if depth is None:
+            depth = feed_depth(max(1, self.config.harvest_lag + 1))
+        return device_feed(rounds, depth=depth,
                            sharding=self.input_sharding, stats=stats,
                            stall_timeout=stall_timeout, restarts=restarts)
 
@@ -510,7 +574,18 @@ class DistributedTrainer:
         rolls the trainer back to the newest valid checkpoint and the
         round is DROPPED — ``self.round`` does not advance, so a
         ``while trainer.round < rounds`` driver naturally replays it.
-        The (poisoned) loss is still returned for logging."""
+        The (poisoned) loss is still returned for logging.
+
+        With ``harvest_lag`` K > 0 this call is free of host
+        synchronization in the steady state: the loss/guard/audit
+        results stay on-device and are harvested once K rounds are in
+        flight, so the return value is the loss of a round up to K
+        behind (``float('nan')`` until the first harvest; exact
+        per-round losses accumulate in ``self.round_losses``).  A trip
+        detected at harvest rolls back exactly as the synchronous path
+        does — same checkpoint chain, same RNG replay — and discards
+        every in-flight round after the poisoned one.  Call ``drain()``
+        before reading final params/scores."""
         from . import health
         from ..utils import faults
         expect = self.batches_per_round
@@ -526,6 +601,7 @@ class DistributedTrainer:
                     f"{k}: batch {v.shape[1]} not divisible by "
                     f"{local_workers} local workers")
         round_idx = self.round
+        lag = self.config.harvest_lag
         health.maybe_beat(round_idx, "round_start")
         # deterministic chaos hook: rot one replica's resident param copy
         # (a flipped HBM bit between rounds — the event the audit exists
@@ -536,15 +612,27 @@ class DistributedTrainer:
                   f"params at round {round_idx}", file=sys.stderr,
                   flush=True)
             self._inject_bitflip(flip)
+        audit_fps = None
         if (self.config.audit_every
                 and round_idx % self.config.audit_every == 0):
-            fps = self.audit_params()
-            if np.unique(fps).size > 1:
-                # round dropped BEFORE it runs; self.round rewinds to the
-                # rollback point, so a while-trainer.round driver replays
-                self._audit_trip(round_idx, fps)
-                return float("nan")
-            self._last_audit_ok = round_idx
+            if lag:
+                # fingerprints are computed over the PRE-round params (the
+                # invariant the audit checks) but stay on-device; the
+                # verdict is harvested with the round's loss
+                if self._audit_fn is None:
+                    self._audit_fn = self._build_audit()
+                audit_fps = self._audit_fn(self.params)
+            else:
+                t0 = time.perf_counter()
+                fps = self.audit_params()
+                self.stall_s["audit_fetch"] += time.perf_counter() - t0
+                if np.unique(fps).size > 1:
+                    # round dropped BEFORE it runs; self.round rewinds to
+                    # the rollback point, so a while-trainer.round driver
+                    # replays
+                    self._audit_trip(round_idx, fps)
+                    return float("nan")
+                self._last_audit_ok = round_idx
         # deterministic chaos hook: poison THIS rank's feed with NaNs (the
         # guard must catch the poison after averaging, no matter which
         # rank produced it — exactly a flaky-HBM / bad-DMA event)
@@ -565,13 +653,26 @@ class DistributedTrainer:
         self.params, self.state, loss = self._round(
             self.params, self.state, jnp.asarray(self.iter), batches, rng,
             jnp.asarray(self.lr_scale, jnp.float32))
-        loss_val = float(loss)
-        if self.config.guard_numerics:
-            reason = self._poison_reason(loss_val)
-            if reason:
-                self._guard_trip(round_idx, reason)
-                return loss_val   # round dropped; self.round unchanged
-            self._loss_history = (self._loss_history + [loss_val])[-8:]
+        if lag:
+            # zero-stall path: loss + finite verdict stay on-device; the
+            # dispatch returns immediately and the verdicts are harvested
+            # up to ``lag`` rounds later (below)
+            finite = (self._finite_fn()(self.params)
+                      if self.config.guard_numerics else None)
+            self._pending.append({"round": round_idx, "loss": loss,
+                                  "finite": finite, "fps": audit_fps})
+            loss_val = float("nan")
+        else:
+            t0 = time.perf_counter()
+            loss_val = float(loss)
+            self.stall_s["loss_fetch"] += time.perf_counter() - t0
+            if self.config.guard_numerics:
+                reason = self._poison_reason(loss_val)
+                if reason:
+                    self._guard_trip(round_idx, reason)
+                    return loss_val   # round dropped; self.round unchanged
+                self._loss_history = (self._loss_history + [loss_val])[-8:]
+            self.round_losses[round_idx] = loss_val
         prev = self.iter
         self.iter += self.config.tau
         # snapshot-on-schedule at round granularity (Solver::Step checks per
@@ -585,12 +686,23 @@ class DistributedTrainer:
                 and self.round % self.config.checkpoint_every == 0):
             self.save_round_checkpoint()
         health.maybe_beat(round_idx, "round_end")
+        if lag:
+            # keep at most ``lag`` rounds in flight: harvesting the
+            # overflow is the ONLY place the steady-state loop can block,
+            # and with a healthy device it blocks on a round dispatched
+            # K rounds ago — long since finished
+            while len(self._pending) > lag:
+                h = self._harvest_one()
+                if h is not None:
+                    loss_val = h
         return loss_val
 
     # -- numerical-integrity guard (see TrainerConfig.guard_numerics) -----
-    def _all_finite(self, tree) -> bool:
-        """Jitted all-leaves-finite reduction over the float leaves of a
-        (replicated) pytree — one fused pass, one scalar fetched."""
+    def _finite_fn(self):
+        """The jitted all-leaves-finite reduction over the float leaves
+        of a (replicated) pytree — one fused pass producing one device
+        scalar (fetched immediately on the sync path, harvested late on
+        the deferred path)."""
         if self._finite_check is None:
             def check(t):
                 leaves = [jnp.all(jnp.isfinite(x))
@@ -599,10 +711,19 @@ class DistributedTrainer:
                 return (jnp.all(jnp.stack(leaves)) if leaves
                         else jnp.asarray(True))
             self._finite_check = jax.jit(check)
-        return bool(self._finite_check(tree))
+        return self._finite_check
 
-    def _poison_reason(self, loss_val: float) -> str | None:
-        """Why the just-finished round should be rejected, or None."""
+    def _all_finite(self, tree) -> bool:
+        t0 = time.perf_counter()
+        out = bool(self._finite_fn()(tree))
+        self.stall_s["finite_check"] += time.perf_counter() - t0
+        return out
+
+    def _loss_poison_reason(self, loss_val: float) -> str | None:
+        """The host-only half of the verdict: non-finite or spiking
+        loss.  Shared by the synchronous check and the deferred harvest
+        (where the params verdict arrives separately, as the round's own
+        pre-computed finite flag)."""
         if not np.isfinite(loss_val):
             return f"non-finite loss {loss_val}"
         factor = self.config.loss_spike_factor
@@ -611,19 +732,31 @@ class DistributedTrainer:
             if loss_val > factor * mean:
                 return (f"loss spike {loss_val:.4g} > {factor:g} x "
                         f"trailing mean {mean:.4g}")
+        return None
+
+    def _poison_reason(self, loss_val: float) -> str | None:
+        """Why the just-finished round should be rejected, or None."""
+        reason = self._loss_poison_reason(loss_val)
+        if reason:
+            return reason
         if not self._all_finite(self.params):
             return "non-finite parameters after averaging"
         return None
 
     def _guard_trip(self, round_idx: int, reason: str) -> None:
         """Reject round ``round_idx``: roll back to the newest valid
-        checkpoint (params/state/iter/round/RNG all restored, so the
-        replay is exact), optionally back off the LR, and count the trip.
-        All processes take this path together — the decision derives from
-        replicated values, so no collective can diverge."""
+        checkpoint at or before it (params/state/iter/round/RNG all
+        restored, so the replay is exact), optionally back off the LR,
+        and count the trip.  The ``max_round`` bound is what keeps the
+        deferred-harvest path safe: under a harvest lag, checkpoints for
+        rounds AFTER the poisoned one may already exist (and carry the
+        poison) — they must not be rollback targets.  On the synchronous
+        path no newer checkpoint can exist yet, so the bound is inert.
+        All processes take this path together — the decision derives
+        from replicated values, so no collective can diverge."""
         self.guard_trips += 1
         print(f"guard: round {round_idx} REJECTED ({reason}); rolling "
-              f"back to last valid checkpoint "
+              f"back to last valid checkpoint at round <= {round_idx} "
               f"(trip {self.guard_trips}/{self.config.guard_max_trips})",
               file=sys.stderr, flush=True)
         if self.guard_trips > self.config.guard_max_trips:
@@ -631,16 +764,110 @@ class DistributedTrainer:
                 f"numerical guard tripped {self.guard_trips} times "
                 f"(> guard_max_trips={self.config.guard_max_trips}); "
                 f"last reason: {reason}")
-        manifest = self.resume_latest(self.config.checkpoint_dir)
+        manifest = self.resume_latest(self.config.checkpoint_dir,
+                                      max_round=round_idx)
         if manifest is None:
             raise TrainingDivergedError(
                 f"round {round_idx} poisoned ({reason}) and no valid "
-                f"checkpoint to roll back to in "
+                f"checkpoint at round <= {round_idx} to roll back to in "
                 f"{self.config.checkpoint_dir!r}")
         if self.config.guard_lr_backoff != 1.0:
             self.lr_scale *= self.config.guard_lr_backoff
             print(f"guard: LR scale backed off to {self.lr_scale:g}",
                   file=sys.stderr, flush=True)
+
+    # -- deferred harvesting (see TrainerConfig.harvest_lag) --------------
+    def _harvest_one(self) -> float | None:
+        """Resolve the OLDEST in-flight round: fetch its audit verdict,
+        loss, and finite-check (in that order — the audit inspected the
+        params the round STARTED from, so its verdict comes first, as on
+        the synchronous path).  A trip discards every younger in-flight
+        round (their inputs descend from the poisoned state), flushes
+        the checkpoint writer so the rollback scan sees a settled disk,
+        rolls back, and prunes now-invalid newer checkpoints.  Returns
+        the harvested loss (poisoned losses included, for logging), or
+        None when the round was dropped by the audit before it counted."""
+        e = self._pending.popleft()
+        round_idx = int(e["round"])
+        if e["fps"] is not None:
+            t0 = time.perf_counter()
+            fps = np.asarray(e["fps"])
+            self.stall_s["audit_fetch"] += time.perf_counter() - t0
+            if np.unique(fps).size > 1:
+                self._pending.clear()
+                self.flush_checkpoints()
+                self._audit_trip(round_idx, fps)
+                self._drop_checkpoints_after(self.round)
+                return None
+            self._last_audit_ok = round_idx
+        t0 = time.perf_counter()
+        loss_val = float(e["loss"])
+        self.stall_s["loss_fetch"] += time.perf_counter() - t0
+        if self.config.guard_numerics:
+            reason = self._loss_poison_reason(loss_val)
+            if reason is None and e["finite"] is not None:
+                t0 = time.perf_counter()
+                finite = bool(e["finite"])
+                self.stall_s["finite_check"] += time.perf_counter() - t0
+                if not finite:
+                    reason = "non-finite parameters after averaging"
+            if reason:
+                self._pending.clear()
+                self.flush_checkpoints()
+                self._guard_trip(round_idx, reason)
+                self._drop_checkpoints_after(self.round)
+                return loss_val
+            self._loss_history = (self._loss_history + [loss_val])[-8:]
+        self.round_losses[round_idx] = loss_val
+        return loss_val
+
+    def drain(self) -> dict[int, float]:
+        """Harvest every in-flight round verdict and flush the async
+        checkpoint writer — the end-of-loop (and pre-eval) barrier for
+        pipelined training.  After this, ``self.params`` is a validated
+        state and every scheduled checkpoint is durable.  Returns the
+        per-round harvested losses (``self.round_losses``).
+
+        NOTE a deferred verdict can TRIP here, after the driver's round
+        loop already exited: the rollback rewinds ``self.round``, so a
+        driver that wants the dropped rounds replayed must re-enter its
+        ``while trainer.round < rounds`` loop until the target holds
+        after drain (see tests/multihost_driver.py)."""
+        while self._pending:
+            self._harvest_one()
+        self.flush_checkpoints()
+        return dict(self.round_losses)
+
+    def flush_checkpoints(self) -> None:
+        """Durability barrier over this trainer's async checkpoint
+        writes; re-raises any background write failure.  A no-op on the
+        synchronous path."""
+        if self._ckpt_writer is not None:
+            t0 = time.perf_counter()
+            try:
+                self._ckpt_writer.flush()
+            finally:
+                self.stall_s["checkpoint"] += time.perf_counter() - t0
+
+    def _drop_checkpoints_after(self, round_idx: int) -> None:
+        """Remove checkpoints NEWER than ``round_idx`` — after a deferred
+        trip rolled back, snapshots taken during the detection lag
+        descend from the poisoned state and must not survive as future
+        rollback targets.  (The replay re-writes those round boundaries
+        with clean state.)  Process 0 only; inert on the synchronous
+        path, where no newer checkpoint can exist at trip time."""
+        directory = self.config.checkpoint_dir
+        if not directory or jax.process_index() != 0:
+            return
+        for mpath in glob.glob(os.path.join(directory, "manifest_*.json")):
+            r = _manifest_round(mpath)
+            if r > round_idx:
+                for p in (mpath, os.path.join(directory,
+                                              f"ckpt_round_{r:08d}.npz")):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
 
     # -- cross-replica parameter audit (see TrainerConfig.audit_every) ----
     def _build_audit(self):
@@ -915,54 +1142,96 @@ class DistributedTrainer:
 
     # -- round-granular checkpoint/resume (the recovery half of the
     #    reference's Spark fault-tolerance story; see TrainerConfig) ------
+    def _async_ckpt_enabled(self) -> bool:
+        from ..utils.checkpoint import async_checkpoints_enabled
+        return self.config.async_checkpoint and async_checkpoints_enabled()
+
     def save_round_checkpoint(self, directory: str | None = None) -> str | None:
         """Write checkpoint + manifest for the current round.  All
         processes must call (the state fetch is a collective); only
         process 0 touches disk.  Returns the checkpoint path on process 0,
-        None elsewhere."""
+        None elsewhere.
+
+        With async checkpointing on (the default; see
+        ``TrainerConfig.async_checkpoint``) the durable write — npz
+        serialize, sha256, manifest tmp+rename, prune — runs on a
+        background writer thread: this call only starts a non-blocking
+        device→host snapshot and enqueues the job, so the next round can
+        dispatch immediately.  The fault-injection hooks
+        (``crash_in_ckpt``/``corrupt_ckpt``) fire inside the job at the
+        same points in the write sequence, and ``flush_checkpoints()``
+        is the barrier that restores strict durability where callers
+        need it (rollback, preemption, end of run)."""
         from ..utils import faults
-        from ..utils.checkpoint import save_checkpoint
+        from ..utils.checkpoint import (
+            AsyncCheckpointWriter, save_checkpoint, snapshot_tree,
+        )
         directory = directory or self.config.checkpoint_dir
         if not directory:
             raise ValueError("no checkpoint directory configured")
+        # pin the injector INSTANCE now: the write may run later on the
+        # writer thread, and the fault decision belongs to the round that
+        # scheduled it, not to whatever the env says at write time
+        injector = faults.get_injector()
+        t0 = time.perf_counter()
         blob = self._host_blob()
         if jax.process_index() != 0:
             return None
         os.makedirs(directory, exist_ok=True)
-        name = f"ckpt_round_{self.round:08d}.npz"
+        # capture the round-scoped fields NOW — on the async path the
+        # trainer's counters will have moved on by write time
+        round_now, iter_now = self.round, self.iter
+        name = f"ckpt_round_{round_now:08d}.npz"
         path = os.path.join(directory, name)
-        save_checkpoint(path, blob)
-        # torn-write chaos window: the npz is durable, the manifest is not
-        # yet — crash_in_ckpt kills HERE; resume must treat the orphan npz
-        # as if the checkpoint never happened
-        faults.get_injector().on_checkpoint_write(self.round)
-        # deterministic chaos hook: scribble the snapshot AFTER it exists
-        # (and before/after the manifest — both orders must be survivable;
-        # we corrupt after so the manifest's checksum catches it)
-        corrupt = faults.get_injector().corrupt_checkpoint(self.round)
         manifest = {
-            "round": self.round,
-            "iter": self.iter,
+            "round": round_now,
+            "iter": iter_now,
             "file": name,
-            "sha256": _sha256_file(path),
+            "sha256": None,   # filled in after the npz lands
             "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
             "strategy": self.config.strategy,
             "n_workers": self.n_workers,
             "tau": self.config.tau,
             "data_cursor": self.data_cursor,
         }
-        mpath = os.path.join(directory, f"manifest_{self.round:08d}.json")
-        # unique temp name (pid-stamped): a crashed writer's leftover can
-        # never collide with — or be half-overwritten into — a live write
-        tmp = f"{mpath}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f, indent=1)
-        os.replace(tmp, mpath)  # manifest appears atomically, last
-        if corrupt:
-            print(f"FAULT: corrupt_ckpt scribbling {path}",
-                  file=sys.stderr, flush=True)
-            faults.scribble(path)
-        self._prune_checkpoints(directory)
+
+        def job() -> None:
+            save_checkpoint(path, blob)
+            # torn-write chaos window: the npz is durable, the manifest is
+            # not yet — crash_in_ckpt kills HERE; resume must treat the
+            # orphan npz as if the checkpoint never happened
+            injector.on_checkpoint_write(round_now)
+            # deterministic chaos hook: scribble the snapshot AFTER it
+            # exists (and before/after the manifest — both orders must be
+            # survivable; we corrupt after so the manifest's checksum
+            # catches it)
+            corrupt = injector.corrupt_checkpoint(round_now)
+            manifest["sha256"] = _sha256_file(path)
+            mpath = os.path.join(directory,
+                                 f"manifest_{round_now:08d}.json")
+            # unique temp name (pid-stamped): a crashed writer's leftover
+            # can never collide with — or be half-overwritten into — a
+            # live write
+            tmp = f"{mpath}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1)
+            os.replace(tmp, mpath)  # manifest appears atomically, last
+            if corrupt:
+                print(f"FAULT: corrupt_ckpt scribbling {path}",
+                      file=sys.stderr, flush=True)
+                faults.scribble(path)
+            self._prune_checkpoints(directory)
+
+        if self._async_ckpt_enabled():
+            # alias-free device copy + async d2h start; the job's
+            # np.asarray then lands on a transfer already in flight
+            blob = snapshot_tree(blob)
+            if self._ckpt_writer is None:
+                self._ckpt_writer = AsyncCheckpointWriter()
+            self._ckpt_writer.submit(job)
+        else:
+            job()
+        self.stall_s["checkpoint"] += time.perf_counter() - t0
         return path
 
     def _prune_checkpoints(self, directory: str) -> None:
@@ -997,7 +1266,14 @@ class DistributedTrainer:
         search (the audit's rollback horizon: newer checkpoints may carry
         an unverified divergence).  Returns the manifest resumed from, or
         None when no valid checkpoint exists."""
-        from ..utils.checkpoint import CheckpointError, load_checkpoint
+        from ..utils.checkpoint import (
+            CheckpointError, flush_all_writers, load_checkpoint,
+        )
+        # async tier: settle every in-flight background write (this
+        # trainer's AND any other live instance writing the same
+        # directory) before scanning — the newest manifest must not be
+        # sitting in a writer queue when we look for it
+        flush_all_writers()
         for mpath in sorted(
                 glob.glob(os.path.join(directory, "manifest_*.json")),
                 key=_manifest_round, reverse=True):

@@ -177,8 +177,14 @@ class Solver:
                 jnp.copy, self.params) if debug else None
             self.params, self.state, loss_dev = self._step(
                 self.params, self.state, self.iter, stacked, rng)
-            loss = float(loss_dev)
-            self._smoothed.append(loss)
+            # the loss stays a DEVICE scalar here — fetching it every
+            # iteration would serialize the host loop on each compiled
+            # step (the reference pattern carried over from per-iter
+            # logging).  ``smoothed_loss()`` converts lazily, so the host
+            # only synchronizes at display boundaries and chunk ends —
+            # the per-step analog of the trainer's harvest_lag.
+            loss = loss_dev
+            self._smoothed.append(loss_dev)
             self.iter += 1
             if debug:
                 self._log_debug_info(stacked, params_before, rng)
@@ -210,7 +216,7 @@ class Solver:
                     # a clean, resumable stop at the chunk boundary
                     self._stop_requested = True
                     break
-        return self.smoothed_loss() if self._smoothed else loss
+        return self.smoothed_loss() if self._smoothed else float(loss)
 
     def solve(self, max_iter: int | None = None) -> float:
         """Drive training to ``max_iter`` with the Solver::Solve schedule
@@ -314,7 +320,15 @@ class Solver:
             lambda *xs: jnp.stack(xs), *batches)
 
     def smoothed_loss(self) -> float:
-        return sum(self._smoothed) / len(self._smoothed) if self._smoothed else 0.0
+        """Average of the trailing ``average_loss`` window
+        (solver.cpp:226-235).  The window holds device scalars; this is
+        the one place they are fetched, so calling it IS the host sync
+        point — step() only does so at display boundaries and chunk
+        ends."""
+        if not self._smoothed:
+            return 0.0
+        return float(sum(float(v) for v in self._smoothed)
+                     / len(self._smoothed))
 
     # -- test pass (Solver::TestAndStoreResult; reference:
     #    solver.cpp:413-445 + ccaffe.cpp:179-187) -------------------------

@@ -15,15 +15,29 @@ Robustness contract (the recovery layer leans on this):
 - ANY malformed file (truncated zip, missing arrays, bad meta, checksum
   mismatch) surfaces as ``CheckpointError`` carrying ``.path``, never a
   raw ``zipfile.BadZipFile``/``KeyError`` from deep inside numpy.
+
+Zero-stall tier: :class:`AsyncCheckpointWriter` moves the serialize +
+checksum + rename work onto a background thread behind a bounded queue,
+so the training loop's checkpoint cost shrinks to a non-blocking
+device→host snapshot (``begin_host_transfer``) and a queue put.  The
+on-disk contract above is unchanged — the same ``save_checkpoint`` runs,
+just off-thread — and ``flush()`` is the barrier that restores strict
+durability ordering wherever the caller needs it (rollback, preemption,
+fault-injection windows).  ``SPARKNET_ASYNC_CKPT=0`` disables the tier
+globally, restoring the fully synchronous write path.
 """
 
 from __future__ import annotations
 
+import atexit
 import hashlib
 import json
 import os
+import queue
+import threading
+import weakref
 import zipfile
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
@@ -113,3 +127,167 @@ def load_checkpoint(path: str, verify: bool = True) -> Any:
         raise CheckpointError(
             f"malformed checkpoint structure ({type(e).__name__}: {e})",
             path) from e
+
+
+# ---------------------------------------------------------------------------
+# Async checkpoint tier (the zero-stall outer-loop piece)
+# ---------------------------------------------------------------------------
+
+def async_checkpoints_enabled() -> bool:
+    """Whether the async checkpoint tier is on (``SPARKNET_ASYNC_CKPT=0``
+    is the escape hatch restoring the synchronous write path)."""
+    return os.environ.get("SPARKNET_ASYNC_CKPT", "") != "0"
+
+
+_DEVICE_COPY = None
+
+
+def snapshot_tree(tree: Any) -> Any:
+    """Non-blocking snapshot of a checkpoint pytree: every jax leaf is
+    (1) copied ON-DEVICE through a jitted identity-copy — a fresh buffer
+    the training loop can never donate out from under the pending write
+    (the next compiled round donates the ORIGINAL params/state buffers)
+    — and (2) started on its device→host transfer with
+    ``copy_to_host_async``, so the writer thread's later ``np.asarray``
+    completes against a copy already in flight instead of paying the
+    full device sync on the training thread.  Both steps are async
+    dispatches; the call returns immediately.  Non-array leaves (ints,
+    strings, numpy) pass through unchanged."""
+    global _DEVICE_COPY
+    if _DEVICE_COPY is None:
+        import jax.numpy as jnp
+        _DEVICE_COPY = jax.jit(lambda x: jnp.copy(x))
+
+    def snap(x):
+        if not isinstance(x, jax.Array):
+            return x
+        try:
+            y = _DEVICE_COPY(x)
+        except Exception:
+            return np.asarray(x)   # fallback: synchronous host fetch
+        try:
+            y.copy_to_host_async()
+        except Exception:
+            pass  # best-effort: np.asarray in the writer still works
+        return y
+    return jax.tree_util.tree_map(snap, tree)
+
+
+# every live writer, so cross-instance consumers (a fresh trainer's
+# resume_latest scanning a directory another trainer is still writing
+# into) can wait for in-flight writes without holding a reference
+_WRITERS: "weakref.WeakSet[AsyncCheckpointWriter]" = weakref.WeakSet()
+_STOP = object()
+
+
+class AsyncCheckpointWriter:
+    """Single background thread executing checkpoint-write jobs in FIFO
+    order behind a bounded queue.
+
+    A *job* is a zero-arg callable that performs one complete durable
+    write (npz + manifest + prune), built by the caller with all its
+    inputs captured at submission time.  ``submit`` blocks only when
+    ``depth`` jobs are already queued (backpressure bounds host memory to
+    ``depth`` staged snapshots).  A job that raises parks the exception
+    and every later job still runs — the error surfaces on the next
+    ``submit``/``flush``, exactly where a synchronous write would have
+    raised.  ``flush()`` is the durability barrier: it returns only when
+    every previously submitted job has finished."""
+
+    def __init__(self, depth: int = 2, name: str = "ckpt-writer"):
+        if depth < 1:
+            raise ValueError(f"writer queue depth must be >= 1, got {depth}")
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._cond = threading.Condition()
+        self._submitted = 0
+        self._completed = 0
+        self._err: BaseException | None = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+        _WRITERS.add(self)
+
+    # -- writer side ------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is _STOP:
+                return
+            try:
+                job()
+            except BaseException as e:  # surfaced on next submit()/flush()
+                with self._cond:
+                    if self._err is None:
+                        self._err = e
+            finally:
+                with self._cond:
+                    self._completed += 1
+                    self._cond.notify_all()
+
+    # -- caller side ------------------------------------------------------
+    @property
+    def pending(self) -> int:
+        with self._cond:
+            return self._submitted - self._completed
+
+    def _take_error(self) -> BaseException | None:
+        with self._cond:
+            e, self._err = self._err, None
+            return e
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Queue one write job (FIFO).  Blocks while the queue is full;
+        re-raises the first error of any PREVIOUS job."""
+        if self._closed:
+            raise RuntimeError("checkpoint writer is closed")
+        err = self._take_error()
+        if err is not None:
+            raise err
+        with self._cond:
+            self._submitted += 1
+        self._q.put(job)
+
+    def flush(self, raise_errors: bool = True) -> None:
+        """Wait until every submitted job has completed (the durability
+        barrier).  With ``raise_errors``, a parked job exception is
+        re-raised here."""
+        with self._cond:
+            while self._completed < self._submitted:
+                if not self._thread.is_alive():
+                    break  # interpreter teardown killed the daemon
+                self._cond.wait(0.1)
+        if raise_errors:
+            err = self._take_error()
+            if err is not None:
+                raise err
+
+    def close(self, raise_errors: bool = False) -> None:
+        """Flush, then stop the writer thread.  Safe to call twice."""
+        if self._closed:
+            return
+        self.flush(raise_errors=raise_errors)
+        self._closed = True
+        self._q.put(_STOP)
+        self._thread.join(timeout=5.0)
+        _WRITERS.discard(self)
+
+
+def flush_all_writers() -> None:
+    """Barrier over every live :class:`AsyncCheckpointWriter` — used by
+    ``resume_latest`` (and atexit) so a directory scan never races a
+    write still in another instance's queue.  Errors stay parked on
+    their own writer (the owning trainer surfaces them); this only
+    waits."""
+    for w in list(_WRITERS):
+        try:
+            w.flush(raise_errors=False)
+        except Exception:
+            pass
+
+
+# normal interpreter exit must not drop queued round checkpoints (the
+# preemption contract: snapshot, then clean exit); crashes (os._exit)
+# still tear mid-write, which is exactly what the tmp+rename layout and
+# manifest checksums exist to survive
+atexit.register(flush_all_writers)

@@ -122,6 +122,50 @@ def peak_flops(device_kind: str) -> float | None:
     return _PEAK_FLOPS_BF16.get(device_kind)
 
 
+def eval_cost_flops(solver, batch) -> float | None:
+    """Model FLOPs of one compiled test-net forward (the eval-pass MFU
+    numerator), via XLA cost analysis like :func:`step_cost_flops`."""
+    import sys
+    try:
+        lowered = solver._test_fwd.lower(solver.params, batch, None)
+        cost = lowered.compile().cost_analysis()
+        if cost:
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            return float(cost.get("flops", 0.0)) or None
+    except Exception as e:
+        print(f"[profiling] eval cost_analysis unavailable: {e}",
+              file=sys.stderr)
+    return None
+
+
+def scanned_eval_block(solver, iters: int):
+    """Forward-only analog of :func:`scanned_train_block`: ``iters``
+    test-net forward passes as ONE compiled fori_loop, with a scalar
+    loop-carried perturbation of the input so XLA can neither hoist nor
+    elide the forward (the shared-weights eval pass the bench's
+    eval_images_per_sec times; `caffe time`'s forward leg,
+    caffe/tools/caffe.cpp:290-376).
+
+    Returns ``block(params, batch, s0) -> s`` (an opaque scalar)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    fwd = solver._make_test_forward(solver.test_net)
+
+    def block_fn(params, batch, s0):
+        def body(i, s):
+            b = {k: (v + (s * 1e-20).astype(v.dtype)
+                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                 for k, v in batch.items()}
+            out = fwd(params, b)
+            taps = [jnp.sum(v).astype(jnp.float32)
+                    for v in jax.tree_util.tree_leaves(out)]
+            return jnp.sum(jnp.stack(taps)) * 1e-20
+        return lax.fori_loop(0, iters, body, s0)
+
+    return jax.jit(block_fn)
+
+
 def scanned_train_block(solver, iters: int):
     """The production-shaped benchmark block: ``iters`` solver steps as ONE
     compiled fori_loop with donated params/state — the same execution model

@@ -213,8 +213,10 @@ def device_plane(planes: list[Plane]) -> Plane:
     if best is None:
         # CPU-platform traces have no accelerator plane; fall back to the
         # busiest plane that carries an "XLA Ops" line (host-side XLA)
+        # or a TfrtCpuClient execution line (newer jax CPU runtimes put
+        # HLO-named thunk events on "tf_XLATfrtCpuClient/<id>" lines)
         for p in planes:
-            if any("XLA Ops" in ln for ln in p.lines):
+            if any("XLA Ops" in ln or "tf_XLA" in ln for ln in p.lines):
                 if best is None or p.total_ps() > best.total_ps():
                     best = p
     if best is None:
@@ -235,7 +237,25 @@ def op_tables(log_dir: str, *, top: int = 30) -> dict:
     for lname, evs in plane.lines.items():
         if "XLA Ops" in lname and "Async" not in lname:
             events.extend(evs)
-    leaf = [e for e in events if e.meta.category not in _CONTAINERS]
+    if not events:
+        # CPU TfrtCpuClient traces: HLO-named thunk events on the
+        # client's execution line, with no category metadata — derive a
+        # category from the HLO name stem and drop the runtime's own
+        # bookkeeping events
+        for lname, evs in plane.lines.items():
+            if "tf_XLA" in lname:
+                events.extend(
+                    e for e in evs
+                    if not e.meta.name.startswith(("ThunkExecutor",
+                                                   "ThreadpoolListener")))
+
+    def category(m) -> str:
+        if m.category:
+            return m.category
+        stem = m.name.split(".", 1)[0]
+        return stem.rsplit("_", 1)[-1] if "_" in stem else stem
+
+    leaf = [e for e in events if category(e.meta) not in _CONTAINERS]
 
     def agg(key_fn):
         rows: dict[str, dict] = {}
@@ -261,7 +281,7 @@ def op_tables(log_dir: str, *, top: int = 30) -> dict:
             })
         return out
 
-    by_cat = agg(lambda m: m.category or "(uncategorized)")
+    by_cat = agg(lambda m: category(m) or "(uncategorized)")
     def op_key(m: OpMeta) -> str:
         base = m.label.rsplit(".", 1)
         return base[0] if len(base) == 2 and base[1].isdigit() else m.label

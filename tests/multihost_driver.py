@@ -69,6 +69,10 @@ def main() -> None:
     ap.add_argument("--audit-every", type=int, default=0,
                     help="cross-replica parameter audit cadence in rounds "
                          "(0 = off; needs --ckpt-dir)")
+    ap.add_argument("--harvest-lag", type=int, default=0,
+                    help="zero-stall outer loop: keep up to K rounds in "
+                         "flight, harvesting loss/guard/audit verdicts "
+                         "up to K rounds late (0 = synchronous)")
     ap.add_argument("--fail-rank", type=int, default=None,
                     help="failure-path mode: this rank dies (exit 3) after "
                          "the first round")
@@ -123,7 +127,8 @@ def main() -> None:
                       checkpoint_every=args.ckpt_every,
                       elastic=args.elastic,
                       guard_numerics=args.guard,
-                      audit_every=args.audit_every),
+                      audit_every=args.audit_every,
+                      harvest_lag=args.harvest_lag),
         seed=0)
     rows = local_batch_slice(GLOBAL_BATCH)
     injector = faults.get_injector()
@@ -136,34 +141,55 @@ def main() -> None:
     preempted = False
     with preemption_guard() as guard:
         # driven by tr.round, not a range(): a guard rollback rewinds
-        # tr.round and the loop replays the dropped round
-        while tr.round < args.rounds:
-            action = guard.check()
-            if action in (SolverAction.SNAPSHOT, SolverAction.SNAPSHOT_STOP):
-                if args.ckpt_dir:
-                    print(f"driver: signal checkpoint at round {tr.round}",
-                          flush=True)
-                    tr.save_round_checkpoint()
-            if action in (SolverAction.STOP, SolverAction.SNAPSHOT_STOP):
-                print(f"driver: preempted; stopped cleanly at round "
-                      f"boundary {tr.round}", flush=True)
-                preempted = True
+        # tr.round and the loop replays the dropped round.  The OUTER
+        # loop covers the pipelined case: a deferred verdict can trip
+        # during drain() — after the inner loop already exited — which
+        # rewinds tr.round again, and the dropped rounds must replay.
+        while True:
+            while tr.round < args.rounds:
+                action = guard.check()
+                if action in (SolverAction.SNAPSHOT,
+                              SolverAction.SNAPSHOT_STOP):
+                    if args.ckpt_dir:
+                        print(f"driver: signal checkpoint at round "
+                              f"{tr.round}", flush=True)
+                        tr.drain()   # settle in-flight rounds first
+                        tr.save_round_checkpoint()
+                        tr.flush_checkpoints()   # durable BEFORE the exit
+                if action in (SolverAction.STOP,
+                              SolverAction.SNAPSHOT_STOP):
+                    print(f"driver: preempted; stopped cleanly at round "
+                          f"boundary {tr.round}", flush=True)
+                    preempted = True
+                    break
+                r = tr.round
+                injector.on_round(r, rank=rank)
+                x, y = round_batch(r, TAU, GLOBAL_BATCH)
+                loss = tr.train_round(
+                    {"data": x[:, rows],
+                     "label": y[:, rows].astype(np.float32)})
+                losses.append(loss)
+                print(f"driver: round {r} done loss={loss:.4f}",
+                      flush=True)
+                if r == 0 and args.fail_rank is not None \
+                        and jax.process_index() == args.fail_rank:
+                    print(f"driver: rank {args.fail_rank} dying "
+                          f"(failure-path test)", flush=True)
+                    os._exit(3)
+            if preempted:
                 break
-            r = tr.round
-            injector.on_round(r, rank=rank)
-            x, y = round_batch(r, TAU, GLOBAL_BATCH)
-            loss = tr.train_round(
-                {"data": x[:, rows], "label": y[:, rows].astype(np.float32)})
-            losses.append(loss)
-            print(f"driver: round {r} done loss={loss:.4f}", flush=True)
-            if r == 0 and args.fail_rank is not None \
-                    and jax.process_index() == args.fail_rank:
-                print(f"driver: rank {args.fail_rank} dying "
-                      f"(failure-path test)", flush=True)
-                os._exit(3)
+            # settle every in-flight verdict + async checkpoint write; a
+            # trip here rewinds tr.round and the outer loop replays
+            tr.drain()
+            if tr.round >= args.rounds:
+                break
 
     if preempted:
         return  # clean exit: the relaunch resumes from the checkpoint
+
+    # pipelined mode: exact per-round losses live in tr.round_losses
+    if args.harvest_lag:
+        losses = [tr.round_losses[r] for r in range(args.rounds)]
 
     erng = np.random.default_rng(2000)
     eval_y = erng.integers(0, 10, size=(GLOBAL_BATCH,))

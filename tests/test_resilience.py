@@ -497,6 +497,7 @@ def test_checkpoint_every_and_pruning(tmp_path):
     tr = _make_trainer(d, every=2, keep=2)
     for r in range(8):
         tr.train_round(_batch(r))
+    tr.flush_checkpoints()             # settle the async writer
     rounds = sorted(int(f[len("manifest_"):-len(".json")])
                     for f in os.listdir(d) if f.startswith("manifest_"))
     assert rounds == [6, 8]            # every 2 rounds, newest 2 kept
@@ -510,6 +511,7 @@ def test_corrupt_checkpoint_falls_back_to_previous_manifest(tmp_path):
     tr = _make_trainer(d)
     for r in range(3):
         tr.train_round(_batch(r))
+    tr.flush_checkpoints()
     # scribble the NEWEST snapshot (round 3) — manifest checksum now lies
     faults.scribble(str(d / "ckpt_round_00000003.npz"))
     tr2 = _make_trainer(d, seed=99)
@@ -533,6 +535,7 @@ def test_corrupt_ckpt_fault_injection_end_to_end(tmp_path, monkeypatch):
     assert tr2.resumed is not None and tr2.round == 2
     # and the restarted job's own round-3 checkpoint is clean this time
     tr2.train_round(_batch(2))
+    tr2.flush_checkpoints()
     blob = load_checkpoint(str(d / "ckpt_round_00000003.npz"))
     assert int(blob["round"]) == 3
 
@@ -721,6 +724,7 @@ def test_resume_latest_all_manifests_corrupt(tmp_path):
     tr = _make_trainer(d)
     for r in range(2):
         tr.train_round(_batch(r))
+    tr.flush_checkpoints()
     for f in os.listdir(d):
         if f.startswith("manifest_"):
             (d / f).write_text("{ not json at all")
@@ -733,6 +737,7 @@ def test_resume_latest_mixed_valid_and_corrupt(tmp_path):
     tr = _make_trainer(d)
     for r in range(3):
         tr.train_round(_batch(r))
+    tr.flush_checkpoints()
     # newest manifest: unparsable JSON; next: points at a missing file;
     # round 1 stays intact — resume must land exactly there
     (d / "manifest_00000003.json").write_text("!!")
@@ -750,6 +755,7 @@ def test_pruning_keeps_exactly_checkpoint_keep_newest(tmp_path):
     tr = _make_trainer(d, keep=2)
     for r in range(5):
         tr.train_round(_batch(r))
+    tr.flush_checkpoints()
     rounds = sorted(int(f[len("manifest_"):-len(".json")])
                     for f in os.listdir(d) if f.startswith("manifest_"))
     assert rounds == [4, 5]
@@ -765,7 +771,11 @@ def test_pruning_keeps_exactly_checkpoint_keep_newest(tmp_path):
 def test_kill_during_npz_write_leaves_no_referenced_garbage(tmp_path,
                                                             monkeypatch):
     """A worker killed INSIDE the npz write (before the atomic rename)
-    must leave no final-name npz, no manifest, and a resumable dir."""
+    must leave no final-name npz, no manifest, and a resumable dir.
+    Pinned to the SYNCHRONOUS write path (the kill is simulated by an
+    exception through the caller's stack); the async-writer variant is
+    test_async_ckpt_crash_in_background_write."""
+    monkeypatch.setenv("SPARKNET_ASYNC_CKPT", "0")
     d = tmp_path / "ck"
     tr = _make_trainer(d)
     for r in range(2):
@@ -801,7 +811,10 @@ def test_crash_between_npz_and_manifest_is_invisible_to_resume(tmp_path,
                                                                monkeypatch):
     """The crash_in_ckpt fault kills in the torn-write window: npz
     durable, manifest never written.  resume_latest must skip the orphan
-    npz (no manifest references it) and land on the previous round."""
+    npz (no manifest references it) and land on the previous round.
+    Synchronous-path variant (the fake _exit raises through train_round);
+    the async window is test_async_ckpt_crash_in_background_write."""
+    monkeypatch.setenv("SPARKNET_ASYNC_CKPT", "0")
     d = tmp_path / "ck"
     monkeypatch.setenv("SPARKNET_FAULT", "crash_in_ckpt@round:3")
     monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
@@ -905,6 +918,7 @@ def test_elastic_reform_matches_native_3worker_run_bit_for_bit(tmp_path):
     a = _make_trainer(d4, batch=24, workers=4, lr=0.005)     # local_sgd, the
     for r in range(2):                             # re-tier-bearing case
         a.train_round(_batch(r, 24))
+    a.flush_checkpoints()
 
     # elastic side: resume the 4-worker checkpoint on 3 workers
     b = _make_trainer(d4, seed=99, batch=24, workers=3, lr=0.005, elastic=True)
@@ -958,6 +972,7 @@ def test_nan_inject_rolls_back_and_matches_fault_free(tmp_path, monkeypatch):
     losses = []
     while tr.round < 4:
         losses.append(tr.train_round(_batch(tr.round)))
+    tr.flush_checkpoints()
     assert tr.guard_trips == 1
     assert sum(1 for l in losses if not np.isfinite(l)) == 1  # the dropped one
     # checkpoint chain: every surviving snapshot is finite
@@ -1043,6 +1058,232 @@ def test_nan_inject_driver_end_to_end(tmp_path):
         assert np.all(np.isfinite(b[k])), f"NaN reached final params at {k}"
         np.testing.assert_array_equal(
             a[k], b[k], err_msg=f"guard recovery diverged at {k}")
+
+
+# ---------------------------------------------------------------------------
+# zero-stall outer loop: async checkpointing + deferred guard/audit harvest
+# ---------------------------------------------------------------------------
+
+def test_harvest_lag_retention_validation(tmp_path):
+    """harvest_lag must not outrun checkpoint retention: a poison at
+    round r surfaces up to lag (+ audit cadence) rounds later, and the
+    pre-poison checkpoint must still exist then."""
+    with pytest.raises(ValueError, match="harvest_lag must be >= 0"):
+        _make_trainer(tmp_path / "ck", harvest_lag=-1)
+    with pytest.raises(ValueError, match="outruns the checkpoint"):
+        _make_trainer(tmp_path / "ck", keep=2, guard_numerics=True,
+                      harvest_lag=2)
+    with pytest.raises(ValueError, match="outruns the checkpoint"):
+        # the audit's own cadence adds to the detection latency
+        _make_trainer(tmp_path / "ck", keep=3, audit_every=1,
+                      harvest_lag=2)
+    # enough retention: fine (and lag without guard/audit needs none)
+    _make_trainer(tmp_path / "ck", keep=4, audit_every=1,
+                  guard_numerics=True, harvest_lag=2)
+    _make_trainer(None, harvest_lag=3)
+
+
+def test_async_pipelined_loop_matches_sync_bit_for_bit(tmp_path):
+    """THE zero-stall parity contract: with checkpointing + numerics
+    guard + cross-replica audit ALL enabled, the pipelined loop
+    (harvest_lag=2, async checkpoint writer) produces the same
+    per-round losses and bit-identical params as the fully synchronous
+    loop — the tentpole is a latency optimization, not a semantics
+    change."""
+    kw = dict(lr=0.005, keep=4, guard_numerics=True, audit_every=1)
+    sync = _make_trainer(tmp_path / "sync", **kw)
+    sync_losses = [sync.train_round(_batch(r)) for r in range(5)]
+    sync.drain()
+    tr = _make_trainer(tmp_path / "async", harvest_lag=2, **kw)
+    first = tr.train_round(_batch(0))
+    assert np.isnan(first)          # nothing harvested yet — by design
+    while tr.round < 5:
+        tr.train_round(_batch(tr.round))
+    losses = tr.drain()
+    assert [losses[r] for r in range(5)] == sync_losses
+    for name in ("conv1", "ip2"):
+        np.testing.assert_array_equal(
+            np.asarray(tr.params[name][0]),
+            np.asarray(sync.params[name][0]),
+            err_msg=f"pipelined loop diverged at {name}")
+    # both modes wrote the same checkpoint chain (content-identical)
+    for d in (tmp_path / "sync", tmp_path / "async"):
+        assert "manifest_00000005.json" in os.listdir(d)
+    a = load_checkpoint(str(tmp_path / "sync" / "ckpt_round_00000005.npz"))
+    b = load_checkpoint(str(tmp_path / "async" / "ckpt_round_00000005.npz"))
+    import jax
+    for x, y in zip(jax.tree_util.tree_leaves(a["params"]),
+                    jax.tree_util.tree_leaves(b["params"])):
+        np.testing.assert_array_equal(x, y)
+    # the async loop recorded its (near-zero) stalls under the same keys
+    assert set(tr.stall_s) == {"loss_fetch", "finite_check",
+                               "audit_fetch", "checkpoint"}
+
+
+def test_async_ckpt_escape_hatch_restores_sync_path(tmp_path, monkeypatch):
+    """SPARKNET_ASYNC_CKPT=0 restores today's fully synchronous write:
+    durable before train_round returns, no writer thread at all."""
+    monkeypatch.setenv("SPARKNET_ASYNC_CKPT", "0")
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)
+    tr.train_round(_batch(0))
+    assert tr._ckpt_writer is None
+    assert "manifest_00000001.json" in os.listdir(d)
+    # flipping the env back re-enables the async tier mid-run
+    monkeypatch.delenv("SPARKNET_ASYNC_CKPT")
+    tr.train_round(_batch(1))
+    assert tr._ckpt_writer is not None
+    tr.flush_checkpoints()
+    assert "manifest_00000002.json" in os.listdir(d)
+
+
+@pytest.mark.chaos
+def test_async_ckpt_crash_in_background_write(tmp_path, monkeypatch):
+    """crash_in_ckpt with the ASYNC writer: the kill lands on the writer
+    thread inside the torn window (npz durable, manifest not yet), the
+    failure surfaces at the flush barrier — not silently — and resume
+    treats the orphan npz as if the checkpoint never happened."""
+    d = tmp_path / "ck"
+    monkeypatch.setenv("SPARKNET_FAULT", "crash_in_ckpt@round:2")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+
+    class _Killed(BaseException):
+        pass
+
+    def fake_exit(code):
+        raise _Killed()
+
+    faults.reset_injector()
+    monkeypatch.setattr(faults.get_injector(), "_exit", fake_exit)
+    tr = _make_trainer(d)
+    tr.train_round(_batch(0))
+    tr.train_round(_batch(1))      # round-2 job dies on the writer thread
+    with pytest.raises(_Killed):
+        tr.flush_checkpoints()
+    names = set(os.listdir(d))
+    assert "ckpt_round_00000002.npz" in names        # npz IS durable...
+    assert "manifest_00000002.json" not in names     # ...but unreferenced
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "1")    # the restart
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None and tr2.round == 1
+
+
+@pytest.mark.chaos
+def test_async_guard_trip_at_harvest_lag_bit_for_bit(tmp_path,
+                                                     monkeypatch):
+    """Acceptance: nan_inject at round 2 under harvest_lag=2 — the
+    verdict arrives up to two rounds late, every in-flight round after
+    the poison is discarded, newer (poison-descended) checkpoints are
+    pruned, and the replay lands bit-for-bit on the fault-free run."""
+    kw = dict(lr=0.005, keep=4, guard_numerics=True)
+    clean = _make_trainer(tmp_path / "clean", **kw)
+    clean_losses = [clean.train_round(_batch(r)) for r in range(5)]
+    clean.drain()
+
+    monkeypatch.setenv("SPARKNET_FAULT", "nan_inject@round:2")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    faults.reset_injector()
+    tr = _make_trainer(tmp_path / "chaos", harvest_lag=2, **kw)
+    while tr.round < 5:
+        tr.train_round(_batch(tr.round))
+    losses = tr.drain()
+    assert tr.guard_trips == 1
+    assert [losses[r] for r in range(5)] == clean_losses
+    for name in ("conv1", "ip2"):
+        np.testing.assert_array_equal(
+            np.asarray(tr.params[name][0]),
+            np.asarray(clean.params[name][0]),
+            err_msg=f"deferred guard recovery diverged at {name}")
+    # no checkpoint on disk carries the poison (lag-window snapshots
+    # were pruned on the trip, then re-written clean by the replay)
+    import jax
+    for f in sorted(os.listdir(tmp_path / "chaos")):
+        if f.endswith(".npz"):
+            blob = load_checkpoint(str(tmp_path / "chaos" / f))
+            for leaf in jax.tree_util.tree_leaves(blob["params"]):
+                assert np.all(np.isfinite(leaf)), f"NaN survived in {f}"
+
+
+@pytest.mark.chaos
+def test_async_audit_trip_at_harvest_lag_bit_for_bit(tmp_path,
+                                                     monkeypatch):
+    """bitflip_params at round 3 under harvest_lag=2 and audit_every=1:
+    the fingerprint mismatch is harvested late, rolls back to a
+    checkpoint at or before the last PASSED audit, and the replay (flip
+    is once-per-process) finishes bit-for-bit fault-free."""
+    kw = dict(lr=0.005, keep=5, audit_every=1)
+    clean = _make_trainer(tmp_path / "clean", **kw)
+    while clean.round < 6:
+        clean.train_round(_batch(clean.round))
+    clean.drain()
+    assert clean.audit_trips == 0
+
+    monkeypatch.setenv("SPARKNET_FAULT", "bitflip_params@rank:1@round:3")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    faults.reset_injector()
+    tr = _make_trainer(tmp_path / "chaos", harvest_lag=2, **kw)
+    while tr.round < 6:
+        tr.train_round(_batch(tr.round))
+    losses = tr.drain()
+    assert tr.audit_trips == 1
+    assert [losses[r] for r in range(6)] == \
+        [clean.round_losses[r] for r in range(6)]
+    for name in ("conv1", "ip2"):
+        np.testing.assert_array_equal(
+            np.asarray(tr.params[name][0]),
+            np.asarray(clean.params[name][0]),
+            err_msg=f"deferred audit recovery diverged at {name}")
+
+
+@pytest.mark.chaos
+def test_nan_inject_driver_end_to_end_pipelined(tmp_path):
+    """The guard acceptance path re-run under the async loop: the real
+    driver with --harvest-lag 2, nan_inject at round 2, absorbs the
+    poison through the DEFERRED verdict and still lands on the
+    fault-free params bit-for-bit."""
+    base, out = str(tmp_path / "base.npz"), str(tmp_path / "chaos.npz")
+    saved = _clean_launch_env()
+    try:
+        from sparknet_tpu.tools.launch import launch_local
+        common = [sys.executable, DRIVER, "--strategy", "sync",
+                  "--local-devices", "4", "--rounds", "4", "--guard",
+                  "--harvest-lag", "2"]
+        rc = launch_local(
+            common + ["--out", base, "--ckpt-dir", str(tmp_path / "ck_a")],
+            nprocs=1, platform="cpu", timeout=300)
+        assert rc == 0
+        rc = launch_local(
+            common + ["--out", out, "--ckpt-dir", str(tmp_path / "ck_b")],
+            nprocs=1, platform="cpu", timeout=300,
+            extra_env={"SPARKNET_FAULT": "nan_inject@round:2"})
+        assert rc == 0
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    a, b = np.load(base), np.load(out)
+    assert int(b["__guard_trips__"]) == 1 and int(a["__guard_trips__"]) == 0
+    for k in a.files:
+        if k.startswith("__"):
+            continue
+        assert np.all(np.isfinite(b[k])), f"NaN reached final params at {k}"
+        np.testing.assert_array_equal(
+            a[k], b[k], err_msg=f"pipelined guard recovery diverged at {k}")
+
+
+def test_roundbench_smoke(tmp_path):
+    """tools/roundbench.py (the SPARKNET_ROUNDBENCH=1 CI gate) passes
+    in-process: the async loop reproduces the sync loop's losses,
+    params, and newest checkpoint, and reports the stall accounting."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "roundbench", os.path.join(REPO, "tools", "roundbench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = tmp_path / "rb.json"
+    assert mod.main(["--rounds", "3", "--out", str(out)]) == 0
+    rec = json.loads(out.read_text())
+    assert rec["ok"] is True and rec["failures"] == []
+    assert rec["stall_total_sync_s"] >= 0
 
 
 @pytest.mark.chaos

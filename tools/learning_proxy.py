@@ -213,10 +213,10 @@ def main(argv=None) -> int:
     def _resume_path(tag):
         return f"{args.out}.resume_{tag}.npz"
 
-    def _save_resume(tag, it, tree, curve):
+    def _save_resume(tag, it, tree, curve, wall):
         leaves = jax.tree_util.tree_leaves(tree)
         np.savez(_resume_path(tag), __iter__=it,
-                 __curve__=json.dumps(curve),
+                 __curve__=json.dumps(curve), __wall__=float(wall),
                  **{f"l{i}": np.asarray(x) for i, x in enumerate(leaves)})
 
     def _load_resume(tag, template):
@@ -227,8 +227,13 @@ def main(argv=None) -> int:
         with np.load(path) as z:
             it = int(z["__iter__"])
             curve = json.loads(str(z["__curve__"]))
+            # cumulative wall seconds across EVERY invocation that
+            # contributed to this curve (VERDICT r5 weak #1: per-run
+            # timers reset on resume corrupted the wall_s_* fields by
+            # orders of magnitude); older resume files lack the field
+            wall = float(z["__wall__"]) if "__wall__" in z.files else 0.0
             new = [jnp.asarray(z[f"l{i}"]) for i in range(len(leaves))]
-        return it, jax.tree_util.tree_unflatten(treedef, new), curve
+        return it, jax.tree_util.tree_unflatten(treedef, new), curve, wall
 
     def _transient_exit(tag, it, err):
         print(f"{tag}: backend lost at iter {it} ({type(err).__name__}); "
@@ -256,14 +261,17 @@ def main(argv=None) -> int:
         rng = jax.random.PRNGKey(100)
         curve = []
         it = 0
+        wall0 = 0.0   # wall seconds accumulated by PREVIOUS invocations
         r = _load_resume("1x", (params0, state0))
         if r:
-            it, (params, state), curve = r
+            it, (params, state), curve, wall0 = r
             for _ in range(it // args.eval_every):  # fast-forward streams
                 rng_idx.integers(0, args.n_train,
                                  size=(args.eval_every, batch))
                 rng, _ = jax.random.split(rng)
-            print(f"1x   resuming at iter {it}", flush=True)
+            print(f"1x   resuming at iter {it} "
+                  f"({wall0:.1f}s accumulated)", flush=True)
+        t_run = time.time()
         while it < max_iter:
             n = min(args.eval_every, max_iter - it)
             idxs = rng_idx.integers(0, args.n_train, size=(n, batch))
@@ -275,13 +283,14 @@ def main(argv=None) -> int:
                 row = make_row(it, loss, params)
             except jax.errors.JaxRuntimeError as e:
                 _transient_exit("1x", it, e)
+            row["wall_s"] = round(wall0 + time.time() - t_run, 1)
             curve.append(row)
-            _save_resume("1x", it, (params, state), curve)
+            _save_resume("1x", it, (params, state), curve, row["wall_s"])
             print(f"1x   iter {it:5d} lr {row['lr']:.0e} "
                   f"loss {row['train_loss']:.3f} "
                   f"train_acc {row['train_acc']:.3f} "
                   f"test_acc {row['test_acc']:.3f}", flush=True)
-        return curve
+        return curve, wall0 + time.time() - t_run
 
     # -- 8-way local SGD: vmapped workers, tau-step weight averaging -----
     W, tau = args.workers, args.tau
@@ -337,16 +346,19 @@ def main(argv=None) -> int:
         rng = jax.random.PRNGKey(key)
         curve = []
         it = 0
+        wall0 = 0.0   # wall seconds accumulated by PREVIOUS invocations
         rounds_per_eval = max(args.eval_every // tau, 1)
         chunk_iters = rounds_per_eval * tau
         r = _load_resume(tag, (sparams, sstate))
         if r:
-            it, (sparams, sstate), curve = r
+            it, (sparams, sstate), curve, wall0 = r
             for _ in range(it // chunk_iters):     # fast-forward streams
                 rng_idx.integers(0, part,
                                  size=(rounds_per_eval, tau) + idx_tail)
                 rng, _ = jax.random.split(rng)
-            print(f"{tag:4s} resuming at iter {it}", flush=True)
+            print(f"{tag:4s} resuming at iter {it} "
+                  f"({wall0:.1f}s accumulated)", flush=True)
+        t_run = time.time()
         while it < max_iter:
             n_rounds = min(rounds_per_eval, (max_iter - it) // tau)
             if n_rounds == 0:
@@ -362,13 +374,15 @@ def main(argv=None) -> int:
                 row = make_row(it, loss, params)
             except jax.errors.JaxRuntimeError as e:
                 _transient_exit(tag, it, e)
+            row["wall_s"] = round(wall0 + time.time() - t_run, 1)
             curve.append(row)
-            _save_resume(tag, it, (sparams, sstate), curve)
+            _save_resume(tag, it, (sparams, sstate), curve,
+                         row["wall_s"])
             print(f"{tag:4s} iter {it:5d} lr {row['lr']:.0e} "
                   f"loss {row['train_loss']:.3f} "
                   f"train_acc {row['train_acc']:.3f} "
                   f"test_acc {row['test_acc']:.3f}", flush=True)
-        return curve
+        return curve, wall0 + time.time() - t_run
 
     def run_8way():
         return run_stacked("8way", W, rounds_8way, (W, batch), 6, 200)
@@ -431,10 +445,13 @@ def main(argv=None) -> int:
     def execute(tag, key, wall_key, run_fn):
         """Run the curve if selected, else take it from --merge."""
         if tag in selected:
-            t0 = time.time()
-            curve = run_fn()
+            # runners return their CUMULATIVE wall clock (resume
+            # checkpoints carry it across invocations), so wall_s_* is
+            # the true cost of the whole curve, not of the final slice
+            # this invocation happened to execute (VERDICT r5 weak #1)
+            curve, wall = run_fn()
             partial[key] = curve
-            partial[wall_key] = round(time.time() - t0, 1)
+            partial[wall_key] = round(wall, 1)
             checkpoint_partial()
             return curve, partial[wall_key]
         if key not in merged:

@@ -17,8 +17,14 @@ Parts (select with argv, default all):
            baseline / no-LRN / no-dropout / eval-forward, batch 256.
   hlo    — transpose/copy census of the optimized HLO for the compiled
            train step (layout-assignment cost evidence).
+  lrn    — the cross-channel LRN window sum as reduce_window (default)
+           vs the SPARKNET_LRN_CUMSUM=1 prefix-sum-difference
+           reformulation (VERDICT r5 weak #2), fwd and fwd+bwd, at both
+           LRN-bearing headline models' shapes.  PROBE_LRN_DTYPE=f32
+           switches from the bf16 default.
 
-Usage: python tools/perf_probe.py [ops|net|hlo ...] [--platform cpu]
+Usage: python tools/perf_probe.py [ops|net|hlo|poolbwd|lrn ...]
+       [--platform cpu]
 Prints one JSON line per experiment to stdout; diagnostics to stderr.
 """
 
@@ -69,8 +75,9 @@ def time_block(name: str, make_iter, iters: int = 0,
 
     @jax.jit
     def block(s, n):
-        return lax.fori_loop(0, n, lambda i, s: make_iter(s), s,
-                             unroll=False)
+        # no explicit unroll kwarg: it is already the default, and some
+        # jax versions reject it outright when the bound is traced
+        return lax.fori_loop(0, n, lambda i, s: make_iter(s), s)
 
     s0 = jnp.zeros((), jnp.float32)
     t0 = time.perf_counter()
@@ -457,6 +464,87 @@ def run_poolbwd() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Part: LRN window-sum reformulation (VERDICT r5 weak #2)
+# ---------------------------------------------------------------------------
+
+def run_lrn() -> None:
+    """reduce_window vs prefix-sum-difference cross-channel LRN
+    (``SPARKNET_LRN_CUMSUM=1``), forward and forward+backward, at the
+    LRN shapes of both LRN-bearing headline models.  The flag is read at
+    trace time, so each variant compiles its own block; the layer code
+    under test is the production ``ops.vision.LRNLayer``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu.models.dsl import layer
+    from sparknet_tpu.ops.registry import get_layer_impl
+
+    impl = get_layer_impl("LRN")
+    lp = layer("probe_lrn", "LRN", ["x"], ["y"],
+               lrn_param={"local_size": 5, "alpha": 1e-4, "beta": 0.75})
+    dtype = (jnp.float32 if os.environ.get("PROBE_LRN_DTYPE") == "f32"
+             else jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    div = max(1, int(os.environ.get("PROBE_LRN_BATCH_DIV", "1") or 1))
+    shapes = {
+        f"googlenet_norm1_b{128 // div}": (128 // div, 64, 56, 56),
+        f"googlenet_norm2_b{128 // div}": (128 // div, 192, 56, 56),
+        f"caffenet_norm1_b{256 // div}": (256 // div, 96, 55, 55),
+        f"caffenet_norm2_b{256 // div}": (256 // div, 256, 27, 27),
+    }
+    only = os.environ.get("PROBE_LRN_SHAPES", "")
+    if only:  # comma-separated substring filter (CPU smokes)
+        shapes = {k: v for k, v in shapes.items()
+                  if any(s and s in k for s in only.split(","))}
+    saved = os.environ.get("SPARKNET_LRN_CUMSUM")
+    results: dict[str, dict[str, float]] = {}
+    try:
+        for name, shape in shapes.items():
+            x = jnp.asarray(rng.normal(size=shape), dtype)
+            nbytes = x.size * x.dtype.itemsize
+
+            def loss(xx):
+                y = impl.apply(lp, [], [xx], True, None)[0]
+                return jnp.mean(y).astype(jnp.float32)
+
+            for variant, env in (("reduce_window", None), ("cumsum", "1")):
+                if env is None:
+                    os.environ.pop("SPARKNET_LRN_CUMSUM", None)
+                else:
+                    os.environ["SPARKNET_LRN_CUMSUM"] = env
+
+                def fwd(s, x=x, loss=loss):
+                    return loss(x + s.astype(dtype))
+
+                def fwdbwd(s, x=x, loss=loss):
+                    g = jax.grad(loss)(x + s.astype(dtype))
+                    return jnp.mean(g).astype(jnp.float32)
+
+                extra = {"shape": list(shape), "dtype": str(jnp.dtype(dtype))}
+                f_ms = time_block(f"lrn_{name}_{variant}_fwd", fwd,
+                                  extra=extra)
+                fb_ms = time_block(f"lrn_{name}_{variant}_fwdbwd", fwdbwd,
+                                   extra=extra)
+                # effective traffic at the fwd floor: read x, write y
+                results.setdefault(name, {})[variant] = fb_ms
+                results[name][f"{variant}_fwd_gbps"] = round(
+                    2 * nbytes / max(f_ms, 1e-6) / 1e6, 1)
+    finally:
+        if saved is None:
+            os.environ.pop("SPARKNET_LRN_CUMSUM", None)
+        else:
+            os.environ["SPARKNET_LRN_CUMSUM"] = saved
+    verdict = {
+        name: {"speedup_fwdbwd": round(r["reduce_window"]
+                                       / max(r["cumsum"], 1e-9), 3),
+               **{k: v for k, v in r.items()}}
+        for name, r in results.items()}
+    emit({"exp": "lrn_verdict", "dtype": str(jnp.dtype(dtype)),
+          "per_shape": verdict})
+
+
+# ---------------------------------------------------------------------------
 # Part C: HLO transpose census
 # ---------------------------------------------------------------------------
 
@@ -525,4 +613,4 @@ if __name__ == "__main__":
           "batch": BATCH})
     for p in parts:
         {"ops": run_ops, "net": run_net, "hlo": run_hlo,
-         "poolbwd": run_poolbwd}[p]()
+         "poolbwd": run_poolbwd, "lrn": run_lrn}[p]()

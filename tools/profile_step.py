@@ -8,7 +8,11 @@ step-time and MFU so layout/precision experiments have a measured target.
 
 Usage:
     python tools/profile_step.py [--model caffenet] [--batch 256]
-        [--iters 20] [--dtype bf16] [--out profiles/caffenet]
+        [--iters 20] [--dtype bf16] [--out profiles/caffenet] [--eval]
+
+``--eval`` profiles the forward-only eval pass instead (the `caffe
+time` forward leg): the scanned test-net forward with eval MFU in the
+summary, written to profiles/<model>[_bf16]_eval by default.
 
 The reference's closest analog is `caffe time` (per-layer fwd/bwd timing,
 caffe/tools/caffe.cpp:290-376); this is per-XLA-op, post-fusion — the
@@ -37,6 +41,10 @@ def main() -> None:
                     help="trace dir (default profiles/<model>)")
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--eval", action="store_true",
+                    help="profile the forward-only eval pass (the "
+                         "test-net `caffe time` forward leg) instead of "
+                         "the train step — eval MFU in the summary")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
@@ -54,7 +62,9 @@ def main() -> None:
     from sparknet_tpu.utils.profiling import (
         BENCH_SOLVER_PROTOTXT,
         build_bench_model,
+        eval_cost_flops,
         peak_flops,
+        scanned_eval_block,
         scanned_train_block,
         step_cost_flops,
     )
@@ -69,30 +79,47 @@ def main() -> None:
     label = jnp.asarray(rng.integers(0, classes, size=(args.batch,)).astype(np.float32))
     batch = {"data": data[None], "label": label[None]}
 
-    block = scanned_train_block(solver, args.iters)
-
     params, state = solver.params, solver.state
     step_rng = jax.random.PRNGKey(0)
 
     # cost_analysis of the fori_loop block would undercount (the while body
     # is costed once); cost the single step, exactly as bench.py does
-    flops_per_step = step_cost_flops(solver, batch)
+    if args.eval:
+        eval_batch = {"data": data, "label": label}
+        block = scanned_eval_block(solver, args.iters)
+        flops_per_step = eval_cost_flops(solver, eval_batch)
+
+        def run_block(s):
+            return block(params, eval_batch, s)
+    else:
+        block = scanned_train_block(solver, args.iters)
+        flops_per_step = step_cost_flops(solver, batch)
 
     t0 = time.perf_counter()
-    params, state, step_rng, loss = block(params, state, 0, batch, step_rng)
-    jax.block_until_ready(loss)
+    if args.eval:
+        tap = run_block(jnp.zeros(()))
+        jax.block_until_ready(tap)
+    else:
+        params, state, step_rng, loss = block(params, state, 0, batch,
+                                              step_rng)
+        jax.block_until_ready(loss)
     print(f"[profile] compile+warmup {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
 
     out_dir = args.out or os.path.join(
         "profiles",
-        args.model + ("_bf16" if args.dtype == "bf16" else ""))
+        args.model + ("_bf16" if args.dtype == "bf16" else "")
+        + ("_eval" if args.eval else ""))
     os.makedirs(out_dir, exist_ok=True)
     t0 = time.perf_counter()
     jax.profiler.start_trace(out_dir)
-    params, state, step_rng, loss = block(params, state, args.iters, batch,
-                                          step_rng)
-    jax.block_until_ready(loss)
+    if args.eval:
+        tap = run_block(jnp.ones(()))
+        jax.block_until_ready(tap)
+    else:
+        params, state, step_rng, loss = block(params, state, args.iters,
+                                              batch, step_rng)
+        jax.block_until_ready(loss)
     jax.profiler.stop_trace()
     dt = time.perf_counter() - t0
     step_s = dt / args.iters
@@ -105,6 +132,7 @@ def main() -> None:
     print(xplane.format_tables(tables))
     summary = {
         "model": args.model, "batch": args.batch, "dtype": args.dtype,
+        "mode": "eval_forward" if args.eval else "train_step",
         "device": f"{dev.platform}/{dev.device_kind}",
         "step_ms": round(step_s * 1e3, 2),
         "img_s": round(args.batch / step_s, 1),

@@ -52,11 +52,27 @@ maybe_feedbench() {
   fi
 }
 
+# ~10-second sync-vs-async outer-loop parity smoke (tools/roundbench.py)
+# — opt-in via SPARKNET_ROUNDBENCH=1.  Fails the gate unless the
+# pipelined loop (harvest_lag + AsyncCheckpointWriter) reproduces the
+# synchronous loop's round losses, final params, and newest checkpoint
+# bit for bit, with ckpt+guard+audit all enabled.  (A fast in-tree smoke
+# of the same contract always runs inside tier-1: tests/test_resilience.py.)
+maybe_roundbench() {
+  if [ "${SPARKNET_ROUNDBENCH:-}" = "1" ]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python tools/roundbench.py --rounds 6 --out /tmp/_roundbench.json
+  fi
+}
+
 case "${1:-}" in
   --chaos) run_chaos ;;
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
-  --all)   run_tier1 && run_chaos && maybe_soak && maybe_feedbench ;;
-  "")      run_tier1 && maybe_soak && maybe_feedbench ;;
-  *) echo "usage: $0 [--chaos|--soak|--feedbench|--all]" >&2; exit 2 ;;
+  --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
+  --all)   run_tier1 && run_chaos && maybe_soak && maybe_feedbench \
+             && maybe_roundbench ;;
+  "")      run_tier1 && maybe_soak && maybe_feedbench && maybe_roundbench ;;
+  *) echo "usage: $0 [--chaos|--soak|--feedbench|--roundbench|--all]" >&2
+     exit 2 ;;
 esac
