@@ -1,0 +1,141 @@
+"""Drive the PR-6 fleet tier end-to-end through the public surface.
+
+Run from the repo root: python .drive_r11.py   -> expect DRIVE OK
+
+Flows: (1) a two-job fleet (one with an injected crash) completes with
+params bit-identical to a fault-free baseline; (2) preempt/resume — a
+self-preempting job (SPARKNET_FAULT=preempt@round:1) AND a late
+whole-budget priority-99 job that evicts the running gang, everything
+still bit-identical; (3) quarantine — a job that always fails lands in
+QUARANTINED with a postmortem.json and the fleet returns rc 3;
+(4) journal resume — a finished fleet resumed from its journal stays
+finished (runner factory that would explode proves nothing relaunches);
+(5) status plumbing — round progress + heartbeat extras (stall_s) are
+visible; error-path probes: duplicate name, oversized gang, unknown
+model, cmd without {out}.
+"""
+
+import os
+import sys
+import tempfile
+
+for k in list(os.environ):
+    if k.startswith("SPARKNET_"):
+        os.environ.pop(k)
+os.environ.pop("XLA_FLAGS", None)
+
+import numpy as np
+
+from sparknet_tpu.parallel.fleet import (
+    COMPLETED, QUARANTINED, FleetError, FleetScheduler, JobSpec,
+    format_status,
+)
+from sparknet_tpu.tools.launch import launch_local
+
+DRIVER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tests", "multihost_driver.py")
+work = tempfile.mkdtemp(prefix="drive_r11_")
+
+
+def check(name, cond):
+    print(f"{'ok ' if cond else 'FAIL'} {name}", flush=True)
+    if not cond:
+        raise SystemExit(f"DRIVE FAILED at {name}")
+
+
+def params_equal(a_path, b_path):
+    a, b = np.load(a_path), np.load(b_path)
+    return all(np.array_equal(a[k], b[k])
+               for k in a.files if not k.startswith("__"))
+
+
+# fault-free baseline (world 4 / rounds 4) and (world 8 / rounds 3)
+base4 = os.path.join(work, "base4.npz")
+base8 = os.path.join(work, "base8.npz")
+rc = launch_local([sys.executable, DRIVER, "--strategy", "sync",
+                   "--out", base4, "--local-devices", "4",
+                   "--rounds", "4"], nprocs=1, platform="cpu",
+                  timeout=300)
+check("baseline world=4", rc == 0)
+rc = launch_local([sys.executable, DRIVER, "--strategy", "sync",
+                   "--out", base8, "--local-devices", "8",
+                   "--expect-devices", "8", "--rounds", "3"],
+                  nprocs=1, platform="cpu", timeout=300)
+check("baseline world=8", rc == 0)
+
+# -- flow 1+2: crash recovery, self-preempt, priority preemption -------
+fleet = FleetScheduler(os.path.join(work, "fleet"), 8,
+                       tenants={"acme": 8, "beta": 8},
+                       preempt_grace_s=20)
+crashy = fleet.submit(JobSpec(name="crashy", tenant="acme", world=4,
+                              rounds=4, fault="crash@round:2"))
+selfpre = fleet.submit(JobSpec(name="selfpre", tenant="beta", world=4,
+                               rounds=4, fault="preempt@round:1"))
+urgent = fleet.submit(JobSpec(name="urgent", tenant="acme", priority=99,
+                              world=8, rounds=3, not_before_s=4.0))
+rc = fleet.run(tick_s=0.1, timeout_s=300)
+check("fleet drains rc=0", rc == 0)
+check("all jobs completed",
+      all(j.state == COMPLETED for j in fleet.jobs.values()))
+check("crash was restarted (attempts>1)", crashy.restarts_used > 1)
+check("preemption exercised",
+      selfpre.preempt_count >= 1 or crashy.preempt_count >= 1)
+check("crashy bit-identical", params_equal(base4, crashy.out_path))
+check("selfpre bit-identical", params_equal(base4, selfpre.out_path))
+check("urgent bit-identical", params_equal(base8, urgent.out_path))
+check("zero orphans", fleet.live_worker_pids() == {})
+st = fleet.status()
+text = format_status(st)
+check("status table renders", "crashy" in text and "COMPLETED" in text)
+hb = [r["heartbeats"] for r in st["jobs"] if r["job"] == "selfpre"][0]
+check("heartbeat extras carry stall_s",
+      any("stall_s" in (b.get("extras") or {}) for b in hb.values()))
+
+# -- flow 4: journal resume of a finished fleet ------------------------
+def explode(job, cmd, env):
+    raise AssertionError(f"double launch of {job.name}")
+
+again = FleetScheduler.resume(os.path.join(work, "fleet"),
+                              runner_factory=explode)
+check("resume keeps completions",
+      all(j.state == COMPLETED for j in again.jobs.values()))
+check("resumed fleet is a no-op", again.run(tick_s=0.05) == 0)
+
+# -- flow 3: quarantine with post-mortem -------------------------------
+f2 = FleetScheduler(os.path.join(work, "fleet2"), 4)
+doomed = f2.submit(JobSpec(
+    name="doomed", world=2, rounds=1, max_restarts=1, timeout_s=60,
+    cmd=(sys.executable, "-c",
+         "import sys; sys.stderr.write('artifact at {out}\\n'); "
+         "sys.exit(7)")))
+check("quarantine rc=3", f2.run(tick_s=0.05, timeout_s=120) == 3)
+check("doomed quarantined", doomed.state == QUARANTINED)
+pm = os.path.join(doomed.job_dir, "postmortem.json")
+check("postmortem written", os.path.exists(pm))
+check("gang re-offered", f2.allocator.free_count == 4)
+
+# -- error paths -------------------------------------------------------
+try:
+    f2.submit(JobSpec(name="doomed", world=1))
+    check("duplicate name rejected", False)
+except FleetError:
+    check("duplicate name rejected", True)
+try:
+    f2.submit(JobSpec(name="huge", world=64))
+    check("oversized gang rejected", False)
+except FleetError as e:
+    check("oversized gang rejected", "never be placed" in str(e))
+try:
+    JobSpec(name="x", model="resnet50")
+    check("unknown model rejected", False)
+except ValueError:
+    check("unknown model rejected", True)
+try:
+    JobSpec(name="x", cmd=("prog", "--flag"))
+    check("cmd without {out} rejected", False)
+except ValueError:
+    check("cmd without {out} rejected", True)
+
+import shutil
+shutil.rmtree(work, ignore_errors=True)
+print("DRIVE OK")

@@ -12,4 +12,5 @@ from .resilience import (
     ResilientRunner,
     RestartPolicy,
 )
+from .fleet import FleetScheduler, GangAllocator, JobSpec
 from . import health

@@ -51,6 +51,12 @@ class Heartbeat:
     time: float         # writer's epoch seconds
     pid: int
     attempt: int
+    # optional free-form telemetry riding the beat (JSON-serializable):
+    # the trainer publishes its per-component host-stall accounting
+    # (``stall_s``) and the feed pipeline's ``FeedStats`` snapshot here,
+    # which is how the fleet status view sees inside a running job
+    # without any extra channel.  Older beats simply lack it.
+    extras: dict | None = None
 
     def age(self, now: float | None = None) -> float:
         return (time.time() if now is None else now) - self.time
@@ -62,11 +68,13 @@ def beat_path(directory: str, rank: int) -> str:
 
 def write_beat(directory: str, rank: int, round_idx: int, phase: str,
                attempt: int = 0, *, clock: Callable[[], float] = time.time,
-               ) -> None:
+               extras: dict | None = None) -> None:
     """Publish rank ``rank``'s beat — atomic replace, never a torn read."""
     os.makedirs(directory, exist_ok=True)
     beat = {"rank": rank, "round": round_idx, "phase": phase,
             "time": clock(), "pid": os.getpid(), "attempt": attempt}
+    if extras:
+        beat["extras"] = extras
     path = beat_path(directory, rank)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
@@ -81,9 +89,11 @@ def read_beat(directory: str, rank: int) -> Heartbeat | None:
     try:
         with open(beat_path(directory, rank)) as f:
             d = json.load(f)
+        extras = d.get("extras")
         return Heartbeat(rank=int(d["rank"]), round=int(d["round"]),
                          phase=str(d["phase"]), time=float(d["time"]),
-                         pid=int(d["pid"]), attempt=int(d["attempt"]))
+                         pid=int(d["pid"]), attempt=int(d["attempt"]),
+                         extras=extras if isinstance(extras, dict) else None)
     except (OSError, ValueError, KeyError, json.JSONDecodeError):
         return None
 
@@ -107,7 +117,8 @@ def read_all(directory: str) -> dict[int, Heartbeat]:
     return beats
 
 
-def maybe_beat(round_idx: int, phase: str = "round_start") -> None:
+def maybe_beat(round_idx: int, phase: str = "round_start",
+               extras: dict | None = None) -> None:
     """Worker-side hook: publish a beat iff SPARKNET_HEARTBEAT_DIR is set.
     Deliberately swallow-nothing-raise-nothing is NOT the contract — a
     beacon dir that exists but is unwritable should fail loudly (it means
@@ -118,7 +129,8 @@ def maybe_beat(round_idx: int, phase: str = "round_start") -> None:
     write_beat(directory, int(os.environ.get("SPARKNET_PROC_ID", "0") or 0),
                round_idx, phase,
                attempt=int(os.environ.get("SPARKNET_FAULT_ATTEMPT", "0")
-                           or 0))
+                           or 0),
+               extras=extras)
 
 
 class StragglerMonitor:

@@ -167,7 +167,8 @@ class ResilientRunner:
                  workdir: str | None = None,
                  extra_env: dict | None = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 jitter_rng: random.Random | None = None):
+                 jitter_rng: random.Random | None = None,
+                 on_spawn: Callable[[list], None] | None = None):
         if (nprocs is None) == (hosts is None):
             raise ValueError("exactly one of nprocs / hosts is required")
         self.cmd = list(cmd)
@@ -185,7 +186,9 @@ class ResilientRunner:
         self._sleep = sleep
         self._rng = jitter_rng or random.Random()
         self.workdir = workdir or tempfile.mkdtemp(prefix="sparknet-job-")
+        self.on_spawn = on_spawn
         self.attempts: list[Attempt] = []
+        self.canceled = False
         self.incarnation = 0
         self.dropped: list[int | str] = []   # host names (ssh) / slots
         self._drop_counts: dict[int | str, int] = {}
@@ -256,7 +259,8 @@ class ResilientRunner:
             heartbeat_dir=os.path.join(adir, "hb"),
             round_deadline=self.round_deadline,
             log_dir=os.path.join(adir, "logs"),
-            report=report)
+            report=report,
+            on_spawn=self.on_spawn)
         if self.hosts is not None:
             return launch_ssh(self.cmd, self.hosts,
                               coordinator_port=free_port(),
@@ -316,12 +320,26 @@ class ResilientRunner:
             return None
         return collections.Counter(ranks).most_common(1)[0][0]
 
+    # -- cancellation (fleet preemption) ----------------------------------
+    def cancel(self) -> None:
+        """Stop supervising: no further restarts or re-forms after the
+        current attempt exits (and none at all if called between
+        attempts).  The runner does NOT kill the live workers itself — it
+        has handed their handles to ``on_spawn`` and the canceling
+        supervisor owns the signalling (SIGTERM for a graceful
+        preemption, SIGKILL past the grace window).  After a cancel,
+        ``run()`` returns the last attempt's code without building a
+        post-mortem: a canceled job is preempted, not failed."""
+        self.canceled = True
+
     # -- the supervision loop ---------------------------------------------
     def _run_incarnation(self, attempt_base: int) -> int:
         """One full restart budget at the current world size; returns the
         last exit code (0 = recovered)."""
         rc = 0
         for i in range(self.policy.max_restarts + 1):
+            if self.canceled:
+                return rc
             attempt = attempt_base + i
             report: dict = {}
             t0 = time.monotonic()
@@ -336,6 +354,8 @@ class ResilientRunner:
                     print(f"resilience: job recovered on attempt "
                           f"{attempt + 1}", file=sys.stderr, flush=True)
                 return 0
+            if self.canceled:
+                return rc
             if rc == EXIT_STRAGGLER:
                 print(f"resilience: rank "
                       f"{report.get('first_failure', '?')} missed the "
@@ -359,6 +379,10 @@ class ResilientRunner:
             rc = self._run_incarnation(len(self.attempts))
             if rc == 0:
                 return 0
+            if self.canceled:
+                # preempted, not failed: no post-mortem, no re-form — the
+                # canceling supervisor decides what happens to the job
+                return rc
             culprit = self._culprit()
             survivors = self.world_size() - 1
             if (self.elastic.enabled and culprit is not None
@@ -382,5 +406,9 @@ class ResilientRunner:
         heartbeat age) instead of returning an opaque exit code."""
         rc = self.run()
         if rc != 0:
-            raise self.failure   # always set on nonzero return
+            if self.failure is None:   # canceled mid-flight: no post-mortem
+                raise ResilienceError(
+                    f"job canceled with last exit rc={rc}", returncode=rc,
+                    cause="canceled")
+            raise self.failure   # always set on nonzero uncanceled return
         return rc

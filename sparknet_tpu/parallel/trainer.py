@@ -323,6 +323,10 @@ class DistributedTrainer:
         self._ckpt_writer = None
         self.stall_s = {"loss_fetch": 0.0, "finite_check": 0.0,
                         "audit_fetch": 0.0, "checkpoint": 0.0}
+        # the FeedStats of the newest input_feed() (if any) — published on
+        # round_end heartbeats so fleet-level supervisors can see the data
+        # plane's health without any extra channel
+        self.feed_stats = None
         if self.config.harvest_lag < 0:
             raise ValueError(
                 f"harvest_lag must be >= 0, got {self.config.harvest_lag}")
@@ -551,10 +555,13 @@ class DistributedTrainer:
         compiled rounds in flight and needs that many staged feeds to
         never be the bottleneck.  Close the returned feed (context
         manager) after the loop."""
-        from ..data.pipeline import feed_depth
+        from ..data.pipeline import FeedStats, feed_depth
         from ..data.prefetch import device_feed
         if depth is None:
             depth = feed_depth(max(1, self.config.harvest_lag + 1))
+        if stats is None:
+            stats = FeedStats()
+        self.feed_stats = stats
         return device_feed(rounds, depth=depth,
                            sharding=self.input_sharding, stats=stats,
                            stall_timeout=stall_timeout, restarts=restarts)
@@ -685,7 +692,7 @@ class DistributedTrainer:
         if (self.config.checkpoint_dir
                 and self.round % self.config.checkpoint_every == 0):
             self.save_round_checkpoint()
-        health.maybe_beat(round_idx, "round_end")
+        health.maybe_beat(round_idx, "round_end", extras=self._beat_extras())
         if lag:
             # keep at most ``lag`` rounds in flight: harvesting the
             # overflow is the ONLY place the steady-state loop can block,
@@ -696,6 +703,19 @@ class DistributedTrainer:
                 if h is not None:
                     loss_val = h
         return loss_val
+
+    def _beat_extras(self) -> dict:
+        """Telemetry riding the round_end heartbeat: per-component host
+        stalls, trip counters, and the feed pipeline's stats — the fleet
+        status view's only window into a running job."""
+        extras = {
+            "stall_s": {k: round(v, 4) for k, v in self.stall_s.items()},
+            "guard_trips": self.guard_trips,
+            "audit_trips": self.audit_trips,
+        }
+        if self.feed_stats is not None:
+            extras["feed"] = self.feed_stats.snapshot()
+        return extras
 
     # -- numerical-integrity guard (see TrainerConfig.guard_numerics) -----
     def _finite_fn(self):

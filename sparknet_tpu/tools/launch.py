@@ -176,14 +176,18 @@ def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
                  heartbeat_dir: str | None = None,
                  round_deadline: float | None = None,
                  log_dir: str | None = None,
-                 report: dict | None = None) -> int:
+                 report: dict | None = None,
+                 on_spawn=None) -> int:
     """Spawn ``nprocs`` copies of ``cmd`` locally; returns the first
     non-zero exit code, else 0.  Output is streamed with [p<i>] prefixes.
     The first worker death kills the remaining workers immediately
     (see ``_wait_all``).  ``extra_env`` adds per-job vars to every child
     (the ResilientRunner's attempt-stamping channel); ``heartbeat_dir`` /
     ``round_deadline`` / ``log_dir`` / ``report`` are the health plane
-    (module docstring)."""
+    (module docstring).  ``on_spawn`` (if given) receives the list of
+    ``subprocess.Popen`` handles once the full gang is up — an external
+    supervisor's only safe channel to the worker pids (for preemption
+    signals and orphan accounting; see ``parallel.fleet``)."""
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
     monitor = _make_monitor(heartbeat_dir, round_deadline)
     if log_dir:
@@ -203,6 +207,8 @@ def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
         t.start()
         procs.append(p)
         threads.append(t)
+    if on_spawn is not None:
+        on_spawn(list(procs))
     rc = _wait_all(procs, timeout, monitor=monitor, report=report)
     for t in threads:
         t.join(timeout=5)
@@ -217,11 +223,14 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
                heartbeat_dir: str | None = None,
                round_deadline: float | None = None,
                log_dir: str | None = None,
-               report: dict | None = None) -> int:
+               report: dict | None = None,
+               on_spawn=None) -> int:
     """Run ``cmd`` on every host via ssh; host 0 doubles as coordinator.
     The health plane (``heartbeat_dir``/``round_deadline``) requires the
     dir to be on a filesystem shared with the supervisor — the same
-    assumption the checkpoint dir makes."""
+    assumption the checkpoint dir makes.  ``on_spawn`` receives the local
+    ssh ``Popen`` handles (signalling one ends its remote command via the
+    ssh session, so preemption still works, host by host)."""
     port = coordinator_port or 9876
     coordinator = f"{hosts[0]}:{port}"
     cwd = cwd or os.getcwd()
@@ -251,6 +260,8 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
         t.start()
         procs.append(p)
         threads.append(t)
+    if on_spawn is not None:
+        on_spawn(list(procs))
     rc = _wait_all(procs, timeout, monitor=monitor, report=report)
     for t in threads:
         t.join(timeout=5)

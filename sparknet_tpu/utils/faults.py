@@ -43,6 +43,15 @@ first-class, deterministic test input.  Faults are described by the
                                 the process — a flaky-HBM event; the
                                 cross-replica audit must catch it before
                                 the next averaging folds it in)
+              | preempt       — deliver SIGTERM to THIS process at the
+                                start of round N: the deterministic
+                                replacement for a cloud scheduler's
+                                preemption notice.  The preemption guard
+                                (utils/signals.py SNAPSHOT_STOP) must
+                                turn it into one final round checkpoint
+                                + a clean rc-0 exit, and the fleet layer
+                                must requeue-and-resume the job — NOT
+                                count it complete
 
 Scoping:
   @round:N   — fire at round N (required for crash/hang/straggle/
@@ -64,8 +73,8 @@ Scoping:
                (they model degradation and permanent loss, not a
                transient death).
 
-nan_inject, bitflip_params, feeder_die, and feeder_hang additionally fire
-at most once per process even without a restart: the guard/audit rollback
+nan_inject, bitflip_params, preempt, feeder_die, and feeder_hang
+additionally fire at most once per process even without a restart: the guard/audit rollback
 replays the same round index (and the restarted feeder replays the same
 batch index), and the replay must run clean (the deterministic
 replacement for "the cosmic ray does not strike twice").
@@ -81,6 +90,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 import sys
 import time
 import zlib
@@ -88,7 +98,7 @@ from typing import Callable, Mapping
 
 KINDS = ("crash", "perma_crash", "hang", "straggle", "slow_feed",
          "nan_inject", "corrupt_ckpt", "crash_in_ckpt", "corrupt_record",
-         "feeder_die", "feeder_hang", "bitflip_params")
+         "feeder_die", "feeder_hang", "bitflip_params", "preempt")
 
 # kinds that keep firing on every job attempt unless @attempt pins one
 _EVERY_ATTEMPT = ("slow_feed", "perma_crash", "corrupt_record")
@@ -99,7 +109,7 @@ _PROB_ARG = ("corrupt_record",)
 # kinds that must name a round (for feeder_* the "round" is the batch
 # sequence index the prefetch feeder is about to produce)
 _NEED_ROUND = ("crash", "hang", "straggle", "nan_inject", "crash_in_ckpt",
-               "feeder_die", "feeder_hang", "bitflip_params")
+               "feeder_die", "feeder_hang", "bitflip_params", "preempt")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -198,12 +208,14 @@ class FaultInjector:
     def __init__(self, specs: tuple[FaultSpec, ...], *, attempt: int = 0,
                  rank: int = 0,
                  _exit: Callable[[int], None] = os._exit,
-                 _sleep: Callable[[float], None] = time.sleep):
+                 _sleep: Callable[[float], None] = time.sleep,
+                 _kill: Callable[[int, int], None] = os.kill):
         self.specs = specs
         self.attempt = attempt
         self.rank = rank
         self._exit = _exit
         self._sleep = _sleep
+        self._kill = _kill
         self._fired: set[FaultSpec] = set()   # once-per-process kinds
 
     @classmethod
@@ -230,7 +242,8 @@ class FaultInjector:
     def on_round(self, round_idx: int, rank: int | None = None) -> None:
         """Call at the start of every training round."""
         for spec in self.specs:
-            if spec.kind not in ("crash", "perma_crash", "hang", "straggle"):
+            if spec.kind not in ("crash", "perma_crash", "hang", "straggle",
+                                 "preempt"):
                 continue
             if spec.kind == "perma_crash":
                 if spec.round is not None and spec.round != round_idx:
@@ -238,6 +251,8 @@ class FaultInjector:
             elif spec.round != round_idx:
                 continue
             if not self._active(spec, rank):
+                continue
+            if spec.kind == "preempt" and spec in self._fired:
                 continue
             who = self.rank if rank is None else rank
             print(f"FAULT: {spec.kind} at round {round_idx} on rank {who} "
@@ -248,6 +263,14 @@ class FaultInjector:
             if spec.kind == "straggle":
                 self._sleep(spec.delay_s)
                 continue  # a straggler resumes (if it survives that long)
+            if spec.kind == "preempt":
+                # the preemption notice: SIGTERM to ourselves, exactly as
+                # a cloud scheduler's grace window starts.  Once per
+                # process — the round that observes the flag checkpoints
+                # and exits, and the resumed process is PAST this round
+                self._fired.add(spec)
+                self._kill(os.getpid(), signal.SIGTERM)
+                continue  # training continues until the guard polls
             while True:  # hang: a stuck worker, killable only from outside
                 self._sleep(3600)
 

@@ -1,0 +1,621 @@
+"""Fleet scheduler coverage: job-spec grammar, gang allocation, quota /
+fair-share / aging arbitration, priority preemption, quarantine with
+post-mortem, journal replay + scheduler-death recovery (reap survivors,
+never double-launch), the launcher/runner hooks the fleet rides on
+(``on_spawn``, ``cancel``), the injected ``preempt`` fault kind, and the
+heartbeat ``extras`` telemetry channel.  The scheduler core is driven
+through ``step()`` with fake runners for determinism; the end-to-end
+paths use real subprocess stub jobs (no JAX) and one real driver job
+(marker ``chaos``)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.parallel import health
+from sparknet_tpu.parallel.fleet import (
+    COMPLETED, PREEMPTING, QUARANTINED, QUEUED, RUNNING,
+    ENV_JOB_TAG, FleetJournal, FleetScheduler, GangAllocator, JobSpec,
+    _pid_is_fleet_job, format_status,
+)
+from sparknet_tpu.utils import faults
+
+DRIVER = os.path.join(os.path.dirname(__file__), "multihost_driver.py")
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# JobSpec
+# ---------------------------------------------------------------------------
+
+def test_jobspec_json_roundtrip():
+    spec = JobSpec(name="j1", tenant="acme", priority=3, world=8,
+                   rounds=6, guard=True, fault="crash@round:2",
+                   cmd=("prog", "--out", "{out}", "--ck", "{ckpt}"),
+                   env={"K": "v"})
+    again = JobSpec.from_json(json.loads(json.dumps(spec.to_json())))
+    assert again == spec
+
+
+@pytest.mark.parametrize("kw,msg", [
+    (dict(name="bad name"), "bad job name"),
+    (dict(name="j", world=0), "world"),
+    (dict(name="j", rounds=0), "rounds"),
+    (dict(name="j", cmd=("prog", "--x")), "{out}"),
+    (dict(name="j", model="resnet50"), "no built-in driver"),
+])
+def test_jobspec_validation(kw, msg):
+    with pytest.raises(ValueError, match=msg):
+        JobSpec(**kw)
+
+
+def test_jobspec_rejects_unknown_fields():
+    with pytest.raises(ValueError, match="unknown JobSpec field"):
+        JobSpec.from_json({"name": "j", "wrold": 4})
+
+
+# ---------------------------------------------------------------------------
+# gang allocation
+# ---------------------------------------------------------------------------
+
+def test_gang_allocator_all_or_nothing():
+    a = GangAllocator(8)
+    g1 = a.allocate(5)
+    assert g1 is not None and len(g1) == 5 and a.free_count == 3
+    assert a.allocate(4) is None          # would be partial: refused whole
+    assert a.free_count == 3              # the refusal took nothing
+    g2 = a.allocate(3)
+    assert a.free_count == 0
+    a.free(g1)
+    assert a.free_count == 5
+    assert a.allocate(5) is not None      # freed gang immediately reusable
+    with pytest.raises(Exception):
+        a.free(g2 + g2)                   # double free is loud
+
+
+# ---------------------------------------------------------------------------
+# scheduler core (fake runners, manual stepping)
+# ---------------------------------------------------------------------------
+
+class FakeRunner:
+    """Stands in for ResilientRunner: blocks until released, then
+    returns ``rc``.  ``behavior`` per job name:
+      "complete"  — write the out artifact, rc 0
+      "stop"      — rc 0 WITHOUT the artifact (checkpoint-and-stop)
+      ("fail", n) — rc n
+    """
+
+    def __init__(self, job, behavior):
+        self.job = job
+        self.behavior = behavior
+        self.release = threading.Event()
+        self.canceled = False
+        self.failure = None
+        self.workdir = os.path.join(job.job_dir, "runner")
+
+    def cancel(self):
+        self.canceled = True
+        self.release.set()
+
+    def run(self):
+        assert self.release.wait(timeout=30), "fake runner never released"
+        b = self.behavior
+        if b == "complete" and not self.canceled:
+            with open(self.job.out_path, "w") as f:
+                f.write("done")
+            return 0
+        if b == "stop" or self.canceled:
+            return 0
+        if isinstance(b, tuple) and b[0] == "fail":
+            return b[1]
+        return 0
+
+
+class FakeFleet:
+    """A FleetScheduler wired to FakeRunners, stepped manually."""
+
+    def __init__(self, tmp_path, devices=8, **kw):
+        self.behaviors = {}
+        self.runners = {}
+
+        def factory(job, cmd, env):
+            r = FakeRunner(job, self.behaviors.get(job.name, "complete"))
+            self.runners.setdefault(job.name, []).append(r)
+            return r
+
+        self.sched = FleetScheduler(str(tmp_path / "fleet"), devices,
+                                    runner_factory=factory, **kw)
+
+    def submit(self, behavior="complete", **kw):
+        self.behaviors[kw["name"]] = behavior
+        return self.sched.submit(JobSpec(**kw))
+
+    def release(self, name):
+        self.runners[name][-1].release.set()
+
+    def settle(self, cond, timeout=10.0):
+        """Step until ``cond()`` (supervisor threads are real, so results
+        arrive asynchronously)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.sched.step()
+            if cond():
+                return
+            time.sleep(0.01)
+        raise AssertionError("condition never settled")
+
+
+def test_gang_scheduling_and_quota(tmp_path):
+    f = FakeFleet(tmp_path, devices=8, tenants={"acme": 4})
+    a1 = f.submit(name="a1", tenant="acme", world=4)
+    a2 = f.submit(name="a2", tenant="acme", world=4)
+    b1 = f.submit(name="b1", tenant="beta", world=4)
+    f.sched.step()
+    # acme's quota (4) admits one of its jobs; beta fills the other gang
+    assert a1.state == RUNNING and b1.state == RUNNING
+    assert a2.state == QUEUED
+    assert f.sched.allocator.free_count == 0
+    f.release("a1")
+    f.settle(lambda: a1.state == COMPLETED and a2.state == RUNNING)
+    f.release("a2")
+    f.release("b1")
+    f.settle(lambda: f.sched.done())
+    assert {j.state for j in f.sched.jobs.values()} == {COMPLETED}
+    assert f.sched.allocator.free_count == 8
+
+
+def test_fair_share_tiebreak_and_fifo(tmp_path):
+    f = FakeFleet(tmp_path, devices=4, tenants={"acme": 8, "beta": 8})
+    # acme already holds 4 slots; equal-priority queued jobs tie-break to
+    # the tenant using the smaller share of its quota
+    run = f.submit(name="hold", tenant="acme", world=4)
+    f.sched.step()
+    assert run.state == RUNNING
+    qa = f.submit(name="qa", tenant="acme", world=4)
+    qb = f.submit(name="qb", tenant="beta", world=4)
+    ranked = sorted([qa, qb], key=f.sched._rank_key)
+    assert ranked[0] is qb
+    # same tenant, same priority: FIFO
+    qa2 = f.submit(name="qa2", tenant="acme", world=4)
+    assert sorted([qa2, qa], key=f.sched._rank_key)[0] is qa
+    f.release("hold")
+    # with hold done acme's usage is back to 0 — the tie resets to FIFO
+    for name in ("qa", "qb", "qa2"):
+        f.settle(lambda: f.sched.jobs[name].state == RUNNING)
+        f.release(name)
+    f.settle(lambda: f.sched.done())
+
+
+def test_fair_share_decides_placement_under_contention(tmp_path):
+    f = FakeFleet(tmp_path, devices=8, tenants={"acme": 8, "beta": 8})
+    hold = f.submit(name="hold", tenant="acme", world=4)
+    f.sched.step()
+    assert hold.state == RUNNING
+    qa = f.submit(name="qa", tenant="acme", world=4)
+    qb = f.submit(name="qb", tenant="beta", world=4)
+    f.sched.step()
+    # one free gang, two equal-priority claimants: beta (0/8 of its
+    # quota in use) beats acme (4/8 in use), despite acme's FIFO edge
+    assert qb.state == RUNNING and qa.state == QUEUED
+    f.release("hold")
+    f.release("qb")
+    f.settle(lambda: qa.state == RUNNING)
+    f.release("qa")
+    f.settle(lambda: f.sched.done())
+
+
+def test_starvation_aging_reorders_but_never_preempts(tmp_path):
+    now = [0.0]
+    f = FakeFleet(tmp_path, devices=4, aging_rate=1.0,
+                  clock=lambda: now[0])
+    hi = f.submit(name="hi", priority=5, world=4)
+    low = f.submit(name="low", priority=0, world=4)
+    f.sched.step()
+    assert hi.state == RUNNING and low.state == QUEUED
+    # low starves for 100s: its EFFECTIVE priority dwarfs hi's, yet
+    # preemption compares STATIC priorities only — aging reorders the
+    # queue, it never evicts a runner
+    now[0] = 100.0
+    assert f.sched.effective_priority(low) == pytest.approx(100.0)
+    f.sched.step()
+    f.sched.step()
+    assert hi.state == RUNNING and low.state == QUEUED
+    # a fresh SAME-priority arrival is outranked by the starved job
+    # (same static priority, so no preemption question arises)
+    mid = f.submit(name="mid", priority=0, world=4)
+    assert sorted([low, mid], key=f.sched._rank_key)[0] is low
+    f.release("hi")
+    f.settle(lambda: hi.state == COMPLETED and low.state == RUNNING)
+    assert mid.state == QUEUED
+    f.release("low")
+    f.settle(lambda: mid.state == RUNNING)
+    f.release("mid")
+    f.settle(lambda: f.sched.done())
+
+
+def test_priority_preemption_frees_the_gang(tmp_path):
+    f = FakeFleet(tmp_path, devices=8, preempt_grace_s=30)
+    v1 = f.submit(name="v1", priority=0, world=4, behavior="complete")
+    v2 = f.submit(name="v2", priority=1, world=4, behavior="complete")
+    f.sched.step()
+    assert v1.state == RUNNING and v2.state == RUNNING
+    urgent = f.submit(name="urgent", priority=50, world=8)
+    f.sched.step()   # preemption decision: both victims evicted
+    assert v1.state == PREEMPTING and v2.state == PREEMPTING
+    assert f.runners["v1"][-1].canceled and f.runners["v2"][-1].canceled
+    # canceled runners return rc 0 without the artifact -> requeued
+    f.settle(lambda: v1.state == QUEUED and v2.state == QUEUED
+             and urgent.state == RUNNING)
+    assert v1.preempt_count == 1
+    f.release("urgent")
+    f.settle(lambda: urgent.state == COMPLETED
+             and v1.state == RUNNING and v2.state == RUNNING)
+    f.release("v1")
+    f.release("v2")
+    f.settle(lambda: f.sched.done())
+    assert v1.state == COMPLETED and v2.state == COMPLETED
+
+
+def test_no_preemption_without_strictly_higher_priority(tmp_path):
+    f = FakeFleet(tmp_path, devices=4, preempt_grace_s=30)
+    v = f.submit(name="v", priority=5, world=4)
+    f.sched.step()
+    assert v.state == RUNNING
+    peer = f.submit(name="peer", priority=5, world=4)   # equal: must wait
+    f.sched.step()
+    f.sched.step()
+    assert v.state == RUNNING and peer.state == QUEUED
+    f.release("v")
+    f.settle(lambda: v.state == COMPLETED and peer.state == RUNNING)
+    f.release("peer")
+    f.settle(lambda: f.sched.done())
+
+
+def test_non_preemptible_jobs_are_never_evicted(tmp_path):
+    f = FakeFleet(tmp_path, devices=4, preempt_grace_s=30)
+    v = f.submit(name="pinned", priority=0, world=4, preemptible=False)
+    f.sched.step()
+    assert v.state == RUNNING
+    urgent = f.submit(name="urgent", priority=99, world=4)
+    f.sched.step()
+    f.sched.step()
+    assert v.state == RUNNING and urgent.state == QUEUED
+    f.release("pinned")
+    f.settle(lambda: v.state == COMPLETED and urgent.state == RUNNING)
+    f.release("urgent")
+    f.settle(lambda: f.sched.done())
+
+
+def test_quarantine_writes_postmortem_and_reoffers_gang(tmp_path):
+    f = FakeFleet(tmp_path, devices=4)
+    bad = f.submit(name="bad", world=4, behavior=("fail", 7))
+    good = f.submit(name="good", world=4)
+    f.sched.step()
+    assert bad.state == RUNNING and good.state == QUEUED
+    f.release("bad")
+    # the freed gang is re-offered to the queued job in the same pass
+    f.settle(lambda: bad.state == QUARANTINED and good.state == RUNNING)
+    post = json.load(open(os.path.join(bad.job_dir, "postmortem.json")))
+    assert post["rc"] == 7 and post["job"] == "bad"
+    f.release("good")
+    f.settle(lambda: f.sched.done())
+    assert f.sched.run(tick_s=0.01) == 3   # quarantine -> nonzero fleet rc
+
+
+def test_clean_stop_without_artifact_requeues_then_bounds(tmp_path):
+    f = FakeFleet(tmp_path, devices=4, max_preempts=2)
+    j = f.submit(name="stopper", world=4, behavior="stop")
+    # each episode exits 0 without the artifact -> requeue; bounded by
+    # max_preempts, then quarantined.  (QUEUED->RUNNING can flip inside
+    # one step, so release each NEW runner as it appears.)
+    released = set()
+    deadline = time.monotonic() + 20
+    while j.state != QUARANTINED and time.monotonic() < deadline:
+        f.sched.step()
+        runners = f.runners.get("stopper", [])
+        if runners and runners[-1] not in released \
+                and j.state == RUNNING:
+            released.add(runners[-1])
+            runners[-1].release.set()
+        time.sleep(0.01)
+    assert j.state == QUARANTINED
+    assert j.preempt_count == 3        # 2 requeues allowed, 3rd is fatal
+    post = json.load(open(os.path.join(j.job_dir, "postmortem.json")))
+    assert "requeue loop" in post["reason"]
+
+
+def test_duplicate_job_name_rejected(tmp_path):
+    f = FakeFleet(tmp_path)
+    f.submit(name="dup", world=1)
+    with pytest.raises(Exception, match="duplicate"):
+        f.submit(name="dup", world=1)
+
+
+def test_status_and_format(tmp_path):
+    f = FakeFleet(tmp_path, devices=8, tenants={"acme": 8})
+    f.submit(name="s1", tenant="acme", world=4)
+    f.sched.step()
+    st = f.sched.status()
+    assert st["devices"] == {"total": 8, "free": 4}
+    assert st["tenants"]["acme"]["used"] == 4
+    (row,) = st["jobs"]
+    assert row["job"] == "s1" and row["state"] == RUNNING
+    assert row["rounds_target"] == 4
+    text = format_status(st)
+    assert "s1" in text and "acme" in text and "RUNNING" in text
+    f.release("s1")
+    f.settle(lambda: f.sched.done())
+
+
+# ---------------------------------------------------------------------------
+# journal + scheduler-death recovery
+# ---------------------------------------------------------------------------
+
+def _stub_path(tmp_path):
+    """A no-JAX training-job stand-in: counts rounds in a state file,
+    SIGTERM checkpoints (the state file IS the checkpoint) and exits 0,
+    completion writes the out artifact.  Resumes from the state file."""
+    p = tmp_path / "stub.py"
+    p.write_text(
+        "import os, signal, sys, time\n"
+        "state, rounds, tick, out = (sys.argv[1], int(sys.argv[2]),\n"
+        "                            float(sys.argv[3]), sys.argv[4])\n"
+        "stop = []\n"
+        "signal.signal(signal.SIGTERM, lambda *a: stop.append(1))\n"
+        "r = int(open(state).read()) if os.path.exists(state) else 0\n"
+        "while r < rounds:\n"
+        "    if stop:\n"
+        "        sys.exit(0)\n"
+        "    time.sleep(tick)\n"
+        "    r += 1\n"
+        "    with open(state, 'w') as f:\n"
+        "        f.write(str(r))\n"
+        "with open(out, 'w') as f:\n"
+        "    f.write('done')\n")
+    return str(p)
+
+
+def _stub_spec(tmp_path, name, rounds=10, tick=0.02, **kw):
+    return JobSpec(
+        name=name, rounds=rounds,
+        cmd=(sys.executable, _stub_path(tmp_path),
+             "{ckpt}/state.txt", "{rounds}", str(tick), "{out}"),
+        **kw)
+
+
+def test_stub_fleet_completes_and_journal_replays(tmp_path):
+    wd = str(tmp_path / "fleet")
+    fleet = FleetScheduler(wd, 4, preempt_grace_s=5)
+    fleet.submit(_stub_spec(tmp_path, "s1", world=2))
+    fleet.submit(_stub_spec(tmp_path, "s2", world=2))
+    assert fleet.run(tick_s=0.02, timeout_s=60) == 0
+    events = [e["ev"] for e in
+              FleetJournal.read(os.path.join(wd, "fleet_journal.jsonl"))]
+    for ev in ("fleet", "submit", "launch", "pids", "exit", "complete",
+               "done"):
+        assert ev in events
+    # resume of a finished fleet: everything stays COMPLETED and nothing
+    # is ever launched again
+
+    def exploding_factory(job, cmd, env):
+        raise AssertionError(f"double launch of {job.name}!")
+
+    again = FleetScheduler.resume(wd, runner_factory=exploding_factory)
+    assert all(j.state == COMPLETED for j in again.jobs.values())
+    assert again.run(tick_s=0.01) == 0
+
+
+def test_resume_reaps_survivor_and_requeues(tmp_path):
+    """Scheduler death with a live worker: the journal records the pid;
+    resume must identify it (env tag through /proc), kill it, and requeue
+    the job — which then resumes from its state file and completes."""
+    wd = str(tmp_path / "fleet")
+    spec = _stub_spec(tmp_path, "lone", rounds=40, tick=0.01, world=2)
+    # fabricate the dead scheduler's journal: submitted, launched, pids.
+    # The survivor itself runs a much longer round count, so it is still
+    # alive when the resumed scheduler looks for it.
+    sched = FleetScheduler(wd, 4)   # writes the fleet record
+    job = sched.submit(spec)
+    os.makedirs(job.ckpt_dir, exist_ok=True)
+    proc = subprocess.Popen(
+        [c.format(out=job.out_path, ckpt=job.ckpt_dir, world="2",
+                  rounds="100000") for c in spec.cmd],
+        env={**os.environ, ENV_JOB_TAG: "lone"})
+    sched.journal.append("launch", job="lone", episode=1, slots=[0, 1])
+    sched.journal.append("pids", job="lone", pids=[proc.pid])
+    sched.journal.close()
+    del sched
+    time.sleep(0.3)
+    assert proc.poll() is None and _pid_is_fleet_job(proc.pid, "lone")
+
+    fleet = FleetScheduler.resume(wd)
+    # the survivor was reaped before the job could be relaunched
+    assert proc.wait(timeout=10) is not None
+    job2 = fleet.jobs["lone"]
+    assert job2.state == QUEUED
+    # shrink the remaining work and let it finish from its checkpoint
+    state = os.path.join(job2.ckpt_dir, "state.txt")
+    resumed_from = int(open(state).read()) if os.path.exists(state) else 0
+    assert fleet.run(tick_s=0.02, timeout_s=60) == 0
+    assert job2.completed_ok()
+    if resumed_from:
+        # the second launch started from the survivor's checkpoint, not 0
+        assert int(open(state).read()) >= resumed_from
+
+
+def test_pid_identity_check_never_kills_strangers(tmp_path):
+    # a live process WITHOUT our env tag is never "ours", whatever the
+    # journal says — pid recycling must not let the fleet kill strangers
+    stranger = subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(30)"])
+    try:
+        assert not _pid_is_fleet_job(stranger.pid, "anyjob")
+        wd = str(tmp_path / "fleet")
+        sched = FleetScheduler(wd, 4)
+        sched.submit(_stub_spec(tmp_path, "ghost", rounds=1, world=1))
+        sched.journal.append("pids", job="ghost", pids=[stranger.pid])
+        sched.journal.close()
+        fleet = FleetScheduler.resume(wd)
+        assert stranger.poll() is None          # untouched
+        assert fleet.run(tick_s=0.02, timeout_s=60) == 0
+    finally:
+        stranger.kill()
+
+
+def test_stub_preempt_resume_e2e(tmp_path):
+    """Fleet-level preemption against real processes: the victim's
+    SIGTERM handler checkpoints (state file) and exits 0; the fleet
+    requeues it; after the urgent job drains, the victim resumes FROM
+    ITS CHECKPOINT and completes — no lost progress beyond the round in
+    flight."""
+    wd = str(tmp_path / "fleet")
+    fleet = FleetScheduler(wd, 4, preempt_grace_s=5)
+    victim = fleet.submit(_stub_spec(tmp_path, "victim", rounds=60,
+                                     tick=0.03, world=4, priority=0))
+    urgent = fleet.submit(_stub_spec(tmp_path, "urgent", rounds=5,
+                                     tick=0.02, world=4, priority=50,
+                                     not_before_s=0.4))
+    assert fleet.run(tick_s=0.02, timeout_s=120) == 0
+    assert victim.state == COMPLETED and urgent.state == COMPLETED
+    assert victim.preempt_count >= 1
+    assert int(open(os.path.join(victim.ckpt_dir,
+                                 "state.txt")).read()) == 60
+    assert fleet.live_worker_pids() == {}
+
+
+# ---------------------------------------------------------------------------
+# the hooks the fleet rides on
+# ---------------------------------------------------------------------------
+
+def test_launch_local_on_spawn_exposes_the_gang():
+    from sparknet_tpu.tools.launch import launch_local
+    seen = []
+    rc = launch_local([sys.executable, "-c", "pass"], nprocs=2,
+                      timeout=60, on_spawn=lambda procs: seen.append(procs))
+    assert rc == 0
+    assert len(seen) == 1 and len(seen[0]) == 2
+    assert all(p.pid > 0 for p in seen[0])
+
+
+def test_runner_cancel_stops_restarts(monkeypatch):
+    from sparknet_tpu.parallel import resilience as R
+    runner = R.ResilientRunner(["prog"], nprocs=2,
+                               policy=R.RestartPolicy(max_restarts=5,
+                                                      backoff_base=0.0))
+    calls = []
+
+    def fake_local(cmd, nprocs, **kw):
+        calls.append(1)
+        runner.cancel()       # cancel lands while the attempt is dying
+        return 9
+
+    monkeypatch.setattr(R, "launch_local", fake_local)
+    assert runner.run() == 9
+    assert len(calls) == 1            # no restart after the cancel
+    assert runner.failure is None     # preempted, not failed
+
+
+def test_runner_cancel_run_or_raise_is_typed(monkeypatch):
+    from sparknet_tpu.parallel import resilience as R
+    runner = R.ResilientRunner(["prog"], nprocs=2,
+                               policy=R.RestartPolicy(max_restarts=5,
+                                                      backoff_base=0.0))
+
+    def fake_local(cmd, nprocs, **kw):
+        runner.cancel()
+        return 9
+
+    monkeypatch.setattr(R, "launch_local", fake_local)
+    with pytest.raises(R.ResilienceError, match="canceled"):
+        runner.run_or_raise()
+
+
+def test_preempt_fault_kind_fires_sigterm_once():
+    spec = faults.parse_faults("preempt@round:2")[0]
+    assert spec.kind == "preempt" and spec.round == 2
+    kills = []
+    inj = faults.FaultInjector((spec,), _kill=lambda pid, sig:
+                               kills.append((pid, sig)))
+    inj.on_round(0)
+    inj.on_round(1)
+    assert kills == []
+    inj.on_round(2)
+    assert kills == [(os.getpid(), signal.SIGTERM)]
+    inj.on_round(2)            # once per process: the resumed replay
+    assert len(kills) == 1     # must run clean
+    with pytest.raises(ValueError, match="needs @round"):
+        faults.parse_faults("preempt")
+
+
+def test_heartbeat_extras_roundtrip(tmp_path):
+    d = str(tmp_path / "hb")
+    extras = {"stall_s": {"checkpoint": 0.12}, "feed": {"batches": 7}}
+    health.write_beat(d, 3, 5, "round_end", extras=extras)
+    beat = health.read_beat(d, 3)
+    assert beat.extras == extras
+    assert beat.round == 5
+    # beats without extras (every pre-fleet writer) read back as None
+    health.write_beat(d, 4, 5, "round_end")
+    assert health.read_beat(d, 4).extras is None
+    # the straggler monitor is oblivious to extras
+    mon = health.StragglerMonitor(d, deadline_s=1e6)
+    assert mon.check([3, 4]) == []
+
+
+# ---------------------------------------------------------------------------
+# real-driver end to end (one job preempted by fault, one clean)
+# ---------------------------------------------------------------------------
+
+def _clean_launch_env():
+    saved = dict(os.environ)
+    os.environ.pop("XLA_FLAGS", None)
+    for k in list(os.environ):
+        if k.startswith("SPARKNET_"):
+            os.environ.pop(k)
+    return saved
+
+
+@pytest.mark.chaos
+def test_driver_fleet_preempt_resume_bit_identical(tmp_path):
+    """THE fleet acceptance path in miniature: a driver job that
+    self-preempts at round 1 (SIGTERM -> snapshot -> clean exit ->
+    fleet requeue -> resume) must finish with params bit-identical to
+    an unpreempted run of the same config."""
+    from sparknet_tpu.tools.launch import launch_local
+    saved = _clean_launch_env()
+    try:
+        base = str(tmp_path / "base.npz")
+        rc = launch_local(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", base,
+             "--local-devices", "4", "--rounds", "4"],
+            nprocs=1, platform="cpu", timeout=300)
+        assert rc == 0
+        fleet = FleetScheduler(str(tmp_path / "fleet"), 4,
+                               preempt_grace_s=20)
+        job = fleet.submit(JobSpec(name="pre", world=4, rounds=4,
+                                   fault="preempt@round:1"))
+        assert fleet.run(tick_s=0.05, timeout_s=240) == 0
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    assert job.state == COMPLETED and job.preempt_count >= 1
+    a, b = np.load(base), np.load(job.out_path)
+    for k in a.files:
+        if k.startswith("__"):
+            continue
+        assert np.array_equal(a[k], b[k]), f"param {k} diverged"
+    assert fleet.live_worker_pids() == {}
+
+
+def test_oversized_gang_rejected_at_submit(tmp_path):
+    f = FakeFleet(tmp_path, devices=4)
+    with pytest.raises(Exception, match="never be placed"):
+        f.submit(name="huge", world=8)

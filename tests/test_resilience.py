@@ -648,6 +648,116 @@ def test_crash_restart_two_process_one_rank(tmp_path, multiprocess_cpu):
         fault="crash@round:3@rank:1")
 
 
+# ---------------------------------------------------------------------------
+# preemption (SNAPSHOT_STOP) x in-flight AsyncCheckpointWriter: a preempt
+# that lands while a background checkpoint write is still queued must
+# FLUSH the write, never tear it (the PR-2 x PR-5 interaction)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_preemption_guard_flushes_inflight_async_writer(tmp_path,
+                                                        monkeypatch):
+    import signal as _signal
+
+    from sparknet_tpu.utils import checkpoint as ckpt_mod
+    from sparknet_tpu.utils.signals import SolverAction, preemption_guard
+
+    # slow the durable write down so the preemption provably arrives
+    # while the writer job is still in the queue/in flight
+    real_save = ckpt_mod.save_checkpoint
+
+    def slow_save(path, tree):
+        time.sleep(0.4)
+        real_save(path, tree)
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", slow_save)
+
+    d = tmp_path / "ck"
+    tr = _make_trainer(d)          # async checkpointing is the default
+    tr.train_round(_batch(0))      # round-1 checkpoint enters the queue
+    assert tr._ckpt_writer is not None
+    pending_at_signal = tr._ckpt_writer.pending
+    assert pending_at_signal >= 1  # the write is genuinely in flight
+
+    with preemption_guard() as guard:
+        os.kill(os.getpid(), _signal.SIGTERM)   # the preemption notice
+        action = SolverAction.NONE
+        for _ in range(200):       # delivery is at a bytecode boundary
+            action = guard.check()
+            if action != SolverAction.NONE:
+                break
+            time.sleep(0.01)
+        assert action == SolverAction.SNAPSHOT_STOP
+        # the driver's preemption sequence (multihost_driver.py): settle
+        # in-flight rounds, one final checkpoint, durability barrier
+        tr.drain()
+        tr.save_round_checkpoint()
+        tr.flush_checkpoints()     # must flush the queued write, not tear
+
+    # every manifest on disk validates, and the newest is the final round
+    tr2 = _make_trainer(d, seed=99)
+    assert tr2.resumed is not None
+    assert tr2.round == tr.round == 1
+    assert np.array_equal(np.asarray(tr2.params["conv1"][0]),
+                          np.asarray(tr.params["conv1"][0]))
+    assert np.array_equal(np.asarray(tr2.params["ip2"][0]),
+                          np.asarray(tr.params["ip2"][0]))
+
+
+@pytest.mark.chaos
+def test_sigterm_preemption_with_async_writer_driver_e2e(tmp_path):
+    """End to end across processes: SIGTERM a live driver mid-run (async
+    checkpoint writer active), expect a clean rc-0 exit with a durable
+    final snapshot, then resume and finish — params bit-identical to an
+    uninterrupted run."""
+    import signal as _signal
+
+    saved = _clean_launch_env()
+    try:
+        base = str(tmp_path / "base.npz")
+        r = subprocess.run(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", base,
+             "--local-devices", "4", "--rounds", "5"],
+            timeout=300, capture_output=True)
+        assert r.returncode == 0, r.stdout.decode(errors="replace")
+
+        out = str(tmp_path / "out.npz")
+        ck = str(tmp_path / "ck")
+        cmd = [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
+               "--local-devices", "4", "--rounds", "5", "--ckpt-dir", ck]
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        deadline = time.monotonic() + 240
+        tail = []
+        for line in iter(p.stdout.readline, b""):
+            tail.append(line)
+            if b"round 1 done" in line:
+                p.send_signal(_signal.SIGTERM)
+                break
+            assert time.monotonic() < deadline, b"".join(tail).decode()
+        rest, _ = p.communicate(timeout=240)
+        text = (b"".join(tail) + rest).decode(errors="replace")
+        assert p.returncode == 0, text     # preemption is a CLEAN exit
+        assert "preempted; stopped cleanly" in text
+        assert not os.path.exists(out)     # stopped, not finished
+        assert any(f.startswith("manifest_") for f in os.listdir(ck))
+
+        r = subprocess.run(cmd, timeout=300, capture_output=True)
+        text2 = r.stdout.decode(errors="replace")
+        assert r.returncode == 0, text2
+        assert "driver: resumed at round" in text2
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+    a, b = np.load(base), np.load(out)
+    for k in a.files:
+        if k.startswith("__"):
+            continue
+        assert np.array_equal(a[k], b[k]), \
+            f"param {k} diverged across preempt/resume"
+
+
 @pytest.mark.chaos
 @pytest.mark.slow
 def test_hang_restart_recovers_via_timeout(tmp_path):

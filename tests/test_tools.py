@@ -781,3 +781,41 @@ snapshot: 1
     with pytest.raises(SystemExit, match="require --devices"):
         caffe_cli.main(["train", "--solver", str(solver),
                         "--strategy", "local_sgd"])
+
+
+def test_plot_learning_proxy_renders_png(tmp_path):
+    """The paper's headline figure renders from a RESULTS JSON — per-row
+    wall_s when present, else a linear reconstruction from the curve
+    total, and corrupt walls are dropped rather than plotted wrong."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    curve = [{"iter": i, "lr": 0.001, "train_loss": 1.0,
+              "train_acc": 0.5 + 0.04 * n, "test_acc": 0.4 + 0.04 * n}
+             for n, i in enumerate(range(100, 1100, 100))]
+    rows_with_wall = [dict(r, wall_s=2.0 * n + 1)
+                      for n, r in enumerate(curve)]
+    results = {
+        "config": {"scale": 10, "max_iter": 1000,
+                   "stepvalues": [600, 800], "batch": 100},
+        "device": "cpu/test",
+        "curve_1x": rows_with_wall,          # per-row wall: used as-is
+        "curve_8way": curve,                 # no rows: reconstructed
+        "curve_hier": curve,                 # corrupt total: dropped
+        "final": {"acc_1x": 0.8, "acc_8way": 0.76, "acc_hier": 0.75,
+                  "wall_s_1x": 99.0, "wall_s_8way": 50.0,
+                  "wall_s_hier": 0.1},
+    }
+    src = tmp_path / "r.json"
+    src.write_text(json.dumps(results))
+    out = tmp_path / "r.png"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "plot_learning_proxy.py"),
+         "--in", str(src), "--out", str(out)],
+        capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert out.exists() and out.stat().st_size > 10_000
+    verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert verdict["synthesized_wall"] == ["8way"]
+    assert verdict["dropped"] == ["hierarchical 2×4"]

@@ -1,0 +1,141 @@
+"""Fleet scheduler CLI — run a multi-tenant queue of training jobs.
+
+Thin shell over ``sparknet_tpu.parallel.fleet.FleetScheduler``: load job
+specs from JSON, schedule them onto a device budget with per-tenant
+quotas and priority preemption, supervise each through its per-job
+ResilientRunner, and keep a crash-safe journal so a killed scheduler
+resumes with ``--resume`` (surviving workers are reaped first — no
+double launch, no orphans).
+
+Job file: a JSON list of JobSpec objects, e.g.
+
+    [{"name": "cifar-a", "tenant": "acme", "priority": 0, "world": 4,
+      "rounds": 4},
+     {"name": "urgent",  "tenant": "beta", "priority": 50, "world": 8,
+      "rounds": 4, "not_before_s": 6.0}]
+
+Usage:
+  python tools/fleet.py --devices 8 --workdir /tmp/fleet \
+      --jobs jobs.json --quota acme=4 --status-every 5
+  python tools/fleet.py --workdir /tmp/fleet --resume     # after a kill
+
+Exit code 0 when every job completed; 3 when any was quarantined (each
+leaves a ``postmortem.json`` in its job dir).
+
+``--render-proxy-figure`` renders the accuracy-vs-wall-clock chart
+(tools/plot_learning_proxy.py) after the fleet drains — the demo
+deliverable of ROADMAP item 5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load_specs(path: str):
+    from sparknet_tpu.parallel.fleet import JobSpec
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, list):
+        raise SystemExit(f"{path}: expected a JSON list of job specs")
+    return [JobSpec.from_json(d) for d in raw]
+
+
+def parse_quotas(pairs):
+    quotas = {}
+    for p in pairs or ():
+        name, _, val = p.partition("=")
+        if not name or not val:
+            raise SystemExit(f"bad --quota {p!r} (want tenant=slots)")
+        try:
+            quotas[name] = int(val)
+        except ValueError:
+            raise SystemExit(f"bad --quota {p!r}: {val!r} is not an int")
+    return quotas
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="multi-tenant training fleet scheduler")
+    ap.add_argument("--workdir", required=True,
+                    help="fleet state dir (journal, per-job artifacts)")
+    ap.add_argument("--jobs", default=None,
+                    help="JSON list of job specs (required unless "
+                         "--resume)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="total device slices in the budget")
+    ap.add_argument("--quota", action="append", default=[],
+                    metavar="TENANT=SLOTS",
+                    help="per-tenant slot quota (repeatable)")
+    ap.add_argument("--resume", action="store_true",
+                    help="rebuild the queue from the journal after a "
+                         "scheduler death (reaps surviving workers; "
+                         "never double-launches)")
+    ap.add_argument("--aging", type=float, default=1.0 / 60.0,
+                    help="starvation aging: priority gained per queued "
+                         "second (default 1/60)")
+    ap.add_argument("--no-preempt", action="store_true",
+                    help="disable priority preemption")
+    ap.add_argument("--preempt-grace", type=float, default=10.0,
+                    help="seconds between SIGTERM and SIGKILL")
+    ap.add_argument("--tick", type=float, default=0.2)
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="bound the whole fleet run (seconds)")
+    ap.add_argument("--status-every", type=float, default=5.0,
+                    help="print the fleet status table this often "
+                         "(0 = silent)")
+    ap.add_argument("--render-proxy-figure", action="store_true",
+                    help="after the fleet drains, render the "
+                         "accuracy-vs-wall-clock figure "
+                         "(tools/plot_learning_proxy.py)")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.parallel.fleet import (
+        FleetScheduler, format_status,
+    )
+
+    if args.resume:
+        fleet = FleetScheduler.resume(
+            args.workdir, aging_rate=args.aging,
+            preempt=not args.no_preempt,
+            preempt_grace_s=args.preempt_grace)
+    else:
+        if not args.jobs:
+            ap.error("--jobs is required (or --resume)")
+        fleet = FleetScheduler(
+            args.workdir, args.devices, tenants=parse_quotas(args.quota),
+            aging_rate=args.aging, preempt=not args.no_preempt,
+            preempt_grace_s=args.preempt_grace)
+        for spec in load_specs(args.jobs):
+            fleet.submit(spec)
+
+    try:
+        rc = fleet.run(tick_s=args.tick, timeout_s=args.timeout,
+                       status_every_s=args.status_every)
+    except KeyboardInterrupt:
+        print("fleet: interrupted — shutting the fleet down "
+              "(journal keeps the queue; rerun with --resume)",
+              file=sys.stderr, flush=True)
+        fleet.shutdown()
+        return 130
+    print(format_status(fleet.status()), flush=True)
+    orphans = fleet.live_worker_pids()
+    if orphans:
+        print(f"fleet: ERROR — orphaned workers survived: {orphans}",
+              file=sys.stderr, flush=True)
+        return 4
+    if args.render_proxy_figure and rc == 0:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import plot_learning_proxy
+        rc = plot_learning_proxy.main([]) or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

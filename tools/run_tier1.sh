@@ -52,6 +52,22 @@ maybe_feedbench() {
   fi
 }
 
+# ~60-second two-job fleet chaos smoke (tools/soak.py --fleet 2) — opt-in
+# via SPARKNET_FLEETSOAK=1.  Two concurrent jobs under one FleetScheduler
+# with pinned crash + preempt schedules, plus a late whole-budget
+# high-priority preemptor: every job must finish bit-identical to its
+# fault-free baseline, with preempt/resume exercised and zero orphaned
+# worker processes.  (The full acceptance run is
+# `python tools/soak.py --fleet 4 --fleet-kill`, which additionally
+# SIGKILLs the scheduler mid-run and resumes it from its journal.)
+maybe_fleetsoak() {
+  if [ "${SPARKNET_FLEETSOAK:-}" = "1" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python tools/soak.py --fleet 2 --seed "${SPARKNET_SOAK_SEED:-0}" \
+      --out /tmp/_fleetsoak.json
+  fi
+}
+
 # ~10-second sync-vs-async outer-loop parity smoke (tools/roundbench.py)
 # — opt-in via SPARKNET_ROUNDBENCH=1.  Fails the gate unless the
 # pipelined loop (harvest_lag + AsyncCheckpointWriter) reproduces the
@@ -68,11 +84,13 @@ maybe_roundbench() {
 case "${1:-}" in
   --chaos) run_chaos ;;
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
+  --fleetsoak) SPARKNET_FLEETSOAK=1 maybe_fleetsoak ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
-  --all)   run_tier1 && run_chaos && maybe_soak && maybe_feedbench \
+  --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
+             && maybe_feedbench && maybe_roundbench ;;
+  "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
              && maybe_roundbench ;;
-  "")      run_tier1 && maybe_soak && maybe_feedbench && maybe_roundbench ;;
-  *) echo "usage: $0 [--chaos|--soak|--feedbench|--roundbench|--all]" >&2
+  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--all]" >&2
      exit 2 ;;
 esac

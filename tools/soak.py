@@ -12,9 +12,22 @@ bit-for-bit the fault-free baseline of the same configuration.  The
 randomness is fully derived from ``--seed``, so any red verdict is
 replayable with the same command line.
 
+Fleet mode (``--fleet N``) is the FLEET-WIDE composition check: N
+seeded jobs (each with its own injected crash/straggle/preempt/nan
+schedule) run CONCURRENTLY under one ``FleetScheduler``, plus a
+late-arriving high-priority job sized to the whole device budget that
+forces a fleet-level preemption of everything running.  With
+``--fleet-kill`` the scheduler itself is SIGKILLed mid-run and resumed
+from its journal.  The verdict requires every job to reach its target
+round with final params bit-identical to its fault-free baseline, the
+resumed queue to never double-launch, and ZERO orphaned worker
+processes at the end.
+
 Usage:
   python tools/soak.py --runs 8 --seed 0 --out soak.json
-  SPARKNET_SOAK=1 tools/run_tier1.sh     # the 2-run CI smoke
+  python tools/soak.py --fleet 4 --fleet-kill --seed 0   # fleet chaos
+  SPARKNET_SOAK=1 tools/run_tier1.sh       # the 2-run CI smoke
+  SPARKNET_FLEETSOAK=1 tools/run_tier1.sh  # the 2-job fleet smoke
 
 Exit code 0 iff every run recovered exactly; the JSON verdict names each
 run's schedule, exit code, attempt count, and whether the params matched.
@@ -59,10 +72,13 @@ def _clean_env():
             os.environ.pop(k)
 
 
-def _run_driver(out, ckpt, flags, fault=None, max_restarts=2):
+def _run_driver(out, ckpt, flags, fault=None, max_restarts=2,
+                local_devices=4, rounds=4):
     from sparknet_tpu.parallel.resilience import ResilientRunner, RestartPolicy
     cmd = [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
-           "--local-devices", "4", "--rounds", "4"] + flags
+           "--local-devices", str(local_devices),
+           "--expect-devices", str(local_devices),
+           "--rounds", str(rounds)] + flags
     if ckpt:
         cmd += ["--ckpt-dir", ckpt]
     runner = ResilientRunner(
@@ -84,6 +100,178 @@ def _params_match(base_npz, out_npz):
     return True, None
 
 
+# ---------------------------------------------------------------------------
+# Fleet chaos soak (--fleet N): concurrent jobs, one scheduler, injected
+# crash/straggle/preempt/nan schedules + fleet-level priority preemption
+# (+ optional scheduler kill/resume), verified bit-identical and orphan-free
+# ---------------------------------------------------------------------------
+
+def _fleet_schedules(rng, i):
+    """Seeded fault schedule for fleet job ``i``.  The first FOUR jobs
+    are pinned to the crash / preempt / nan / straggle families in that
+    order, so the 2-job CI smoke (SPARKNET_FLEETSOAK=1) always covers
+    the preempt/resume/crash triangle and any >= 4-job acceptance run
+    covers all four; later jobs draw seeded from the full menu (the
+    round numbers stay seeded for every job)."""
+    r = int(rng.integers(1, 3))
+    menu = [
+        ("crash", f"crash@round:{r}", False),
+        ("preempt", f"preempt@round:{r}", False),
+        ("nan_inject", f"nan_inject@round:{r}", True),
+        ("straggle+crash",
+         f"straggle:0.5s@round:{r},crash@round:{r}@attempt:0", False),
+        ("crash_in_ckpt", f"crash_in_ckpt@round:{r}", False),
+        ("corrupt_ckpt", f"corrupt_ckpt@round:{r}", False),
+    ]
+    if i < 4:
+        return menu[i]
+    return menu[int(rng.integers(0, len(menu)))]
+
+
+def _journal_pids(workdir):
+    """Every worker pid the fleet journal ever recorded."""
+    from sparknet_tpu.parallel.fleet import FleetJournal
+    pids = {}
+    path = os.path.join(workdir, "fleet_journal.jsonl")
+    for ev in FleetJournal.read(path):
+        if ev.get("ev") == "pids":
+            pids.setdefault(ev["job"], set()).update(ev.get("pids", []))
+    return pids
+
+
+def fleet_soak(args) -> int:
+    import numpy as np
+
+    from sparknet_tpu.parallel.fleet import (
+        FleetScheduler, JobSpec, _pid_is_fleet_job, format_status,
+    )
+
+    _clean_env()
+    rng = np.random.default_rng(args.seed)
+    own_tmp = args.workdir is None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sparknet_fleet_")
+    os.makedirs(workdir, exist_ok=True)
+    fleet_dir = os.path.join(workdir, "fleet")
+    devices = args.fleet_devices
+    t0 = time.monotonic()
+
+    # -- job set: N faulted jobs + the late high-priority preemptor ------
+    specs, meta = [], {}
+    for i in range(args.fleet):
+        name, fault, guard = _fleet_schedules(rng, i)
+        spec = JobSpec(
+            name=f"job{i}", tenant=("acme", "beta")[i % 2],
+            priority=i % 2, world=4, rounds=4, guard=guard, fault=fault,
+            max_restarts=2, timeout_s=300.0)
+        specs.append(spec)
+        meta[spec.name] = {"schedule": name, "fault": fault}
+    preemptor = JobSpec(
+        name="preemptor", tenant="ops", priority=99, world=devices,
+        rounds=3, not_before_s=args.fleet_preempt_after,
+        preemptible=False, timeout_s=300.0)
+    specs.append(preemptor)
+    meta[preemptor.name] = {"schedule": "clean-high-priority", "fault": None}
+
+    # -- fault-free baselines, one per distinct job shape ----------------
+    baselines: dict[tuple, str] = {}
+
+    def baseline_for(spec):
+        key = (spec.world, spec.rounds, spec.guard)
+        if key not in baselines:
+            path = os.path.join(workdir, f"base_{len(baselines)}.npz")
+            ck = os.path.join(workdir, f"base_ck_{len(baselines)}")
+            flags = ["--guard"] if spec.guard else []
+            rc, _ = _run_driver(path, ck if flags else None, flags,
+                                local_devices=spec.world,
+                                rounds=spec.rounds)
+            if rc != 0:
+                raise RuntimeError(f"fault-free baseline failed rc={rc} "
+                                   f"(shape={key})")
+            baselines[key] = path
+        return baselines[key]
+
+    for spec in specs:
+        baseline_for(spec)
+
+    # -- run the fleet (optionally killing the scheduler mid-run) --------
+    killed = False
+    if args.fleet_kill:
+        jobs_json = os.path.join(workdir, "jobs.json")
+        with open(jobs_json, "w") as f:
+            json.dump([s.to_json() for s in specs], f)
+        import signal
+        import subprocess
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "fleet.py"),
+             "--workdir", fleet_dir, "--devices", str(devices),
+             "--jobs", jobs_json, "--status-every", "0"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        time.sleep(args.fleet_kill_after)
+        proc.send_signal(signal.SIGKILL)   # no grace: the worst case
+        proc.wait()
+        killed = True
+        print(f"fleet-soak: scheduler SIGKILLed after "
+              f"{args.fleet_kill_after}s; resuming from the journal",
+              flush=True)
+        fleet = FleetScheduler.resume(fleet_dir)
+    else:
+        fleet = FleetScheduler(fleet_dir, devices,
+                               tenants={"acme": devices, "beta": devices})
+        for spec in specs:
+            fleet.submit(spec)
+    rc = fleet.run(tick_s=0.1, timeout_s=args.fleet_timeout)
+
+    # -- verdict ---------------------------------------------------------
+    jobs = []
+    for spec in specs:
+        job = fleet.jobs[spec.name]
+        verdict = dict(meta[spec.name], job=spec.name, state=job.state,
+                       episodes=job.episodes, attempts=job.restarts_used,
+                       preempts=job.preempt_count)
+        if job.state == "COMPLETED":
+            match, bad = _params_match(baseline_for(spec), job.out_path)
+            verdict.update(match=match,
+                           **({"diverged_at": bad} if not match else {}))
+        else:
+            verdict.update(match=False)
+        verdict["ok"] = job.state == "COMPLETED" and verdict["match"]
+        jobs.append(verdict)
+
+    # zero-orphans: every pid the journal ever recorded must be dead (or
+    # provably not ours anymore)
+    orphans = {name: sorted(p for p in pids
+                            if _pid_is_fleet_job(p, name))
+               for name, pids in _journal_pids(fleet_dir).items()}
+    orphans = {k: v for k, v in orphans.items() if v}
+    preempt_seen = any(j["preempts"] > 0 for j in jobs)
+
+    passed = sum(1 for j in jobs if j["ok"])
+    report = {"mode": "fleet", "seed": args.seed, "devices": devices,
+              "killed_scheduler": killed, "jobs": jobs,
+              "passed": passed, "failed": len(jobs) - passed,
+              "orphans": orphans, "preemption_exercised": preempt_seen,
+              "elapsed_s": round(time.monotonic() - t0, 1),
+              "ok": (rc == 0 and passed == len(jobs) and not orphans
+                     and preempt_seen)}
+    print(format_status(fleet.status()), flush=True)
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"fleet-soak: verdict written to {args.out} "
+              f"({passed}/{len(jobs)} passed"
+              f"{', orphans!' if orphans else ''})")
+    else:
+        print(text)
+    if own_tmp and report["ok"]:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not report["ok"]:
+        print(f"fleet-soak: scratch kept at {workdir} for post-mortem",
+              file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="chaos soak runner")
     ap.add_argument("--runs", type=int, default=8)
@@ -92,7 +280,23 @@ def main(argv=None) -> int:
                     help="write the JSON verdict here (default: stdout)")
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: a TemporaryDirectory)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet mode: N concurrent seeded chaos jobs + a "
+                         "late whole-budget preemptor under one "
+                         "FleetScheduler (0 = classic per-run soak)")
+    ap.add_argument("--fleet-devices", type=int, default=8)
+    ap.add_argument("--fleet-kill", action="store_true",
+                    help="SIGKILL the scheduler mid-run and resume it "
+                         "from its journal")
+    ap.add_argument("--fleet-kill-after", type=float, default=6.0)
+    ap.add_argument("--fleet-preempt-after", type=float, default=5.0,
+                    help="delay before the high-priority preemptor "
+                         "arrives")
+    ap.add_argument("--fleet-timeout", type=float, default=420.0)
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return fleet_soak(args)
 
     import numpy as np
     _clean_env()
