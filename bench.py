@@ -35,7 +35,9 @@ BENCH_FEED_BATCH, BENCH_FEED_ITERS, BENCH_FEED_DELAY_S (per-batch host
 decode stand-in, see measure_feed); round-overhead tier (outer-loop
 host stalls with ckpt+guard+audit on, sync vs async — see
 measure_round_overhead): BENCH_ROUND=0 to skip, BENCH_ROUND_N/_TAU/
-_LAG/_BATCH/_EVERY.
+_LAG/_BATCH/_EVERY; serving tier (closed-loop latency/QPS through the
+inference engine — see measure_serving): BENCH_SERVING=0 to skip,
+BENCH_SERVE_MODEL/_CLIENTS/_WINDOW/_SECONDS.
 """
 
 from __future__ import annotations
@@ -444,6 +446,35 @@ def run_child() -> None:
                 / max(async_["stall_total_s_per_round"], 1e-6), 1),
         }
 
+    def measure_serving() -> dict:
+        """The serving-plane leg: closed-loop latency/QPS through the
+        dynamic micro-batching engine (parallel/serving.py) — batch=1
+        baseline vs dynamic saturation, a paced sweep with the
+        bit-identity audit, and a 2x-overload point showing typed
+        rejections with bounded p99.  Runs tools/serveload.run_report
+        in-process so the BENCH JSON and the committed BENCH_serving_*
+        artifacts share one methodology.  Knobs: BENCH_SERVE_MODEL
+        (default BENCH_MODEL), BENCH_SERVE_CLIENTS/_WINDOW/_SECONDS;
+        BENCH_SERVING=0 skips the leg."""
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        import serveload
+        rep = serveload.run_report(
+            model=os.environ.get("BENCH_SERVE_MODEL", MODEL),
+            clients=int(os.environ.get("BENCH_SERVE_CLIENTS", 8)),
+            window=int(os.environ.get("BENCH_SERVE_WINDOW", 16)),
+            seconds=float(os.environ.get("BENCH_SERVE_SECONDS", 1.5)),
+            fractions=(0.5, 1.0))
+        rep.pop("engine_stats", None)   # the BENCH line stays one screen
+        _log(f"serving[{rep['model']}]: dynamic "
+             f"{rep['saturation']['achieved_qps']} qps vs batch1 "
+             f"{rep['batch1']['achieved_qps']} qps "
+             f"({rep['verdicts']['batching_speedup_x']}x), overload p99 "
+             f"{rep['overload']['p99_ms']} ms with "
+             f"{rep['verdicts']['overload_rejected']} rejections, "
+             f"mismatches {rep['verdicts']['exact_mismatches']}")
+        return rep
+
     dtypes = [DTYPE] if DTYPE in ("f32", "bf16") else ["bf16", "f32"]
     runs = {d: measure(d) for d in dtypes}
     best = max(dtypes, key=lambda d: runs[d]["images_per_sec"])
@@ -462,6 +493,13 @@ def run_child() -> None:
         except Exception as e:  # this tier must not sink the bench either
             _log(f"round_overhead measurement failed: {e}")
             round_overhead = {"error": str(e)}
+    serving = None
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        try:
+            serving = measure_serving()
+        except Exception as e:  # this tier must not sink the bench either
+            _log(f"serving measurement failed: {e}")
+            serving = {"error": str(e)}
     result = {
         "metric": f"{MODEL}_train_images_per_sec",
         "value": b["images_per_sec"],
@@ -486,6 +524,7 @@ def run_child() -> None:
         "by_dtype": runs,
         "feed_in_loop": feed,
         "round_overhead": round_overhead,
+        "serving": serving,
     }
     print(json.dumps(result), flush=True)
 
@@ -526,7 +565,12 @@ _CONFIG_ENVS = ("BENCH_PLATFORM", "BENCH_MODEL", "BENCH_BATCH",
                 "SPARKNET_FEED_DEPTH", "SPARKNET_FEED_PUTTERS",
                 "BENCH_ROUND_N", "BENCH_ROUND_TAU", "BENCH_ROUND_LAG",
                 "BENCH_ROUND_BATCH", "BENCH_ROUND_EVERY",
-                "SPARKNET_ASYNC_CKPT")
+                "SPARKNET_ASYNC_CKPT",
+                "BENCH_SERVE_MODEL", "BENCH_SERVE_CLIENTS",
+                "BENCH_SERVE_WINDOW", "BENCH_SERVE_SECONDS",
+                "SPARKNET_SERVE_SHAPES", "SPARKNET_SERVE_MAX_DELAY_MS",
+                "SPARKNET_SERVE_QUEUE", "SPARKNET_SERVE_DTYPE",
+                "SPARKNET_SERVE_INFLIGHT")
 
 
 def _save_last_good(result: dict) -> None:

@@ -17,6 +17,44 @@ from typing import Sequence
 import numpy as np
 
 
+def preprocess_image(img: np.ndarray, image_dims: tuple[int, int], *,
+                     channel_swap: tuple[int, ...] | None = None,
+                     raw_scale: float | None = None) -> np.ndarray:
+    """(C,H,W) or (H,W,C)/(H,W) float image -> (C, *image_dims), with
+    channel permutation and raw_scale applied — the ONE preprocessing
+    implementation shared by the local :class:`Classifier` and the
+    serving plane's :class:`RemoteClassifier`, so a prediction means the
+    same thing whichever side of the wire ran it.  mean/input_scale
+    happen per-crop at net-input size (:func:`transform_crops`)."""
+    arr = np.asarray(img, np.float32)
+    if arr.ndim == 2:
+        arr = arr[None]
+    elif arr.ndim == 3 and arr.shape[0] not in (1, 3):
+        arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+    if channel_swap is not None and arr.shape[0] == len(channel_swap):
+        arr = arr[list(channel_swap)]
+    if raw_scale is not None:
+        arr = arr * raw_scale
+    h, w = image_dims
+    if arr.shape[-2:] != (h, w):
+        from .data.db import _warp
+        arr = _warp(arr, h, w)
+    return arr
+
+
+def transform_crops(crops: np.ndarray,
+                    mean: np.ndarray | float | None = None,
+                    input_scale: float | None = None) -> np.ndarray:
+    """Per-crop transform at net-input size (crop-sized / per-channel /
+    scalar mean, then input_scale) — shared local/remote, like
+    :func:`preprocess_image`."""
+    if mean is not None:
+        crops = crops - mean
+    if input_scale is not None:
+        crops = crops * input_scale
+    return crops
+
+
 def oversample(images: np.ndarray, crop: int) -> np.ndarray:
     """(N, C, H, W) -> (10N, C, crop, crop): four corners + center, and
     their mirrors (reference: caffe/python/caffe/io.py:340-384, in NCHW)."""
@@ -76,32 +114,15 @@ class Classifier:
                                         train=False).blobs)
 
     def _preprocess(self, img: np.ndarray) -> np.ndarray:
-        """(C,H,W) or (H,W,C)/(H,W) float image -> (C, image_dims), with
-        raw_scale applied; mean/input_scale happen per-crop at net-input
-        size (the Transformer is configured with the net blob shape, so a
+        """Delegates to the shared :func:`preprocess_image` (the
+        Transformer is configured with the net blob shape, so a
         pycaffe-style mean array is crop-sized)."""
-        arr = np.asarray(img, np.float32)
-        if arr.ndim == 2:
-            arr = arr[None]
-        elif arr.ndim == 3 and arr.shape[0] not in (1, 3):
-            arr = arr.transpose(2, 0, 1)  # HWC -> CHW
-        if self.channel_swap is not None and \
-                arr.shape[0] == len(self.channel_swap):
-            arr = arr[list(self.channel_swap)]
-        if self.raw_scale is not None:
-            arr = arr * self.raw_scale
-        h, w = self.image_dims
-        if arr.shape[-2:] != (h, w):
-            from .data.db import _warp
-            arr = _warp(arr, h, w)
-        return arr
+        return preprocess_image(img, self.image_dims,
+                                channel_swap=self.channel_swap,
+                                raw_scale=self.raw_scale)
 
     def _transform_crops(self, crops: np.ndarray) -> np.ndarray:
-        if self.mean is not None:
-            crops = crops - self.mean  # crop-sized / per-channel / scalar
-        if self.input_scale is not None:
-            crops = crops * self.input_scale
-        return crops
+        return transform_crops(crops, self.mean, self.input_scale)
 
     def predict(self, inputs: Sequence[np.ndarray],
                 oversample_crops: bool = True) -> np.ndarray:
@@ -175,3 +196,113 @@ class Detector(Classifier):
         out = out.reshape(out.shape[0], -1)
         return [{"window": w, "prediction": out[i]}
                 for i, w in enumerate(metas)]
+
+
+# ---------------------------------------------------------------------------
+# Remote (served) classification — the --server path of classify_cli
+# ---------------------------------------------------------------------------
+
+def http_json(url: str, payload: dict | None = None,
+              timeout: float = 30.0) -> dict:
+    """One JSON request against the serving plane (stdlib urllib — the
+    client must not need more than the server ships).  HTTP errors with
+    a JSON body surface as RuntimeError carrying the server's typed
+    ``error``/``reason`` fields."""
+    import json
+    import urllib.error
+    import urllib.request
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode())
+        except Exception:
+            body = {}
+        raise RuntimeError(
+            f"{url}: HTTP {e.code} "
+            f"({body.get('reason') or ''} {body.get('error') or e.reason})"
+        ) from None
+
+
+def remote_classify(url: str, model: str, arr: np.ndarray,
+                    tenant: str = "classify",
+                    timeout: float = 30.0) -> dict:
+    """Submit ONE (C,H,W) float32 example to a running ``tools/serve.py``
+    and return the server's JSON (probs + latency stamps)."""
+    import base64
+    arr = np.ascontiguousarray(arr, np.float32)
+    return http_json(f"{url}/v1/classify", {
+        "model": model, "tenant": tenant,
+        "shape": list(arr.shape), "dtype": "float32",
+        "data_b64": base64.b64encode(arr.tobytes()).decode(),
+        "timeout_s": timeout,
+    }, timeout=timeout + 10.0)
+
+
+class RemoteClassifier:
+    """Classifier.predict against a running inference server instead of a
+    local compile: the SAME preprocessing (:func:`preprocess_image` /
+    :func:`transform_crops` / :func:`oversample`) runs client-side, then
+    each crop is submitted as its own request — the server's dynamic
+    micro-batching coalesces the 10-crop fan-out back into one padded
+    forward.  Net geometry (crop size, channels) comes from the server's
+    ``/v1/models``, so client and server can never disagree about it."""
+
+    def __init__(self, url: str, model: str,
+                 image_dims: tuple[int, int] | None = None,
+                 mean: np.ndarray | float | None = None,
+                 input_scale: float | None = None,
+                 raw_scale: float | None = None,
+                 channel_swap=None, tenant: str = "classify",
+                 timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.model = model
+        self.tenant = tenant
+        self.timeout = timeout
+        models = http_json(f"{self.url}/v1/models",
+                           timeout=timeout).get("models", {})
+        if model not in models:
+            raise ValueError(
+                f"server {url} has no model {model!r} "
+                f"(loaded: {sorted(models)})")
+        in_shape = models[model]["in_shape"]
+        self.channels, self.crop = int(in_shape[0]), int(in_shape[-1])
+        self.image_dims = tuple(image_dims or (self.crop, self.crop))
+        self.mean = mean
+        self.input_scale = input_scale
+        self.raw_scale = raw_scale
+        self.channel_swap = tuple(channel_swap) if channel_swap else None
+
+    def predict(self, inputs: Sequence[np.ndarray],
+                oversample_crops: bool = True) -> np.ndarray:
+        """Class probabilities, (N, classes) — Classifier.predict
+        semantics over the wire; crop requests are posted concurrently so
+        the server micro-batches them."""
+        from concurrent.futures import ThreadPoolExecutor
+        batch = np.stack([
+            preprocess_image(im, self.image_dims,
+                             channel_swap=self.channel_swap,
+                             raw_scale=self.raw_scale) for im in inputs])
+        n = len(batch)
+        if oversample_crops:
+            crops = oversample(batch, self.crop)
+        else:
+            y = (batch.shape[2] - self.crop) // 2
+            x = (batch.shape[3] - self.crop) // 2
+            crops = batch[:, :, y:y + self.crop, x:x + self.crop]
+        crops = transform_crops(crops, self.mean, self.input_scale)
+        with ThreadPoolExecutor(max_workers=min(32, len(crops))) as ex:
+            rows = list(ex.map(
+                lambda c: remote_classify(self.url, self.model, c,
+                                          tenant=self.tenant,
+                                          timeout=self.timeout)["probs"],
+                crops))
+        out = np.asarray(rows, np.float32)
+        if oversample_crops:
+            out = out.reshape(10, n, -1).mean(axis=0)
+        return out

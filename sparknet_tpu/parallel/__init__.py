@@ -13,4 +13,13 @@ from .resilience import (
     RestartPolicy,
 )
 from .fleet import FleetScheduler, GangAllocator, JobSpec
+from .serving import (
+    EngineDead,
+    InferenceEngine,
+    ModelHouse,
+    Overloaded,
+    ServeConfig,
+    ServingError,
+    UnknownModel,
+)
 from . import health

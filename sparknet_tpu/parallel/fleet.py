@@ -907,9 +907,17 @@ def format_status(status: Mapping[str, Any]) -> str:
         for rank, b in sorted(j["heartbeats"].items()):
             hb = (f"r{rank} {b['phase']}@{b['round']} "
                   f"({b['age_s']:.1f}s ago)")
-            stall = (b.get("extras") or {}).get("stall_s")
+            extras = b.get("extras") or {}
+            stall = extras.get("stall_s")
             if stall:
                 hb += f" stall {sum(stall.values()):.2f}s"
+            if extras.get("serving"):
+                # a serving job's beat: fold queue/latency telemetry the
+                # way training jobs fold stall_s
+                hb += (f" q{extras.get('queue_depth', 0)}"
+                       f"+{extras.get('in_flight', 0)} "
+                       f"p50 {extras.get('p50_ms', 0):.0f}ms "
+                       f"p99 {extras.get('p99_ms', 0):.0f}ms")
             break   # first rank is enough for the one-liner
         lines.append(
             f"{j['job']:<16} {j['tenant']:<8} {j['state']:<11} "

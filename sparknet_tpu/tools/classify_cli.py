@@ -27,10 +27,19 @@ def main(argv=None) -> int:
     parser.add_argument("input_file",
                         help="Input image, directory, or npy.")
     parser.add_argument("output_file", help="Output npy filename.")
-    parser.add_argument("--model_def", required=True,
-                        help="Model definition file.")
+    parser.add_argument("--model_def", default=None,
+                        help="Model definition file (required unless "
+                             "--server).")
     parser.add_argument("--pretrained_model", default=None,
                         help="Trained model weights file.")
+    parser.add_argument("--server", default=None, metavar="URL",
+                        help="Submit to a running tools/serve.py instead "
+                             "of compiling locally (e.g. "
+                             "http://127.0.0.1:8100); preprocessing is "
+                             "the same shared pipeline either way.")
+    parser.add_argument("--model", default=None,
+                        help="Served model name for --server (e.g. "
+                             "lenet, caffenet).")
     parser.add_argument("--gpu", action="store_true",
                         help="Accepted for compatibility; device "
                              "placement belongs to JAX.")
@@ -52,7 +61,7 @@ def main(argv=None) -> int:
 
     import numpy as np
 
-    from ..classify import Classifier
+    from ..classify import Classifier, RemoteClassifier
     from ..pycaffe_io import load_image
 
     image_dims = [int(s) for s in args.images_dim.split(",")]
@@ -63,10 +72,20 @@ def main(argv=None) -> int:
     channel_swap = ([int(s) for s in args.channel_swap.split(",")]
                     if args.channel_swap else None)
 
-    classifier = Classifier(
-        args.model_def, args.pretrained_model, image_dims=image_dims,
-        mean=mean, input_scale=args.input_scale, raw_scale=args.raw_scale,
-        channel_swap=channel_swap)
+    if args.server:
+        if not args.model:
+            parser.error("--server requires --model (the served name)")
+        classifier = RemoteClassifier(
+            args.server, args.model, image_dims=image_dims,
+            mean=mean, input_scale=args.input_scale,
+            raw_scale=args.raw_scale, channel_swap=channel_swap)
+    else:
+        if not args.model_def:
+            parser.error("--model_def is required (or use --server)")
+        classifier = Classifier(
+            args.model_def, args.pretrained_model, image_dims=image_dims,
+            mean=mean, input_scale=args.input_scale,
+            raw_scale=args.raw_scale, channel_swap=channel_swap)
 
     t = time.time()
     if args.input_file.endswith("npy"):

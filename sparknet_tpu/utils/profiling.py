@@ -122,20 +122,26 @@ def peak_flops(device_kind: str) -> float | None:
     return _PEAK_FLOPS_BF16.get(device_kind)
 
 
-def eval_cost_flops(solver, batch) -> float | None:
-    """Model FLOPs of one compiled test-net forward (the eval-pass MFU
-    numerator), via XLA cost analysis like :func:`step_cost_flops`."""
+def fwd_cost_flops(jitted_fwd, *args) -> float | None:
+    """Model FLOPs of any jitted forward via XLA cost analysis
+    (best-effort, like :func:`step_cost_flops`) — shared by the eval-MFU
+    numerator and the serving plane's per-model FLOPs estimate."""
     import sys
     try:
-        lowered = solver._test_fwd.lower(solver.params, batch, None)
+        lowered = jitted_fwd.lower(*args)
         cost = lowered.compile().cost_analysis()
         if cost:
             cost = cost[0] if isinstance(cost, (list, tuple)) else cost
             return float(cost.get("flops", 0.0)) or None
     except Exception as e:
-        print(f"[profiling] eval cost_analysis unavailable: {e}",
-              file=sys.stderr)
+        print(f"[profiling] cost_analysis unavailable: {e}", file=sys.stderr)
     return None
+
+
+def eval_cost_flops(solver, batch) -> float | None:
+    """Model FLOPs of one compiled test-net forward (the eval-pass MFU
+    numerator), via XLA cost analysis like :func:`step_cost_flops`."""
+    return fwd_cost_flops(solver._test_fwd, solver.params, batch, None)
 
 
 def scanned_eval_block(solver, iters: int):

@@ -1,0 +1,650 @@
+"""Serving plane tests: dynamic micro-batching, admission control,
+hot-load/evict, liveness — the batching-core coverage ISSUE 7 demands.
+
+The contracts under test:
+- pad-and-mask: a request batched with strangers returns bit-identical
+  logits to a solo run padded to the same compiled shape;
+- dispatch ordering: a full largest-shape batch goes immediately, a
+  partial batch waits exactly until the coalesce deadline;
+- overload: typed, bounded rejections (queue bound + tenant QPS), never
+  unbounded latency;
+- hot-load eviction under the HBM budget (LRU, never the newest);
+- a dead engine is a typed EngineDead on every waiter and later submit
+  — never a hang (the DecodePool contract, mirrored).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.parallel.serving import (
+    EngineDead,
+    InferenceEngine,
+    ModelHouse,
+    Overloaded,
+    ServeConfig,
+    ServingError,
+    UnknownModel,
+    deploy_from,
+    run_closed_loop,
+    solo_references,
+    zoo_models,
+)
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# Shared rig: one compiled lenet house per module (warm-up is the slow part)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lenet_house():
+    cfg = ServeConfig(batch_shapes=(1, 4, 8), max_delay_ms=30.0,
+                      max_queue=64, dtype="f32", beat_every_s=0.05)
+    house = ModelHouse(cfg)
+    house.load("lenet")
+    return house
+
+
+def engine_for(house, **overrides) -> InferenceEngine:
+    return InferenceEngine(house,
+                           dataclasses.replace(house.cfg, **overrides))
+
+
+def lenet_inputs(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=(1, 28, 28)).astype(np.float32)
+            for _ in range(n)]
+
+
+class _StubModel:
+    """House-injectable model with scriptable behavior — the serving
+    analog of the fault-injection stand-ins the data plane tests use."""
+
+    def __init__(self, fn=None, in_shape=(2,), classes=3,
+                 shapes=(1, 2, 4), param_bytes=128):
+        self.name = "stub"
+        self.in_shape = tuple(in_shape)
+        self.classes = classes
+        self.batch_shapes = tuple(shapes)
+        self.param_bytes = param_bytes
+        self.last_used = 0.0
+        self.weights = None
+        self.fn = fn
+
+    def pad_shape(self, n: int) -> int:
+        for s in self.batch_shapes:
+            if s >= n:
+                return s
+        return self.batch_shapes[-1]
+
+    def infer_async(self, batch):
+        if self.fn is not None:
+            return self.fn(batch)
+        # row i depends only on input row i (per-example net analog)
+        return np.tile(batch.sum(axis=1, keepdims=True),
+                       (1, self.classes)).astype(np.float32)
+
+    def info(self):
+        return {"name": self.name, "stub": True}
+
+
+def stub_house(stub: _StubModel, **cfg_over) -> ModelHouse:
+    cfg_over.setdefault("batch_shapes", stub.batch_shapes)
+    cfg_over.setdefault("dtype", "f32")
+    house = ModelHouse(ServeConfig(**cfg_over))
+    house._models["stub"] = stub
+    return house
+
+
+# ---------------------------------------------------------------------------
+# Deploy transform + zoo
+# ---------------------------------------------------------------------------
+
+def test_deploy_from_lenet_strips_train_plumbing():
+    from sparknet_tpu.models import lenet
+    deploy, in_shape = deploy_from(lenet(32, 100), max_batch=8)
+    types = [lp.type for lp in deploy.layer]
+    assert "JavaData" not in types and "Accuracy" not in types
+    assert not any(t.endswith("Loss") for t in types)
+    assert types[-1] == "Softmax" and deploy.layer[-1].top == ["prob"]
+    # the softmax head sits on the loss layer's logits bottom
+    assert deploy.layer[-1].bottom == ["ip2"]
+    assert deploy.input == ["data"]
+    assert list(deploy.input_shape[0].dim) == [8, 1, 28, 28]
+    assert in_shape == (1, 28, 28)
+
+
+def test_deploy_from_builds_runnable_net_with_matching_param_names():
+    import jax
+
+    from sparknet_tpu.graph.net import Net
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.proto import NetState, Phase
+    deploy, _ = deploy_from(lenet(4, 4), max_batch=4)
+    net = Net(deploy, NetState(Phase.TEST))
+    params = net.init(jax.random.PRNGKey(0))
+    # same layer names as the train net: trained weights load by name
+    train_net = Net(lenet(4, 4), NetState(Phase.TRAIN))
+    train_params = train_net.init(jax.random.PRNGKey(0))
+    assert set(params) == set(train_params)
+    out = net.apply(params, {"data": np.zeros((4, 1, 28, 28), np.float32)},
+                    train=False).blobs
+    probs = np.asarray(out["prob"])
+    assert probs.shape == (4, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_deploy_from_googlenet_uses_main_head():
+    from sparknet_tpu.models import googlenet
+    deploy, in_shape = deploy_from(googlenet(1, 1, crop=224), max_batch=4)
+    assert in_shape == (3, 224, 224)
+    # the TEST-phase head is loss3's classifier; aux heads are TRAIN-only
+    assert deploy.layer[-1].type == "Softmax"
+    assert "loss3" in deploy.layer[-1].bottom[0]
+
+
+def test_zoo_registry_names():
+    zoo = zoo_models()
+    for name in ("lenet", "caffenet", "googlenet", "vgg16",
+                 "cifar10_quick"):
+        assert name in zoo
+
+
+# ---------------------------------------------------------------------------
+# Pad-and-mask bit-identity (acceptance claim (c))
+# ---------------------------------------------------------------------------
+
+def test_batched_with_strangers_bit_identical_to_solo(lenet_house):
+    """6 concurrent requests coalesce into one padded batch; every row
+    must equal the solo run of that input padded to the same shape."""
+    xs = lenet_inputs(6)
+    lm = lenet_house.get("lenet")
+    refs = solo_references(lm, xs)
+    with engine_for(lenet_house, max_delay_ms=60.0) as eng:
+        futs = [eng.submit("lenet", x) for x in xs]
+        res = [f.result(20.0) for f in futs]
+    # they actually rode together (coalescing happened, pad rows exist)
+    assert {r.padded_to for r in res} == {8}
+    assert all(r.batch_n == 6 for r in res)
+    for i, r in enumerate(res):
+        assert np.array_equal(r.probs, refs[8][i]), f"row {i} differs"
+
+
+def test_solo_request_through_engine_matches_reference(lenet_house):
+    xs = lenet_inputs(3, seed=7)
+    lm = lenet_house.get("lenet")
+    refs = solo_references(lm, xs)
+    with engine_for(lenet_house, max_delay_ms=0.0) as eng:
+        for i, x in enumerate(xs):
+            r = eng.classify("lenet", x)
+            assert np.array_equal(r.probs, refs[r.padded_to][i])
+
+
+# ---------------------------------------------------------------------------
+# Dispatch ordering: full batch beats deadline; deadline pads the tail
+# ---------------------------------------------------------------------------
+
+def test_full_batch_dispatches_before_deadline(lenet_house):
+    """With a deliberately huge deadline, a largest-shape batch must
+    dispatch immediately — the deadline only governs PARTIAL batches."""
+    xs = lenet_inputs(8)
+    with engine_for(lenet_house, max_delay_ms=5000.0) as eng:
+        t0 = time.monotonic()
+        futs = [eng.submit("lenet", x) for x in xs]
+        res = [f.result(20.0) for f in futs]
+        elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, f"full batch waited on the deadline ({elapsed}s)"
+    assert all(r.padded_to == 8 and r.batch_n == 8 for r in res)
+
+
+def test_partial_batch_waits_for_deadline_then_pads(lenet_house):
+    delay_ms = 250.0
+    with engine_for(lenet_house, max_delay_ms=delay_ms) as eng:
+        t0 = time.monotonic()
+        fut = eng.submit("lenet", lenet_inputs(1)[0])
+        res = fut.result(20.0)
+        elapsed = time.monotonic() - t0
+    # a lone request rides the smallest compiled shape, after the delay
+    assert res.batch_n == 1 and res.padded_to == 1
+    assert elapsed >= 0.8 * delay_ms / 1000.0, \
+        f"partial batch dispatched at {elapsed * 1e3:.0f} ms, " \
+        f"before the {delay_ms} ms deadline"
+
+
+def test_two_requests_pad_to_middle_shape(lenet_house):
+    xs = lenet_inputs(2)
+    with engine_for(lenet_house, max_delay_ms=120.0) as eng:
+        futs = [eng.submit("lenet", x) for x in xs]
+        res = [f.result(20.0) for f in futs]
+    assert all(r.batch_n == 2 and r.padded_to == 4 for r in res)
+
+
+def test_latency_stamps_ride_every_result(lenet_house):
+    with engine_for(lenet_house, max_delay_ms=50.0) as eng:
+        r = eng.classify("lenet", lenet_inputs(1)[0])
+    assert r.total_ms >= r.infer_ms >= 0
+    assert r.queue_ms >= 0
+    assert r.total_ms == pytest.approx(r.queue_ms + r.infer_ms, abs=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Admission control: typed and bounded
+# ---------------------------------------------------------------------------
+
+def test_queue_bound_rejects_typed_and_recovers():
+    """A slow model backs the queue up; submits past the bound raise
+    Overloaded(queue_full); every ACCEPTED request still completes."""
+    stub = _StubModel(fn=lambda b: (time.sleep(0.05),
+                                    np.ones((b.shape[0], 3), np.float32)
+                                    )[1],
+                      shapes=(1,))
+    house = stub_house(stub, max_delay_ms=0.0, max_queue=4)
+    accepted, rejected = [], 0
+    with InferenceEngine(house, house.cfg) as eng:
+        for _ in range(25):
+            try:
+                accepted.append(eng.submit("stub",
+                                           np.ones(2, np.float32)))
+            except Overloaded as e:
+                assert e.reason == "queue_full"
+                rejected += 1
+        assert rejected > 0, "queue bound never engaged"
+        # outstanding work never exceeded the bound
+        assert len(accepted) <= 4 + 25 - rejected
+        for f in accepted:
+            f.result(20.0)                       # all accepted complete
+        assert eng.rejected["queue_full"] == rejected
+
+
+def test_tenant_qps_cap_rejects_only_that_tenant(lenet_house):
+    with engine_for(lenet_house, max_delay_ms=0.0,
+                    tenant_qps={"acme": 2.0}) as eng:
+        x = lenet_inputs(1)[0]
+        ok, capped = 0, 0
+        for _ in range(10):
+            try:
+                eng.submit("lenet", x, tenant="acme")
+                ok += 1
+            except Overloaded as e:
+                assert e.reason == "tenant_rate"
+                capped += 1
+        assert ok >= 1 and capped >= 6  # burst of 2, then the cap bites
+        # an uncapped tenant sails through the same instant
+        for _ in range(5):
+            eng.submit("lenet", x, tenant="other")
+        assert eng.rejected["tenant_rate"] == capped
+
+
+def test_wrong_input_shape_is_typed(lenet_house):
+    with engine_for(lenet_house) as eng:
+        with pytest.raises(ServingError, match="expects input"):
+            eng.submit("lenet", np.zeros((3, 10, 10), np.float32))
+
+
+def test_unloaded_model_is_typed_not_compiled(lenet_house):
+    with engine_for(lenet_house) as eng:
+        with pytest.raises(UnknownModel, match="not loaded"):
+            eng.submit("vgg16", np.zeros((3, 224, 224), np.float32))
+    assert "vgg16" not in lenet_house.loaded()  # no implicit hot-load
+
+
+# ---------------------------------------------------------------------------
+# Hot-load / evict under the HBM budget
+# ---------------------------------------------------------------------------
+
+def test_hot_load_eviction_under_hbm_budget():
+    cfg = ServeConfig(batch_shapes=(1, 2), max_delay_ms=1.0, dtype="f32")
+    probe = ModelHouse(dataclasses.replace(cfg, hbm_budget_mb=1024.0))
+    lenet_bytes = probe.load("lenet").param_bytes
+    # budget fits lenet alone but not lenet + cifar10_quick
+    budget_mb = lenet_bytes * 1.2 / 2**20
+    house = ModelHouse(dataclasses.replace(cfg, hbm_budget_mb=budget_mb))
+    house.load("lenet")
+    assert set(house.loaded()) == {"lenet"}
+    house.load("cifar10_quick")
+    assert set(house.loaded()) == {"cifar10_quick"}, \
+        "LRU model must be evicted when the budget trips"
+    assert house.evictions == 1
+    # the evicted model is gone for submit (typed), reloadable on demand
+    with InferenceEngine(house, house.cfg) as eng:
+        with pytest.raises(UnknownModel):
+            eng.submit("lenet", np.zeros((1, 28, 28), np.float32))
+    house.load("lenet")   # hot reload evicts the now-LRU cifar
+    assert set(house.loaded()) == {"lenet"}
+
+
+def test_explicit_evict_and_reload(lenet_house):
+    cfg = ServeConfig(batch_shapes=(1, 2), max_delay_ms=1.0, dtype="f32")
+    house = ModelHouse(cfg)
+    house.load("cifar10_quick")
+    assert house.evict("cifar10_quick") is True
+    assert house.evict("cifar10_quick") is False
+    assert house.loaded() == {}
+
+
+def test_oversize_model_admitted_alone_with_note(capsys):
+    stub = _StubModel(param_bytes=10 * 2**20)
+    house = stub_house(stub, hbm_budget_mb=1.0)
+    house._evict_over_budget(keep="stub")
+    assert "exceeds" in capsys.readouterr().err
+    assert set(house._models) == {"stub"}
+
+
+# ---------------------------------------------------------------------------
+# Dead engine: typed errors, never a hang (the DecodePool contract)
+# ---------------------------------------------------------------------------
+
+def test_dispatcher_death_fails_pending_typed_never_hangs():
+    """A BaseException out of the hot path kills the engine; the pending
+    waiter gets EngineDead within the poll bound, not a hang."""
+    boom = KeyboardInterrupt("injected dispatcher death")
+
+    def die(batch):
+        raise boom
+
+    stub = _StubModel(fn=die, shapes=(1,))
+    house = stub_house(stub, max_delay_ms=0.0)
+    eng = InferenceEngine(house, house.cfg)
+    fut = eng.submit("stub", np.ones(2, np.float32))
+    t0 = time.monotonic()
+    with pytest.raises(EngineDead, match="dispatcher died"):
+        fut.result(10.0)
+    assert time.monotonic() - t0 < 5.0, "dead engine must not hang waiters"
+    assert not eng.alive
+    with pytest.raises(EngineDead):
+        eng.submit("stub", np.ones(2, np.float32))
+    eng.stop()   # idempotent on a dead engine
+
+
+def test_model_failure_fails_batch_but_engine_survives():
+    calls = []
+
+    def flaky(batch):
+        calls.append(batch.shape[0])
+        if len(calls) == 1:
+            raise RuntimeError("transient model failure")
+        return np.ones((batch.shape[0], 3), np.float32)
+
+    stub = _StubModel(fn=flaky, shapes=(1,))
+    house = stub_house(stub, max_delay_ms=0.0)
+    with InferenceEngine(house, house.cfg) as eng:
+        with pytest.raises(ServingError, match="transient model failure"):
+            eng.classify("stub", np.ones(2, np.float32))
+        assert eng.alive, "a per-batch failure must not kill the engine"
+        r = eng.classify("stub", np.ones(2, np.float32))
+        assert r.probs.shape == (3,)
+        assert eng.failed == 1 and eng.completed == 1
+
+
+def test_stop_fails_queued_requests_typed():
+    stub = _StubModel(fn=lambda b: (time.sleep(0.2),
+                                    np.ones((b.shape[0], 3), np.float32)
+                                    )[1],
+                      shapes=(1,))
+    house = stub_house(stub, max_delay_ms=0.0, max_queue=16)
+    eng = InferenceEngine(house, house.cfg)
+    futs = [eng.submit("stub", np.ones(2, np.float32)) for _ in range(6)]
+    eng.stop()
+    outcomes = []
+    for f in futs:
+        try:
+            f.result(10.0)
+            outcomes.append("ok")
+        except EngineDead:
+            outcomes.append("dead")
+    # in-flight work may drain; everything still queued dies typed
+    assert "dead" in outcomes
+    assert set(outcomes) <= {"ok", "dead"}
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: occupancy, stats, beacons
+# ---------------------------------------------------------------------------
+
+def test_stats_and_occupancy_histogram(lenet_house):
+    xs = lenet_inputs(6)
+    with engine_for(lenet_house, max_delay_ms=60.0) as eng:
+        futs = [eng.submit("lenet", x) for x in xs]
+        for f in futs:
+            f.result(20.0)
+        st = eng.stats()
+    assert st["completed"] == 6
+    assert st["occupancy"] == {"8": {6: 1}}
+    assert st["p99_ms"] >= st["p50_ms"] >= 0
+    assert st["models"]["lenet"]["in_shape"] == [1, 28, 28]
+    assert st["queue_depth"] == 0 and st["in_flight"] == 0
+
+
+def test_engine_publishes_health_beacons(lenet_house, tmp_path,
+                                         monkeypatch):
+    from sparknet_tpu.parallel import health
+    monkeypatch.setenv("SPARKNET_HEARTBEAT_DIR", str(tmp_path))
+    monkeypatch.setenv("SPARKNET_PROC_ID", "0")
+    eng = engine_for(lenet_house, max_delay_ms=0.0)
+    try:
+        eng.classify("lenet", lenet_inputs(1)[0])
+        deadline = time.monotonic() + 5.0
+        beat = None
+        while time.monotonic() < deadline:
+            beat = health.read_beat(str(tmp_path), 0)
+            if beat is not None and beat.extras:
+                break
+            time.sleep(0.02)
+        assert beat is not None and beat.phase == "serving"
+        assert beat.extras["serving"] is True
+        assert beat.extras["models"] == ["lenet"]
+        for key in ("queue_depth", "in_flight_batches", "p50_ms",
+                    "p99_ms", "completed", "rejected"):
+            assert key in beat.extras
+    finally:
+        eng.stop()
+    final = health.read_beat(str(tmp_path), 0)
+    assert final is not None and final.phase == "final"
+
+
+def test_fleet_status_folds_serving_beat():
+    from sparknet_tpu.parallel.fleet import format_status
+    status = {
+        "devices": {"total": 8, "free": 7},
+        "tenants": {"svc": {"used": 1, "quota": 2}},
+        "jobs": [{
+            "job": "serve-a", "tenant": "svc", "state": "RUNNING",
+            "priority": 0, "eff_priority": 0.0, "world": 1,
+            "slots": [0], "episodes": 1, "attempts": 0, "preempts": 0,
+            "round": 42, "rounds_target": 0,
+            "heartbeats": {0: {"round": 42, "phase": "serving",
+                               "age_s": 0.5,
+                               "extras": {"serving": True,
+                                          "queue_depth": 3,
+                                          "in_flight": 8,
+                                          "p50_ms": 6.0,
+                                          "p99_ms": 21.0}}},
+        }],
+    }
+    table = format_status(status)
+    assert "serving@42" in table
+    assert "q3+8" in table and "p99 21ms" in table
+
+
+# ---------------------------------------------------------------------------
+# Closed-loop harness
+# ---------------------------------------------------------------------------
+
+def test_closed_loop_exact_and_live(lenet_house):
+    xs = lenet_inputs(8)
+    lm = lenet_house.get("lenet")
+    refs = solo_references(lm, xs)
+    with engine_for(lenet_house, max_delay_ms=3.0) as eng:
+        rep = run_closed_loop(eng, "lenet", xs, clients=4, window=4,
+                              duration_s=0.5, refs=refs)
+    assert rep["completed"] > 0 and rep["errors"] == 0
+    assert rep["exact_mismatches"] == 0
+    assert rep["p99_ms"] >= rep["p50_ms"] > 0
+    assert rep["achieved_qps"] > 0
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="batch_shapes"):
+        ServeConfig(batch_shapes=(0, 4))
+    with pytest.raises(ValueError, match="max_delay_ms"):
+        ServeConfig(max_delay_ms=-1.0)
+    with pytest.raises(ValueError, match="max_queue"):
+        ServeConfig(max_queue=0)
+    with pytest.raises(ValueError, match="dtype"):
+        ServeConfig(dtype="f16")
+    with pytest.raises(ValueError, match="qps cap"):
+        ServeConfig(tenant_qps={"a": 0.0})
+    with pytest.raises(ValueError, match="inflight"):
+        ServeConfig(inflight_batches=0)
+    assert ServeConfig(batch_shapes=(8, 1, 4)).batch_shapes == (1, 4, 8)
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("SPARKNET_SERVE_SHAPES", "16,2")
+    monkeypatch.setenv("SPARKNET_SERVE_MAX_DELAY_MS", "7.5")
+    monkeypatch.setenv("SPARKNET_SERVE_QUEUE", "33")
+    monkeypatch.setenv("SPARKNET_SERVE_DTYPE", "f32")
+    cfg = ServeConfig()
+    assert cfg.batch_shapes == (2, 16)
+    assert cfg.max_delay_ms == 7.5
+    assert cfg.max_queue == 33
+    assert cfg.dtype == "f32"
+    monkeypatch.setenv("SPARKNET_SERVE_SHAPES", "nope")
+    with pytest.raises(ValueError, match="SPARKNET_SERVE_SHAPES"):
+        ServeConfig()
+
+
+# ---------------------------------------------------------------------------
+# Shared preprocessing (classify.py dedup)
+# ---------------------------------------------------------------------------
+
+def test_shared_preprocess_helper_matches_local_semantics():
+    from sparknet_tpu.classify import preprocess_image, transform_crops
+    img_hwc = np.arange(2 * 4 * 4, dtype=np.float32).reshape(4, 4, 2)
+    out = preprocess_image(img_hwc, (4, 4))
+    assert out.shape == (2, 4, 4)          # HWC -> CHW
+    np.testing.assert_array_equal(out[0], img_hwc[:, :, 0])
+    swapped = preprocess_image(np.ones((3, 4, 4), np.float32) *
+                               np.arange(3, dtype=np.float32)[:, None,
+                                                              None],
+                               (4, 4), channel_swap=(2, 1, 0),
+                               raw_scale=2.0)
+    assert swapped[0, 0, 0] == 4.0 and swapped[2, 0, 0] == 0.0
+    crops = np.ones((2, 1, 2, 2), np.float32)
+    out = transform_crops(crops, mean=0.5, input_scale=10.0)
+    np.testing.assert_array_equal(out, np.full_like(crops, 5.0))
+
+
+def test_classifier_preprocess_delegates_to_shared(tmp_path):
+    """Classifier._preprocess and the module-level helper are the same
+    code path — the server/client dedup the satellite asks for."""
+    from sparknet_tpu.classify import Classifier, preprocess_image
+    proto = tmp_path / "deploy.prototxt"
+    proto.write_text("""
+name: "tiny"
+input: "data"
+input_shape { dim: 1 dim: 1 dim: 8 dim: 8 }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+""")
+    c = Classifier(str(proto), image_dims=(8, 8), raw_scale=3.0)
+    img = np.random.default_rng(0).normal(size=(8, 8)).astype(np.float32)
+    np.testing.assert_array_equal(
+        c._preprocess(img),
+        preprocess_image(img, (8, 8), raw_scale=3.0))
+
+
+# ---------------------------------------------------------------------------
+# HTTP server e2e (subprocess; the in-tree smoke of tools/serve.py)
+# ---------------------------------------------------------------------------
+
+def test_serve_http_end_to_end(tmp_path):
+    import signal
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SPARKNET_HEARTBEAT_DIR=str(tmp_path),
+               SPARKNET_PROC_ID="0")
+    env.pop("XLA_FLAGS", None)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(root, "tools", "serve.py"),
+         "--models", "lenet", "--port", "0", "--dtype", "f32",
+         "--shapes", "1,4", "--max-delay-ms", "3",
+         "--quota", "capped=1"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True, cwd=root)
+    try:
+        ready = proc.stdout.readline().strip()
+        assert ready.startswith("serving on http://"), ready
+        url = ready.split()[2]
+        from sparknet_tpu.classify import (
+            RemoteClassifier, http_json, remote_classify,
+        )
+        x = np.random.default_rng(0).normal(size=(1, 28, 28)
+                                            ).astype(np.float32)
+        d = remote_classify(url, "lenet", x)
+        assert len(d["probs"]) == 10 and d["padded_to"] in (1, 4)
+        assert d["total_ms"] >= d["infer_ms"] >= 0
+        # wire result == local engine math: probs sum to 1
+        assert abs(sum(d["probs"]) - 1.0) < 1e-4
+        # typed admission over the wire: tenant cap -> HTTP 429
+        saw_429 = False
+        for _ in range(5):
+            try:
+                remote_classify(url, "lenet", x, tenant="capped")
+            except RuntimeError as e:
+                assert "429" in str(e)
+                saw_429 = True
+        assert saw_429
+        # unknown model -> 404 with the typed reason
+        with pytest.raises(RuntimeError, match="404"):
+            remote_classify(url, "nope", x)
+        # healthz + hot-load/evict round trip
+        hz = http_json(f"{url}/healthz")
+        assert hz["alive"] and hz["completed"] >= 1
+        assert http_json(f"{url}/v1/models/load",
+                         {"name": "cifar10_quick"})["loaded"]["name"] \
+            == "cifar10_quick"
+        assert http_json(f"{url}/v1/models/evict",
+                         {"name": "cifar10_quick"})["evicted"] is True
+        # RemoteClassifier: shared preprocessing + server-side coalesce
+        rc = RemoteClassifier(url, "lenet")
+        assert (rc.channels, rc.crop) == (1, 28)
+        probs = rc.predict([np.random.default_rng(1).normal(
+            size=(32, 32)).astype(np.float32)])
+        assert probs.shape == (1, 10)
+        assert abs(float(probs.sum()) - 1.0) < 1e-4
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+
+
+def test_serveload_smoke_gate():
+    """The CI servesmoke (run_tier1.sh --servesmoke) must pass: exact
+    results, bounded p99 under overload, typed rejections."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "serveload.py"),
+         "--smoke"],
+        capture_output=True, timeout=240, cwd=root, env=env)
+    assert proc.returncode == 0, proc.stderr.decode()[-1500:]
+    import json
+    rep = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    v = rep["verdicts"]
+    assert v["bit_identical"] is True
+    assert v["overload_p99_bounded"] is True
+    assert v["overload_rejected"] > 0

@@ -68,6 +68,19 @@ maybe_fleetsoak() {
   fi
 }
 
+# ~2-second serving smoke (tools/serveload.py --smoke) — opt-in via
+# SPARKNET_SERVESMOKE=1.  In-process engine + closed-loop clients;
+# fails the gate unless results are bit-identical to solo references,
+# p99 under 2x overload stays inside the admission bound, and the
+# overload produces typed rejections (admission control engaged).
+maybe_servesmoke() {
+  if [ "${SPARKNET_SERVESMOKE:-}" = "1" ]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python tools/serveload.py --smoke --out /tmp/_servesmoke.json \
+      > /dev/null
+  fi
+}
+
 # ~10-second sync-vs-async outer-loop parity smoke (tools/roundbench.py)
 # — opt-in via SPARKNET_ROUNDBENCH=1.  Fails the gate unless the
 # pipelined loop (harvest_lag + AsyncCheckpointWriter) reproduces the
@@ -87,10 +100,11 @@ case "${1:-}" in
   --fleetsoak) SPARKNET_FLEETSOAK=1 maybe_fleetsoak ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
+  --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
   --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
-             && maybe_feedbench && maybe_roundbench ;;
+             && maybe_feedbench && maybe_servesmoke && maybe_roundbench ;;
   "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
-             && maybe_roundbench ;;
-  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--all]" >&2
+             && maybe_servesmoke && maybe_roundbench ;;
+  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--all]" >&2
      exit 2 ;;
 esac

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Long-lived inference server over the serving plane.
+
+A thin stdlib-HTTP shell around ``sparknet_tpu.parallel.serving``: the
+engine owns dynamic micro-batching, admission control, hot-load/evict,
+and health beacons; this process owns the sockets and the JSON wire
+format.  Models load (and warm-up compile every serving batch shape)
+BEFORE the socket opens — the request path never compiles.
+
+Endpoints:
+  POST /v1/classify      {"model": m, "tenant": t, "shape": [C,H,W],
+                          "dtype": "float32"|"uint8",
+                          "data_b64": <raw little-endian bytes>}
+                         (or "data": nested lists) ->
+                         {"probs": [...], "top": k, "queue_ms": ...,
+                          "infer_ms": ..., "total_ms": ...,
+                          "batch_n": n, "padded_to": s}
+                         429 on admission rejection (typed reason),
+                         404 unknown model, 503 engine dead.
+  GET  /healthz          engine liveness + stats (503 when dead).
+  GET  /v1/models        loaded models with shapes/classes/bytes.
+  POST /v1/models/load   {"name": m, "weights": path?} — hot-load.
+  POST /v1/models/evict  {"name": m}.
+
+Usage:
+  python tools/serve.py --models lenet,cifar10_quick --port 8100 \
+      --shapes 1,4,16,64 --max-delay-ms 5 --queue-depth 256 \
+      --quota acme=200 --hbm-budget-mb 2048 --dtype bf16
+
+With SPARKNET_HEARTBEAT_DIR set (e.g. by the fleet launcher), the
+engine publishes serving beacons (queue depth, in-flight, p50/p99) that
+``tools/fleet.py status`` folds into the fleet table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def decode_array(payload: dict) -> np.ndarray:
+    """The wire formats the server accepts: raw-bytes b64 (fast path,
+    what RemoteClassifier sends) or nested lists (curl-friendly)."""
+    if "data_b64" in payload:
+        dtype = np.dtype(payload.get("dtype", "float32"))
+        arr = np.frombuffer(
+            base64.b64decode(payload["data_b64"]), dtype=dtype)
+        shape = payload.get("shape")
+        if shape:
+            arr = arr.reshape([int(d) for d in shape])
+        return arr.astype(np.float32)
+    if "data" in payload:
+        return np.asarray(payload["data"], np.float32)
+    raise ValueError("payload needs data_b64 (+shape/dtype) or data")
+
+
+def make_handler(engine, house):
+    from sparknet_tpu.parallel.serving import (
+        EngineDead, Overloaded, ServingError, UnknownModel,
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        # quiet access log: the load generator would drown stderr
+        def log_message(self, fmt, *args):  # noqa: N802
+            pass
+
+        def _send(self, code: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> dict:
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            if not n:
+                return {}
+            return json.loads(self.rfile.read(n).decode())
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                st = engine.stats()
+                self._send(200 if st["alive"] else 503, st)
+            elif self.path == "/v1/models":
+                self._send(200, {"models": house.loaded()})
+            else:
+                self._send(404, {"error": f"no route {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802
+            try:
+                payload = self._read_json()
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": f"bad JSON: {e}"})
+            try:
+                if self.path == "/v1/classify":
+                    res = engine.classify(
+                        payload.get("model", ""), decode_array(payload),
+                        tenant=str(payload.get("tenant", "anon")),
+                        timeout=float(payload.get("timeout_s", 30.0)))
+                    return self._send(200, {
+                        "model": res.model, "request_id": res.request_id,
+                        "probs": [float(p) for p in res.probs],
+                        "top": res.top, "queue_ms": res.queue_ms,
+                        "infer_ms": res.infer_ms, "total_ms": res.total_ms,
+                        "batch_n": res.batch_n, "padded_to": res.padded_to})
+                if self.path == "/v1/models/load":
+                    lm = house.load(payload["name"],
+                                    weights=payload.get("weights"))
+                    return self._send(200, {"loaded": lm.info()})
+                if self.path == "/v1/models/evict":
+                    gone = house.evict(payload["name"])
+                    return self._send(200 if gone else 404,
+                                      {"evicted": bool(gone),
+                                       "name": payload["name"]})
+                return self._send(404, {"error": f"no route {self.path!r}"})
+            except Overloaded as e:
+                self._send(429, {"error": str(e), "reason": e.reason})
+            except UnknownModel as e:
+                self._send(404, {"error": str(e), "reason": "unknown_model"})
+            except EngineDead as e:
+                self._send(503, {"error": str(e), "reason": "engine_dead"})
+            except (ServingError, TimeoutError, KeyError, ValueError) as e:
+                self._send(400, {"error": str(e)})
+
+    return Handler
+
+
+def parse_models(specs) -> list[tuple[str, str | None]]:
+    """``lenet,caffenet=weights.caffemodel`` -> [(name, weights|None)]."""
+    out = []
+    for chunk in specs or ():
+        for item in chunk.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            name, _, weights = item.partition("=")
+            out.append((name, weights or None))
+    return out
+
+
+def parse_quotas(pairs) -> dict[str, float]:
+    quotas: dict[str, float] = {}
+    for p in pairs or ():
+        name, _, val = p.partition("=")
+        if not name or not val:
+            raise SystemExit(f"bad --quota {p!r} (want tenant=qps)")
+        try:
+            quotas[name] = float(val)
+        except ValueError:
+            raise SystemExit(f"bad --quota {p!r}: {val!r} is not a number")
+    return quotas
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="micro-batched inference server")
+    ap.add_argument("--models", action="append", required=True,
+                    metavar="NAME[=WEIGHTS]",
+                    help="zoo models to pre-load (comma-separable, "
+                         "repeatable); optional =path to .caffemodel/npz "
+                         "weights")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8100,
+                    help="0 picks an ephemeral port (printed on ready)")
+    ap.add_argument("--shapes", default=None,
+                    help="compiled batch shapes, e.g. 1,4,16,64 "
+                         "(default SPARKNET_SERVE_SHAPES)")
+    ap.add_argument("--max-delay-ms", type=float, default=None,
+                    help="micro-batch coalesce deadline "
+                         "(default SPARKNET_SERVE_MAX_DELAY_MS)")
+    ap.add_argument("--queue-depth", type=int, default=None,
+                    help="admission bound (default SPARKNET_SERVE_QUEUE)")
+    ap.add_argument("--hbm-budget-mb", type=float, default=None,
+                    help="model-house budget (default SPARKNET_SERVE_HBM_MB)")
+    ap.add_argument("--dtype", choices=("bf16", "f32"), default=None,
+                    help="compute dtype (default SPARKNET_SERVE_DTYPE)")
+    ap.add_argument("--quota", action="append", default=[],
+                    metavar="TENANT=QPS",
+                    help="per-tenant QPS cap (repeatable; '*' caps "
+                         "tenants without an explicit entry)")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.parallel.serving import (
+        InferenceEngine, ModelHouse, ServeConfig,
+    )
+
+    base = ServeConfig()   # env defaults
+    cfg = ServeConfig(
+        batch_shapes=(tuple(int(s) for s in args.shapes.split(","))
+                      if args.shapes else base.batch_shapes),
+        max_delay_ms=(args.max_delay_ms if args.max_delay_ms is not None
+                      else base.max_delay_ms),
+        max_queue=(args.queue_depth if args.queue_depth is not None
+                   else base.max_queue),
+        hbm_budget_mb=(args.hbm_budget_mb if args.hbm_budget_mb is not None
+                       else base.hbm_budget_mb),
+        dtype=args.dtype or base.dtype,
+        tenant_qps=parse_quotas(args.quota))
+
+    house = ModelHouse(cfg)
+    for name, weights in parse_models(args.models):
+        lm = house.load(name, weights=weights)
+        print(f"[serve] loaded {name}: in={lm.in_shape} "
+              f"classes={lm.classes} {lm.param_bytes / 2**20:.1f} MB, "
+              f"compiled {len(cfg.batch_shapes)} shapes in "
+              f"{lm.compile_s:.1f}s", file=sys.stderr, flush=True)
+
+    engine = InferenceEngine(house, cfg)
+    httpd = ThreadingHTTPServer((args.host, args.port),
+                                make_handler(engine, house))
+    httpd.daemon_threads = True
+    host, port = httpd.server_address[:2]
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    server_thread = threading.Thread(target=httpd.serve_forever,
+                                     daemon=True)
+    server_thread.start()
+    # the ready line: tests and operators key off this exact prefix
+    print(f"serving on http://{host}:{port} "
+          f"(models: {', '.join(sorted(house.loaded()))})", flush=True)
+    stop.wait()
+    print("[serve] shutting down", file=sys.stderr, flush=True)
+    httpd.shutdown()
+    engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,320 @@
+#!/usr/bin/env python
+"""Closed-loop serving load generator — the latency-vs-offered-QPS story.
+
+Drives the serving plane with closed-loop clients and emits one
+BENCH-style JSON report covering the three acceptance claims of the
+serving subsystem:
+
+(a) **dynamic batching wins**: saturation throughput of the
+    micro-batching engine vs a batch=1 engine (same model, same compiled
+    kernels, shapes pinned to ``(1,)`` and coalescing off) — the
+    Caffe-con-Troll "the harness is the win" number.
+(b) **overload degrades into typed rejections, not latency collapse**:
+    at 2x the measured saturation QPS the bounded queue + admission
+    control keep the p99 of ACCEPTED requests under an explicit bound
+    (``2·queue/throughput + 5·p99_sat + delay``) while the rejection
+    counters absorb the excess.
+(c) **batching never changes answers**: every completed request in every
+    paced sweep point is compared bit-for-bit against its solo-run
+    reference at the same compiled shape (``solo_references``).
+
+Modes:
+  in-process (default)  build the engine here; full report incl. (a)-(c).
+  --url http://…        drive a running tools/serve.py over HTTP
+                        (timing + rejection legs; exactness needs
+                        engine-side references, so it is skipped).
+  --smoke               ~2 s CI gate: tiny sweep, hard-asserts (b) and
+                        (c) (+ prints (a)); non-zero exit on violation —
+                        wired as SPARKNET_SERVESMOKE=1 in run_tier1.sh.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/serveload.py --model lenet \
+      --seconds 2 --clients 16 --out BENCH_serving_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _log(msg: str) -> None:
+    print(f"[serveload] {msg}", file=sys.stderr, flush=True)
+
+
+class _ReadyFuture:
+    """Future shim for synchronous transports (one HTTP round trip per
+    client thread — remote windows degrade to window=1 semantics)."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def done(self) -> bool:
+        return True
+
+    def result(self, timeout=None):
+        return self._value
+
+
+def make_remote_submit(url: str, model: str, tenant: str):
+    """HTTP transport for run_closed_loop: 429s re-raise as the engine's
+    typed Overloaded so rejection accounting matches in-process runs."""
+    from sparknet_tpu.classify import remote_classify
+    from sparknet_tpu.parallel.serving import Overloaded, ServeResult
+
+    def submit(idx: int, x: np.ndarray) -> _ReadyFuture:
+        try:
+            d = remote_classify(url, model, x, tenant=tenant)
+        except RuntimeError as e:
+            if "HTTP 429" in str(e):
+                raise Overloaded("queue_full", str(e)) from None
+            raise
+        return _ReadyFuture(ServeResult(
+            model=d["model"], probs=np.asarray(d["probs"], np.float32),
+            tenant=tenant, request_id=d["request_id"],
+            queue_ms=d["queue_ms"], infer_ms=d["infer_ms"],
+            total_ms=d["total_ms"], batch_n=d["batch_n"],
+            padded_to=d["padded_to"]))
+
+    return submit
+
+
+def run_report(model: str = "lenet", weights: str | None = None,
+               shapes: tuple[int, ...] | None = None,
+               delay_ms: float | None = None, queue: int | None = None,
+               dtype: str | None = None, clients: int = 8,
+               window: int = 16,
+               seconds: float = 2.0, inputs_n: int = 32, seed: int = 0,
+               fractions: tuple[float, ...] = (0.25, 0.5, 1.0),
+               overload_x: float = 2.0,
+               url: str | None = None) -> dict:
+    """The full load report (see module docstring).  In-process unless
+    ``url`` is given."""
+    from sparknet_tpu.parallel.serving import (
+        InferenceEngine, ModelHouse, ServeConfig, run_closed_loop,
+        solo_references,
+    )
+
+    base = ServeConfig()
+    cfg = ServeConfig(
+        batch_shapes=shapes or base.batch_shapes,
+        max_delay_ms=base.max_delay_ms if delay_ms is None else delay_ms,
+        max_queue=queue or base.max_queue,
+        dtype=dtype or base.dtype, seed=seed)
+    rng = np.random.default_rng(seed)
+
+    report: dict = {
+        "metric": "serving_dynamic_vs_batch1_speedup_x",
+        "unit": "x",
+        "model": model,
+        "mode": "remote" if url else "in_process",
+        "clients": clients,
+        "window": window,
+        "seconds_per_point": seconds,
+        "batch_shapes": list(cfg.batch_shapes),
+        "max_delay_ms": cfg.max_delay_ms,
+        "max_queue": cfg.max_queue,
+        "dtype": cfg.dtype,
+    }
+
+    if url:
+        from sparknet_tpu.classify import http_json
+        info = http_json(f"{url.rstrip('/')}/v1/models")["models"]
+        if model not in info:
+            raise SystemExit(f"server has no model {model!r} "
+                             f"(loaded: {sorted(info)})")
+        in_shape = tuple(info[model]["in_shape"])
+        inputs = [rng.normal(size=in_shape).astype(np.float32)
+                  for _ in range(inputs_n)]
+        refs = None
+        submit = make_remote_submit(url.rstrip("/"), model, "loadgen")
+        engine = None
+        batch1 = None
+        lm = None
+    else:
+        house = ModelHouse(cfg)
+        lm = house.load(model, weights=weights)
+        report["model_info"] = lm.info()
+        engine = InferenceEngine(house, cfg)
+        inputs = [rng.normal(size=lm.in_shape).astype(np.float32)
+                  for _ in range(inputs_n)]
+        _log(f"building solo references over {len(cfg.batch_shapes)} "
+             f"shapes × {inputs_n} inputs")
+        refs = solo_references(lm, inputs)
+        submit = None
+
+        # leg (a) baseline: batch=1 serving — same kernels, harness off
+        b1cfg = ServeConfig(batch_shapes=(1,), max_delay_ms=0.0,
+                            max_queue=cfg.max_queue, dtype=cfg.dtype,
+                            seed=seed)
+        b1house = ModelHouse(b1cfg)
+        b1house.load(model, weights=weights)
+        with InferenceEngine(b1house, b1cfg) as b1eng:
+            batch1 = run_closed_loop(b1eng, model, inputs,
+                                     clients=clients, window=window,
+                                     duration_s=seconds)
+        _log(f"batch1 saturation: {batch1['achieved_qps']} qps "
+             f"(p50 {batch1['p50_ms']} ms)")
+        report["batch1"] = batch1
+
+    # dynamic saturation (leg (a) numerator, and the yardstick for (b))
+    sat = run_closed_loop(engine, model, inputs, clients=clients,
+                          window=window, duration_s=seconds, refs=refs,
+                          submit=submit)
+    _log(f"dynamic saturation: {sat['achieved_qps']} qps "
+         f"(p50 {sat['p50_ms']} ms, p99 {sat['p99_ms']} ms)")
+    report["saturation"] = sat
+    sat_qps = max(sat["achieved_qps"], 1.0)
+
+    # paced sweep with the exactness audit at every point (claim (c))
+    sweep = []
+    for frac in fractions:
+        point = run_closed_loop(engine, model, inputs, clients=clients,
+                                window=window, duration_s=seconds,
+                                offered_qps=max(frac * sat_qps, 1.0),
+                                refs=refs, submit=submit)
+        point["fraction_of_saturation"] = frac
+        _log(f"sweep {frac:.2f}x ({point['offered_qps']} qps offered): "
+             f"achieved {point['achieved_qps']} "
+             f"p50 {point['p50_ms']} p99 {point['p99_ms']} "
+             f"rejected {point['rejected']} "
+             f"mismatches {point['exact_mismatches']}")
+        sweep.append(point)
+    report["sweep"] = sweep
+
+    # overload leg (claim (b)): 2x saturation through the bounded queue.
+    # Client concurrency must exceed the admission bound or the closed
+    # loop can never present more work than the engine accepts — scale
+    # the window so clients*window comfortably overfills the queue.
+    over_window = max(window,
+                      (int(1.5 * cfg.max_queue) + clients - 1) // clients)
+    over = run_closed_loop(engine, model, inputs, clients=clients,
+                           window=over_window, duration_s=seconds,
+                           offered_qps=overload_x * sat_qps,
+                           refs=refs, submit=submit)
+    over["fraction_of_saturation"] = overload_x
+    report["overload"] = over
+    # the bound: queue drain time at measured throughput (doubled for
+    # slack) + deadline + 5x the saturation p99 — crossing it means the
+    # queue is NOT bounding latency, i.e. admission control failed
+    p99_bound_ms = (2000.0 * cfg.max_queue / sat_qps
+                    + 5.0 * max(sat["p99_ms"], 1.0) + cfg.max_delay_ms)
+    report["p99_bound_ms"] = round(p99_bound_ms, 1)
+    _log(f"overload {overload_x}x: achieved {over['achieved_qps']} "
+         f"p99 {over['p99_ms']} (bound {p99_bound_ms:.0f}) "
+         f"rejected {over['rejected']}")
+
+    mismatches = sum(p["exact_mismatches"] or 0 for p in sweep)
+    mismatches += sat["exact_mismatches"] or 0
+    mismatches += over["exact_mismatches"] or 0
+    speedup = (round(sat["achieved_qps"]
+                     / max(batch1["achieved_qps"], 1e-9), 2)
+               if batch1 else None)
+    report["value"] = speedup
+    report["verdicts"] = {
+        # (a) harness win at saturation
+        "batching_speedup_x": speedup,
+        "batching_beats_4x": (None if speedup is None else speedup >= 4.0),
+        # (b) bounded p99 + typed rejections + no throughput collapse
+        "overload_rejected": over["rejected"],
+        "overload_p99_bounded": over["p99_ms"] <= p99_bound_ms,
+        "overload_no_collapse":
+            over["achieved_qps"] >= 0.5 * sat_qps,
+        # (c) bit-identical to solo runs at every swept QPS
+        "exact_mismatches": None if refs is None else mismatches,
+        "bit_identical": None if refs is None else mismatches == 0,
+    }
+    if engine is not None:
+        report["engine_stats"] = engine.stats()
+        engine.stop()
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="closed-loop serving load "
+                                             "generator")
+    ap.add_argument("--model", default="lenet")
+    ap.add_argument("--weights", default=None)
+    ap.add_argument("--shapes", default=None,
+                    help="compiled batch shapes, e.g. 1,4,16,64")
+    ap.add_argument("--delay-ms", type=float, default=None)
+    ap.add_argument("--queue", type=int, default=None)
+    ap.add_argument("--dtype", choices=("bf16", "f32"), default=None)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--window", type=int, default=16,
+                    help="outstanding requests per client (pipelined "
+                         "frontend; total concurrency = clients*window)")
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="duration per sweep point")
+    ap.add_argument("--inputs", type=int, default=32,
+                    help="distinct-input pool size")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--overload-x", type=float, default=2.0)
+    ap.add_argument("--url", default=None,
+                    help="drive a running tools/serve.py instead of an "
+                         "in-process engine")
+    ap.add_argument("--out", default=None, help="write the JSON report "
+                                                "here (stdout always)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="~2 s CI gate: assert bounded p99 under "
+                         "overload + bit-identical results; rc!=0 on "
+                         "violation")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.seconds = min(args.seconds, 0.4)
+        args.clients = min(args.clients, 4)
+        args.window = min(args.window, 16)
+        args.queue = args.queue or 32   # overload must trip the bound
+        shapes = (1, 4, 8)
+        fractions = (1.0,)
+    else:
+        shapes = (tuple(int(s) for s in args.shapes.split(","))
+                  if args.shapes else None)
+        fractions = (0.25, 0.5, 1.0)
+
+    report = run_report(
+        model=args.model, weights=args.weights, shapes=shapes,
+        delay_ms=args.delay_ms, queue=args.queue, dtype=args.dtype,
+        clients=args.clients, window=args.window, seconds=args.seconds,
+        inputs_n=args.inputs, seed=args.seed, fractions=fractions,
+        overload_x=args.overload_x, url=args.url)
+    report["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    line = json.dumps(report)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+
+    if args.smoke:
+        v = report["verdicts"]
+        bad = []
+        if v["bit_identical"] is False:
+            bad.append(f"{v['exact_mismatches']} result mismatches vs "
+                       f"solo references")
+        if not v["overload_p99_bounded"]:
+            bad.append(f"overload p99 {report['overload']['p99_ms']} ms "
+                       f"over bound {report['p99_bound_ms']} ms")
+        if not v["overload_rejected"]:
+            bad.append("overload produced zero rejections (admission "
+                       "control never engaged)")
+        if bad:
+            _log("SMOKE FAIL: " + "; ".join(bad))
+            return 1
+        _log(f"smoke ok: speedup {v['batching_speedup_x']}x, overload "
+             f"p99 {report['overload']['p99_ms']} ms "
+             f"<= {report['p99_bound_ms']} ms with "
+             f"{v['overload_rejected']} rejections, bit-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
