@@ -51,6 +51,8 @@ from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
+from ..utils import telemetry
+
 
 def _env_int(name: str, default: int) -> int:
     raw = os.environ.get(name, "")
@@ -103,11 +105,23 @@ class FeedStats:
         with self._lock:
             self._s[stage] = self._s.get(stage, 0.0) + seconds
             self.records += records
+        # telemetry plane: the stage timing the pipeline already measured
+        # becomes a histogram sample and (when tracing) a retroactive
+        # span — DecodePool / transforms / DeviceFeed all route through
+        # here, so one hook instruments every feed stage
+        telemetry.get_registry().histogram(
+            "feed_stage_seconds",
+            "host feed pipeline stage latency").observe(seconds,
+                                                        stage=stage)
+        telemetry.note_span(f"feed.{stage}", seconds, cat="feed")
 
     def count_batch(self, records: int = 0) -> None:
         with self._lock:
             self.batches += 1
             self.records += records
+        telemetry.get_registry().counter(
+            "feed_batches_total", "batches delivered to the consumer"
+        ).inc()
 
     def note_cache(self, hit: bool) -> None:
         with self._lock:
@@ -115,6 +129,9 @@ class FeedStats:
                 self.cache_hits += 1
             else:
                 self.cache_misses += 1
+        telemetry.get_registry().counter(
+            "feed_cache_total", "shard cache lookups by outcome"
+        ).inc(result="hit" if hit else "miss")
 
     class _Timer:
         __slots__ = ("_stats", "_stage", "_records", "_t0")

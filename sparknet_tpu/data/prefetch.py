@@ -36,7 +36,7 @@ from typing import Any, Callable, Iterator, Mapping
 
 import jax
 
-from ..utils import faults
+from ..utils import faults, telemetry
 
 
 class FeedStalled(RuntimeError):
@@ -168,12 +168,22 @@ class PrefetchIterator:
             self._err = FeedStalled(
                 f"prefetch feed stalled after {self._delivered} delivered "
                 f"batches: {reason} (restart budget spent)")
+            rec = telemetry.get_recorder()
+            rec.record("feed_stalled", delivered=self._delivered,
+                       reason=reason)
+            rec.dump("feed_stalled")
             # attribution on the health plane: the consumer is ALIVE and
             # names the feed as the culprit — the straggler monitor must
             # not read this rank's silence as a hung worker
             from ..parallel import health
             health.maybe_beat(self._delivered, "feed_stalled")
             raise self._err
+        telemetry.get_recorder().record(
+            "feed_restart", delivered=self._delivered, reason=reason,
+            restarts_left=self._restarts_left)
+        telemetry.get_registry().counter(
+            "feed_restarts_total", "prefetch feeder watchdog restarts"
+        ).inc()
         print(f"prefetch: {reason}; restarting feeder "
               f"({self._restarts_left} restarts left)",
               file=sys.stderr, flush=True)

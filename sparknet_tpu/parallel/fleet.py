@@ -82,6 +82,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Mapping
 
+from ..utils import telemetry
 from .resilience import ResilientRunner, RestartPolicy
 
 # job lifecycle states (journaled verbatim)
@@ -393,6 +394,18 @@ class FleetScheduler:
     def _journal_ev(self, ev: str, **fields) -> None:
         if self.journal is not None:
             self.journal.append(ev, **fields)
+        # every scheduling decision also rides the telemetry plane: a
+        # bounded flight-recorder trail (embedded into quarantine
+        # postmortems) plus a per-kind counter — the journal stays the
+        # durable source of truth, this is the observable echo
+        telemetry.get_recorder().record(
+            f"fleet_{ev}",
+            **{k: v for k, v in fields.items()
+               if k in ("job", "rc", "reason", "by", "episode",
+                        "preempts", "recovered", "ok", "slots")})
+        telemetry.get_registry().counter(
+            "fleet_events_total", "fleet scheduler events by kind"
+        ).inc(ev=ev)
 
     # -- submission -------------------------------------------------------
     def job_dir(self, name: str) -> str:
@@ -494,6 +507,11 @@ class FleetScheduler:
         env = dict(self.extra_env)
         env.update(job.spec.env)
         env[ENV_JOB_TAG] = job.name
+        # telemetry: workers snapshot their metrics registry into the
+        # job dir (throttled, atomic) so status views can fold them in
+        # without a live channel; spec/env overrides win
+        env.setdefault("SPARKNET_METRICS_SNAP",
+                       os.path.join(job.job_dir, "metrics"))
         if job.spec.fault:
             env["SPARKNET_FAULT"] = job.spec.fault
         job.runner = self.runner_factory(job, cmd, env)
@@ -649,6 +667,15 @@ class FleetScheduler:
             post.update(cause=failure.cause, rank=failure.rank,
                         heartbeat_age=failure.heartbeat_age,
                         log_tail=failure.log_tail)
+        # the flight-recorder tail: the scheduling decisions (and any
+        # restarts this process supervised) that led here — the black
+        # box a post-mortem reader wants next to the exit code.  The
+        # verdict itself is recorded BEFORE the tail is captured (the
+        # journal echo lands after this file is written).
+        telemetry.get_recorder().record(
+            "fleet_quarantine", job=job.name, rc=rc,
+            reason=post["reason"])
+        post["flight_recorder"] = telemetry.get_recorder().tail(64)
         path = os.path.join(job.job_dir, "postmortem.json")
         with open(path, "w") as f:
             json.dump(post, f, indent=1)
@@ -766,6 +793,7 @@ class FleetScheduler:
         jobs = []
         for job in sorted(self.jobs.values(), key=lambda j: j.seq):
             round_done = job.newest_round()
+            metrics = job_metrics(job.job_dir)
             jobs.append({
                 "job": job.name,
                 "tenant": job.spec.tenant,
@@ -781,6 +809,8 @@ class FleetScheduler:
                           else round_done),
                 "rounds_target": job.spec.rounds,
                 "heartbeats": self._heartbeats(job),
+                "metrics": metrics,
+                "metrics_note": metrics_note(metrics),
             })
         by_tenant = {}
         for t in sorted({j.spec.tenant for j in self.jobs.values()}):
@@ -890,6 +920,155 @@ class FleetScheduler:
                     pass
 
 
+def job_metrics(job_dir: str) -> dict[str, Any]:
+    """Fold the registry snapshots a job's workers wrote into
+    ``<job_dir>/metrics`` (see telemetry.MetricsRegistry.maybe_snapshot;
+    the scheduler points workers there via SPARKNET_METRICS_SNAP).
+    Empty when the job never snapshotted — older jobs simply lack it."""
+    paths = glob.glob(os.path.join(job_dir, "metrics",
+                                   "metrics_rank*.json"))
+    if not paths:
+        return {}
+    return telemetry.fold_snapshots(sorted(paths))
+
+
+def metrics_note(metrics: Mapping[str, Any]) -> str:
+    """One compact table cell out of a job's folded registry snapshot."""
+    if not metrics:
+        return ""
+
+    def total(name: str) -> float:
+        agg = metrics.get(name)
+        if not agg:
+            return 0.0
+        return sum(s.get("value", s.get("count", 0)) or 0
+                   for s in agg.get("samples", ()))
+
+    parts = []
+    rounds = total("trainer_rounds_total")
+    if rounds:
+        parts.append(f"rounds {int(rounds)}")
+    trips = total("trainer_guard_trips_total")
+    if trips:
+        parts.append(f"guard {int(trips)}")
+    audits = total("trainer_audit_trips_total")
+    if audits:
+        parts.append(f"audit {int(audits)}")
+    batches = total("feed_batches_total")
+    if batches:
+        parts.append(f"feed {int(batches)}b")
+    served = total("serve_completed_total")
+    if served:
+        parts.append(f"served {int(served)}")
+    return " ".join(parts)
+
+
+def offline_status(workdir: str) -> dict[str, Any]:
+    """The fleet status view reconstructed from ``workdir``'s journal
+    alone — no scheduler process, nothing launched, nothing signalled.
+    The data source for ``tools/fleet.py --status [--json]``: external
+    scrapers get the same facts the live table shows (journal state,
+    newest checkpoint manifests, per-rank heartbeats, folded registry
+    snapshots) without parsing the human rendering."""
+    path = os.path.join(os.path.abspath(workdir), "fleet_journal.jsonl")
+    events = FleetJournal.read(path)
+    if not events:
+        raise FleetError(f"no journal to read at {path}")
+    devices = 0
+    tenants: dict[str, int] = {}
+    order: list[str] = []
+    specs: dict[str, JobSpec] = {}
+    state: dict[str, str] = {}
+    slots: dict[str, list[int]] = {}
+    counters: dict[str, dict[str, int]] = {}
+    runner_dirs: dict[str, str] = {}
+    for ev in events:
+        kind = ev.get("ev")
+        name = ev.get("job")
+        c = counters.setdefault(name, {"episodes": 0, "attempts": 0,
+                                       "preempts": 0}) if name else None
+        if kind == "fleet":
+            devices = ev.get("devices", devices)
+            tenants = dict(ev.get("tenants") or {})
+        elif kind == "submit":
+            specs[name] = JobSpec.from_json(ev["spec"])
+            order.append(name)
+            state[name] = QUEUED
+        elif kind == "launch":
+            state[name] = RUNNING
+            slots[name] = list(ev.get("slots", []))
+            c["episodes"] = ev.get("episode", c["episodes"] + 1)
+        elif kind == "pids":
+            c["attempts"] += 1
+        elif kind == "preempt":
+            state[name] = PREEMPTING
+        elif kind == "requeue":
+            state[name] = QUEUED
+            slots.pop(name, None)
+            c["preempts"] = ev.get("preempts", c["preempts"] + 1)
+        elif kind == "exit":
+            if state.get(name) not in TERMINAL:
+                state[name] = "EXITED"
+            slots.pop(name, None)
+        elif kind == "complete":
+            state[name] = COMPLETED
+            slots.pop(name, None)
+        elif kind == "quarantine":
+            state[name] = QUARANTINED
+            slots.pop(name, None)
+        elif kind == "recover":
+            state[name] = QUEUED
+    jobs = []
+    used_by_tenant: dict[str, int] = {}
+    free = devices
+    for name in order:
+        spec = specs[name]
+        job_dir = os.path.join(os.path.abspath(workdir), "jobs", name)
+        probe = FleetJob(spec, job_dir, 0, 0.0)
+        st = state.get(name, QUEUED)
+        if st not in TERMINAL and probe.completed_ok():
+            st = COMPLETED   # finished after the journal's last word
+        job_slots = slots.get(name, []) if st in (RUNNING,
+                                                  PREEMPTING) else []
+        if job_slots:
+            free -= len(job_slots)
+            used_by_tenant[spec.tenant] = (
+                used_by_tenant.get(spec.tenant, 0) + len(job_slots))
+        # newest attempt's heartbeat dir, scanned without a runner handle
+        beats: dict[int, dict] = {}
+        attempts = sorted(glob.glob(os.path.join(
+            job_dir, "runner", "ep_*", "attempt_*", "hb")))
+        if attempts:
+            from . import health
+            beats = {rank: {"round": b.round, "phase": b.phase,
+                            "age_s": round(b.age(), 2),
+                            **({"extras": b.extras} if b.extras else {})}
+                     for rank, b in health.read_all(attempts[-1]).items()}
+        metrics = job_metrics(job_dir)
+        c = counters.get(name, {})
+        jobs.append({
+            "job": name, "tenant": spec.tenant, "state": st,
+            "priority": spec.priority,
+            "eff_priority": float(spec.priority),  # no live clock offline
+            "world": spec.world, "slots": job_slots,
+            "episodes": c.get("episodes", 0),
+            "attempts": c.get("attempts", 0),
+            "preempts": c.get("preempts", 0),
+            "round": (spec.rounds if st == COMPLETED
+                      else probe.newest_round()),
+            "rounds_target": spec.rounds,
+            "heartbeats": beats,
+            "metrics": metrics,
+            "metrics_note": metrics_note(metrics),
+        })
+    by_tenant = {t: {"used": used_by_tenant.get(t, 0),
+                     "quota": tenants.get(t)}
+                 for t in sorted({j["tenant"] for j in jobs} |
+                                 set(tenants))}
+    return {"devices": {"total": devices, "free": max(free, 0)},
+            "tenants": by_tenant, "jobs": jobs}
+
+
 def format_status(status: Mapping[str, Any]) -> str:
     """Render ``FleetScheduler.status()`` as a fixed-width table."""
     dev = status["devices"]
@@ -919,6 +1098,9 @@ def format_status(status: Mapping[str, Any]) -> str:
                        f"p50 {extras.get('p50_ms', 0):.0f}ms "
                        f"p99 {extras.get('p99_ms', 0):.0f}ms")
             break   # first rank is enough for the one-liner
+        note = j.get("metrics_note")
+        if note:
+            hb = f"{hb} [{note}]" if hb else f"[{note}]"
         lines.append(
             f"{j['job']:<16} {j['tenant']:<8} {j['state']:<11} "
             f"{j['priority']:>5} {j['eff_priority']:>6.1f} "

@@ -60,6 +60,7 @@ import time
 from typing import Callable
 
 from ..tools.launch import EXIT_STRAGGLER, free_port, launch_local, launch_ssh
+from ..utils import telemetry
 from . import health
 
 LOG_TAIL_BYTES = 2048
@@ -349,6 +350,16 @@ class ResilientRunner:
                 incarnation=self.incarnation, world=self.world_size(),
                 first_failure=report.get("first_failure"),
                 cause=report.get("cause", "")))
+            if rc != 0:
+                telemetry.get_recorder().record(
+                    "restart", attempt=attempt, rc=rc,
+                    cause=report.get("cause", "exit"),
+                    rank=report.get("first_failure"),
+                    incarnation=self.incarnation)
+                telemetry.get_registry().counter(
+                    "resilience_restarts_total",
+                    "supervised job attempts that failed and restarted"
+                ).inc(cause=report.get("cause") or "exit")
             if rc == 0:
                 if attempt:
                     print(f"resilience: job recovered on attempt "
@@ -389,6 +400,9 @@ class ResilientRunner:
                     and survivors >= self.elastic.min_workers):
                 slot = self._drop(culprit)
                 self.incarnation += 1
+                telemetry.get_recorder().record(
+                    "reform", dropped=str(slot), world=self.world_size(),
+                    incarnation=self.incarnation)
                 print(f"resilience: restart budget exhausted on "
                       f"{slot!r}; re-forming with {self.world_size()} "
                       f"survivors (incarnation {self.incarnation}) — the "
@@ -396,6 +410,12 @@ class ResilientRunner:
                       f"consensus", file=sys.stderr, flush=True)
                 continue
             self.failure = self._build_failure(rc)
+            rec = telemetry.get_recorder()
+            rec.record("resilience_error", rc=rc, rank=self.failure.rank,
+                       cause=self.failure.cause,
+                       attempts=len(self.attempts),
+                       incarnations=self.incarnation + 1)
+            rec.dump("resilience_error")
             print(f"resilience: giving up rc={rc}: {self.failure}",
                   file=sys.stderr, flush=True)
             return rc

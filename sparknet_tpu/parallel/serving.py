@@ -64,6 +64,8 @@ from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
+from ..utils import telemetry
+
 
 # ---------------------------------------------------------------------------
 # Typed errors — admission and liveness failures are API, not stack traces
@@ -550,6 +552,21 @@ class InferenceEngine:
         # occupancy["<padded shape>"][<real n>] = batches dispatched
         self.occupancy: dict[str, dict[int, int]] = {}
         self._t_start = time.monotonic()
+        # telemetry plane: live histograms/counters observed on the hot
+        # path, point-in-time gauges filled by a scrape-time collector
+        # (GET /metrics on tools/serve.py renders the registry)
+        reg = telemetry.get_registry()
+        self._m_lat = reg.histogram(
+            "serve_request_seconds", "request latency, submit to demux")
+        self._m_infer = reg.histogram(
+            "serve_infer_seconds", "batch dispatch-to-host latency")
+        self._m_done = reg.counter(
+            "serve_completed_total", "requests answered")
+        self._m_failed = reg.counter(
+            "serve_failed_total", "requests failed by model errors")
+        self._m_rej = reg.counter(
+            "serve_rejected_total", "admission rejections by reason")
+        reg.add_collector(self._publish_gauges)
         self._harvest_q: "_queue.Queue[Any]" = _queue.Queue(
             maxsize=self.cfg.inflight_batches)
         self._harvester = threading.Thread(
@@ -620,6 +637,7 @@ class InferenceEngine:
                         cap, self._clock)
                 if not bucket.allow():
                     self.rejected["tenant_rate"] += 1
+                    self._m_rej.inc(reason="tenant_rate")
                     raise Overloaded(
                         "tenant_rate",
                         f"tenant {tenant!r} over its {cap:g} qps cap")
@@ -628,6 +646,7 @@ class InferenceEngine:
             # backlog, so max_queue / throughput bounds accepted latency
             if self._depth + self._in_flight >= self.cfg.max_queue:
                 self.rejected["queue_full"] += 1
+                self._m_rej.inc(reason="queue_full")
                 raise Overloaded(
                     "queue_full",
                     f"{self._depth} queued + {self._in_flight} in flight "
@@ -709,6 +728,10 @@ class InferenceEngine:
         err = ServingError(
             f"batch of {len(reqs)} on {model!r} failed: {cause}")
         err.__cause__ = cause
+        self._m_failed.inc(len(reqs))
+        telemetry.get_recorder().record(
+            "serve_batch_failed", model=model, n=len(reqs),
+            cause=repr(cause))
         with self._cond:
             self.failed += len(reqs)
             self._in_flight -= len(reqs)
@@ -723,12 +746,15 @@ class InferenceEngine:
         n = len(reqs)
         t_dispatch = self._clock()
         try:
-            lm = self.models.get(model)
-            shape = lm.pad_shape(n)
-            batch = np.zeros((shape,) + lm.in_shape, np.float32)
-            for i, r in enumerate(reqs):
-                batch[i] = r.x
-            out = lm.infer_async(batch)   # pad rows computed, masked at demux
+            with telemetry.span("serve.dispatch", cat="serving",
+                                model=model, n=n):
+                lm = self.models.get(model)
+                shape = lm.pad_shape(n)
+                batch = np.zeros((shape,) + lm.in_shape, np.float32)
+                for i, r in enumerate(reqs):
+                    batch[i] = r.x
+                # pad rows computed, masked at demux
+                out = lm.infer_async(batch)
         except Exception as e:
             self._fail_batch(reqs, model, e)
             return
@@ -759,14 +785,17 @@ class InferenceEngine:
         import jax
         n = len(reqs)
         try:
-            probs = np.asarray(jax.device_get(out))
-            t_done = self._clock()
+            with telemetry.span("serve.batch", cat="serving",
+                                model=model, n=n, padded_to=shape):
+                probs = np.asarray(jax.device_get(out))
+                t_done = self._clock()
         except Exception as e:
             with self._cond:
                 self._batches_in_flight -= 1
             self._fail_batch(reqs, model, e)
             return
         infer_ms = (t_done - t_dispatch) * 1e3
+        self._m_infer.observe(infer_ms / 1e3)
         results = []
         for i, r in enumerate(reqs):
             results.append(ServeResult(
@@ -786,11 +815,50 @@ class InferenceEngine:
             for res in results:
                 self._lat_ms.append(res.total_ms)
                 self._queue_ms.append(res.queue_ms)
+        self._m_done.inc(n)
+        for res in results:
+            self._m_lat.observe(res.total_ms / 1e3)
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            # per-request queue spans, anchored from the latency stamps
+            # (submit -> dispatch): with the dispatch and batch spans
+            # these make the queue -> coalesce -> infer -> demux story
+            # one connected timeline per request
+            now_us = time.time() * 1e6
+            for res in results:
+                tr.complete("serve.queue", "serving",
+                            now_us - res.total_ms * 1e3,
+                            res.queue_ms * 1e3,
+                            {"model": model, "rid": res.request_id})
         for r, res in zip(reqs, results):
             r.result = res
             r.event.set()
 
     # -- telemetry --------------------------------------------------------
+    def _publish_gauges(self) -> None:
+        """Scrape-time registry filler (weakly registered): the
+        point-in-time numbers a Prometheus scrape or file snapshot
+        should carry — queue depth, in-flight work, latency
+        percentiles over the trailing window."""
+        reg = telemetry.get_registry()
+        with self._cond:
+            depth = self._depth
+            in_flight = self._in_flight
+            batches = self._batches_in_flight
+            pcts = self._percentiles(self._lat_ms)
+        reg.gauge("serve_queue_depth",
+                  "requests queued awaiting coalesce").set(depth)
+        reg.gauge("serve_in_flight",
+                  "requests dispatched, not yet demuxed").set(in_flight)
+        reg.gauge("serve_in_flight_batches",
+                  "batches dispatched, not yet demuxed").set(batches)
+        reg.gauge("serve_p50_ms",
+                  "trailing-window p50 request latency").set(pcts["p50_ms"])
+        reg.gauge("serve_p99_ms",
+                  "trailing-window p99 request latency").set(pcts["p99_ms"])
+        reg.gauge("serve_alive", "1 while the engine serves").set(
+            1.0 if self.alive else 0.0)
+
     def _percentiles(self, samples: Sequence[float]) -> dict[str, float]:
         if not samples:
             return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}

@@ -59,6 +59,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..graph.net import Net, WeightCollection
 from ..proto.caffe_pb import NetState, Phase, SolverParameter
+from ..utils import telemetry
 from ..solvers.lr_policies import learning_rate
 from ..solvers.step import make_step_fns
 from ..solvers.update_rules import make_update_rule, preprocess_grads
@@ -327,6 +328,18 @@ class DistributedTrainer:
         # round_end heartbeats so fleet-level supervisors can see the data
         # plane's health without any extra channel
         self.feed_stats = None
+        # telemetry handles (no-op singletons under SPARKNET_TELEMETRY=0)
+        reg = telemetry.get_registry()
+        self._m_rounds = reg.counter(
+            "trainer_rounds_total", "training rounds run (replays included)")
+        self._m_guard = reg.counter(
+            "trainer_guard_trips_total", "numerical-guard rollbacks")
+        self._m_audit = reg.counter(
+            "trainer_audit_trips_total", "cross-replica audit rollbacks")
+        self._m_stall = reg.gauge(
+            "trainer_stall_seconds", "cumulative host stall by component")
+        self._m_pending = reg.gauge(
+            "trainer_pending_rounds", "in-flight rounds awaiting harvest")
         if self.config.harvest_lag < 0:
             raise ValueError(
                 f"harvest_lag must be >= 0, got {self.config.harvest_lag}")
@@ -593,6 +606,17 @@ class DistributedTrainer:
         does — same checkpoint chain, same RNG replay — and discards
         every in-flight round after the poisoned one.  Call ``drain()``
         before reading final params/scores."""
+        with telemetry.span("trainer.round", cat="trainer",
+                            round=self.round):
+            loss_val = self._train_round_impl(batches)
+        self._m_rounds.inc()
+        self._m_pending.set(len(self._pending))
+        for k, v in self.stall_s.items():
+            self._m_stall.set(v, comp=k)
+        telemetry.get_registry().maybe_snapshot()
+        return loss_val
+
+    def _train_round_impl(self, batches: Mapping[str, Any]) -> float:
         from . import health
         from ..utils import faults
         expect = self.batches_per_round
@@ -775,6 +799,11 @@ class DistributedTrainer:
         All processes take this path together — the decision derives
         from replicated values, so no collective can diverge."""
         self.guard_trips += 1
+        self._m_guard.inc()
+        rec = telemetry.get_recorder()
+        rec.record("guard_trip", round=round_idx, reason=reason,
+                   trips=self.guard_trips)
+        rec.dump("guard_trip")
         print(f"guard: round {round_idx} REJECTED ({reason}); rolling "
               f"back to last valid checkpoint at round <= {round_idx} "
               f"(trip {self.guard_trips}/{self.config.guard_max_trips})",
@@ -798,6 +827,11 @@ class DistributedTrainer:
 
     # -- deferred harvesting (see TrainerConfig.harvest_lag) --------------
     def _harvest_one(self) -> float | None:
+        with telemetry.span("trainer.harvest", cat="trainer",
+                            round=int(self._pending[0]["round"])):
+            return self._harvest_one_impl()
+
+    def _harvest_one_impl(self) -> float | None:
         """Resolve the OLDEST in-flight round: fetch its audit verdict,
         loss, and finite-check (in that order — the audit inspected the
         params the round STARTED from, so its verdict comes first, as on
@@ -934,6 +968,12 @@ class DistributedTrainer:
         vals, counts = np.unique(fps, return_counts=True)
         majority = vals[int(np.argmax(counts))]
         culprits = [i for i, f in enumerate(fps) if f != majority]
+        self._m_audit.inc()
+        rec = telemetry.get_recorder()
+        rec.record("audit_mismatch", round=round_idx, culprits=culprits,
+                   fingerprints=[hex(int(f)) for f in fps],
+                   last_ok=self._last_audit_ok)
+        rec.dump("audit_mismatch")
         print(f"audit: round {round_idx} REJECTED — cross-replica param "
               f"fingerprints diverge (replicas {culprits} vs the "
               f"majority: {[hex(int(f)) for f in fps]}); rolling back to "
@@ -1182,6 +1222,12 @@ class DistributedTrainer:
         same points in the write sequence, and ``flush_checkpoints()``
         is the barrier that restores strict durability where callers
         need it (rollback, preemption, end of run)."""
+        with telemetry.span("trainer.ckpt_submit", cat="ckpt",
+                            round=self.round):
+            return self._save_round_checkpoint_impl(directory)
+
+    def _save_round_checkpoint_impl(
+            self, directory: str | None = None) -> str | None:
         from ..utils import faults
         from ..utils.checkpoint import (
             AsyncCheckpointWriter, save_checkpoint, snapshot_tree,
@@ -1332,6 +1378,9 @@ class DistributedTrainer:
             # the restore re-broadcasts params to every replica, so the
             # mesh is consistent by construction from here
             self._last_audit_ok = self.round
+            telemetry.get_recorder().record(
+                "resume", round=self.round, iter=self.iter,
+                file=os.path.basename(manifest["file"]))
             print(f"resume: restored round {self.round} "
                   f"(iter {self.iter}) from "
                   f"{os.path.basename(manifest['file'])}",

@@ -20,6 +20,7 @@ the plot tool refuses the chart types that would need them.
 from __future__ import annotations
 
 import argparse
+import calendar
 import csv
 import datetime
 import os
@@ -37,21 +38,64 @@ _GLOG_RE = re.compile(
     r"^[IWEF](\d{2})(\d{2}) (\d{2}):(\d{2}):(\d{2})\.(\d+)\b")
 
 
-def _glog_seconds(line: str) -> float | None:
-    """Absolute within-year seconds of a glog-prefixed line (year is not
-    in the prefix; extract_seconds.py pulls it from the log's ctime —
-    deltas within one log only wrap at new year, handled in parse_log)."""
+def _log_year(path: str) -> int:
+    """The year the glog prefix omits, recovered from the log file's
+    mtime (the reference extract_seconds.py uses ctime the same way —
+    the log was last written in the year it logged, modulo a New Year
+    boundary handled by the wrap logic in parse_log).  If the log
+    carries a Feb 29 stamp but the mtime year is not leap (the file was
+    copied or touched later), walk back to the nearest leap year — the
+    log cannot postdate its mtime, and ONE year must govern the whole
+    log or neighboring lines would land a year apart."""
+    try:
+        year = datetime.datetime.fromtimestamp(
+            os.path.getmtime(path)).year
+    except OSError:
+        year = datetime.date.today().year
+    if not calendar.isleap(year):
+        try:
+            with open(path) as f:
+                has_feb29 = any(line[1:5] == "0229"
+                                and _GLOG_RE.match(line) for line in f)
+        except OSError:
+            has_feb29 = False
+        if has_feb29:
+            while not calendar.isleap(year):
+                year -= 1
+    return year
+
+
+def _glog_datetime(line: str, year: int) -> datetime.datetime | None:
+    """Full datetime of a glog-prefixed line in ``year``.  Computing
+    deltas from real datetimes (not a fixed-leap-year day-of-year
+    table) keeps Feb 28 → Mar 1 spans exact: the old 2024-anchored
+    scheme charged every non-leap-year log a phantom Feb 29 (+86400 s).
+    A Feb 29 stamp with a non-leap ``year`` walks back to the nearest
+    leap year — the log predates its mtime, it can't postdate it."""
     m = _GLOG_RE.match(line)
     if not m:
         return None
     mo, d, h, mi, s, frac = m.groups()
-    try:
-        # day-of-year via a fixed leap year so Feb 29 logs parse
-        day = datetime.date(2024, int(mo), int(d)).timetuple().tm_yday
-    except ValueError:
-        return None  # regex-shaped but not a date — treat as unprefixed
-    return (((day * 24 + int(h)) * 60 + int(mi)) * 60 + int(s)
-            + int(frac) / 10 ** len(frac))
+    us = round(int(frac) / 10 ** len(frac) * 1e6)
+    for y in range(year, year - 8, -1):
+        try:
+            return datetime.datetime(y, int(mo), int(d), int(h),
+                                     int(mi), int(s), us)
+        except ValueError:
+            if (int(mo), int(d)) != (2, 29):
+                return None  # regex-shaped but not a date — unprefixed
+    return None
+
+
+def _glog_seconds(line: str, year: int | None = None) -> float | None:
+    """Seconds since ``year``'s Jan 1 of a glog-prefixed line (year
+    defaults to the current one — prefer passing _log_year(path))."""
+    if year is None:
+        year = datetime.date.today().year
+    dt = _glog_datetime(line, year)
+    if dt is None:
+        return None
+    return (dt - datetime.datetime(dt.year, 1, 1)).total_seconds()
 
 
 def parse_log(path: str):
@@ -63,18 +107,24 @@ def parse_log(path: str):
     test: dict[tuple[int, int], dict[str, float]] = {}
     cur_iter = 0
     cur_test_net = 0
-    first_ts: float | None = None
+    year = _log_year(path)
+    first_dt: datetime.datetime | None = None
     cur_lr: float | None = None
     lr_by_iter: dict[int, float] = {}
     with open(path) as f:
         for line in f:
-            ts = _glog_seconds(line)
-            if ts is not None:
-                if first_ts is None:
-                    first_ts = ts
-                if ts < first_ts:  # new-year wrap within one log
-                    ts += 366 * 24 * 3600
-                ts -= first_ts
+            ts: float | None = None
+            dt = _glog_datetime(line, year)
+            if dt is not None:
+                if first_dt is None:
+                    first_dt = dt
+                if dt < first_dt:  # new-year wrap within one log
+                    try:
+                        dt = dt.replace(year=dt.year + 1)
+                    except ValueError:  # Feb 29 wrapped into a non-leap
+                        dt = (dt.replace(year=dt.year + 1, day=28)
+                              + datetime.timedelta(days=1))
+                ts = (dt - first_dt).total_seconds()
             m = _LR_RE.search(line)
             if m:
                 cur_lr = float(m.group(2))

@@ -39,8 +39,12 @@ import weakref
 import zipfile
 from typing import Any, Callable
 
+import time
+
 import jax
 import numpy as np
+
+from . import telemetry
 
 
 class CheckpointError(Exception):
@@ -210,10 +214,15 @@ class AsyncCheckpointWriter:
 
     # -- writer side ------------------------------------------------------
     def _run(self) -> None:
+        m_lat = telemetry.get_registry().histogram(
+            "ckpt_write_seconds", "durable checkpoint write latency")
+        m_depth = telemetry.get_registry().gauge(
+            "ckpt_queue_depth", "checkpoint jobs queued or in flight")
         while True:
             job = self._q.get()
             if job is _STOP:
                 return
+            t0 = time.perf_counter()
             try:
                 job()
             except BaseException as e:  # surfaced on next submit()/flush()
@@ -221,9 +230,13 @@ class AsyncCheckpointWriter:
                     if self._err is None:
                         self._err = e
             finally:
+                dur = time.perf_counter() - t0
+                m_lat.observe(dur)
+                telemetry.note_span("ckpt.write", dur, cat="ckpt")
                 with self._cond:
                     self._completed += 1
                     self._cond.notify_all()
+                m_depth.set(self.pending)
 
     # -- caller side ------------------------------------------------------
     @property
@@ -247,6 +260,9 @@ class AsyncCheckpointWriter:
         with self._cond:
             self._submitted += 1
         self._q.put(job)
+        telemetry.get_registry().gauge(
+            "ckpt_queue_depth", "checkpoint jobs queued or in flight"
+        ).set(self.pending)
 
     def flush(self, raise_errors: bool = True) -> None:
         """Wait until every submitted job has completed (the durability

@@ -45,10 +45,19 @@ class SignalGuard:
         for sig, action in self._actions.items():
             if action == SolverAction.NONE:
                 continue
-            self._previous[sig] = signal.signal(
-                sig, lambda signum, frame: self._pending.append(
-                    self._actions[signum]))
+            self._previous[sig] = signal.signal(sig, self._on_signal)
         return self
+
+    def _on_signal(self, signum, frame) -> None:
+        self._pending.append(self._actions[signum])
+        if signum == signal.SIGTERM:
+            # the preemption notice is a flight-recorder moment: dump
+            # the recent-event ring NOW — if the grace window is blown
+            # and the kill lands, the black box is already on disk
+            from . import telemetry
+            rec = telemetry.get_recorder()
+            rec.record("sigterm", action=self._actions[signum])
+            rec.dump("sigterm")
 
     def __exit__(self, *exc) -> None:
         for sig, prev in self._previous.items():

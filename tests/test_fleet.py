@@ -305,6 +305,10 @@ def test_quarantine_writes_postmortem_and_reoffers_gang(tmp_path):
     f.settle(lambda: bad.state == QUARANTINED and good.state == RUNNING)
     post = json.load(open(os.path.join(bad.job_dir, "postmortem.json")))
     assert post["rc"] == 7 and post["job"] == "bad"
+    # the telemetry flight-recorder tail rides along: the scheduling
+    # decisions that led to the quarantine, embedded for the reader
+    kinds = [e["kind"] for e in post["flight_recorder"]]
+    assert "fleet_quarantine" in kinds and "fleet_launch" in kinds
     f.release("good")
     f.settle(lambda: f.sched.done())
     assert f.sched.run(tick_s=0.01) == 3   # quarantine -> nonzero fleet rc

@@ -602,6 +602,56 @@ def test_parse_log_resume_and_inf(tmp_path):
     assert test[(304, 1)]["loss"] == 1e30
 
 
+def test_parse_log_non_leap_feb28_mar1_span(tmp_path):
+    """Regression (ADVICE.md): _glog_seconds used a FIXED leap year
+    (2024) for day-of-year, so a non-leap-year log spanning
+    Feb 28 → Mar 1 gained a phantom Feb 29: +86400 s.  The year now
+    comes from the log's mtime and deltas from full datetimes."""
+    import calendar
+    import datetime
+    import os as _os
+
+    from sparknet_tpu.tools.parse_log import parse_log
+
+    log = tmp_path / "wrap.log"
+    log.write_text(
+        "I0228 23:59:50.000000  1 solver.py:1] Iteration 0, loss = 1.0\n"
+        "I0301 00:00:10.000000  1 solver.py:1] Iteration 2, loss = 0.9\n")
+    # pin the file into a non-leap year (the log "was written" then)
+    mt = datetime.datetime(2025, 3, 1, 1, 0, 0).timestamp()
+    _os.utime(log, (mt, mt))
+    train, _ = parse_log(str(log))
+    deltas = [row.seconds for row in train]
+    assert deltas == [0.0, 20.0]   # was 86420.0 with the 2024 anchor
+
+    # a leap-year log keeps its real Feb 29: same stamps, 2024 mtime
+    mt = datetime.datetime(2024, 3, 1, 1, 0, 0).timestamp()
+    _os.utime(log, (mt, mt))
+    train, _ = parse_log(str(log))
+    assert [row.seconds for row in train] == [0.0, 86420.0]
+
+    # Feb 29 stamps in a log whose mtime landed in a later, non-leap
+    # year (copied file) walk back to the nearest leap year, not crash
+    leap = tmp_path / "leap.log"
+    leap.write_text(
+        "I0229 10:00:00.000000  1 solver.py:1] Iteration 0, loss = 1.0\n"
+        "I0301 10:00:00.000000  1 solver.py:1] Iteration 2, loss = 0.9\n")
+    _os.utime(leap, (mt + 370 * 86400, mt + 370 * 86400))  # 2025 mtime
+    train, _ = parse_log(str(leap))
+    assert [row.seconds for row in train] == [0.0, 86400.0]
+
+    # new-year wrap: Dec 31 → Jan 1 is one day, leap or not
+    wrap = tmp_path / "newyear.log"
+    wrap.write_text(
+        "I1231 23:59:00.000000  1 solver.py:1] Iteration 0, loss = 1.0\n"
+        "I0101 00:01:00.000000  1 solver.py:1] Iteration 2, loss = 0.9\n")
+    mt = datetime.datetime(2026, 1, 1, 2, 0, 0).timestamp()
+    _os.utime(wrap, (mt, mt))
+    train, _ = parse_log(str(wrap))
+    assert [row.seconds for row in train] == [0.0, 120.0]
+    assert not calendar.isleap(2025) and not calendar.isleap(2026)
+
+
 def test_plot_training_log(tmp_path):
     """plot_training_log (tools/extra analog): charts parse_log output;
     unsupported Seconds/lr chart types refuse clearly."""

@@ -18,6 +18,14 @@ Usage:
   python tools/fleet.py --devices 8 --workdir /tmp/fleet \
       --jobs jobs.json --quota acme=4 --status-every 5
   python tools/fleet.py --workdir /tmp/fleet --resume     # after a kill
+  python tools/fleet.py status --workdir /tmp/fleet          # offline view
+  python tools/fleet.py status --workdir /tmp/fleet --json   # one JSON doc
+
+``status`` (or ``--status``) reads the journal + heartbeats + the
+telemetry registry snapshots the workers wrote — no scheduler process
+needed, nothing is launched or signalled.  ``--json`` emits the same
+data as one machine-readable JSON document so external scrapers never
+parse the human table.
 
 Exit code 0 when every job completed; 3 when any was quarantined (each
 leaves a ``postmortem.json`` in its job dir).
@@ -61,10 +69,20 @@ def parse_quotas(pairs):
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "status":   # subcommand spelling of --status
+        argv = ["--status"] + argv[1:]
     ap = argparse.ArgumentParser(
         description="multi-tenant training fleet scheduler")
     ap.add_argument("--workdir", required=True,
                     help="fleet state dir (journal, per-job artifacts)")
+    ap.add_argument("--status", action="store_true",
+                    help="print the fleet status reconstructed from the "
+                         "journal (+ heartbeats + metrics snapshots) and "
+                         "exit — works on a live OR dead fleet")
+    ap.add_argument("--json", action="store_true",
+                    help="with --status: emit one machine-readable JSON "
+                         "document instead of the table")
     ap.add_argument("--jobs", default=None,
                     help="JSON list of job specs (required unless "
                          "--resume)")
@@ -97,8 +115,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from sparknet_tpu.parallel.fleet import (
-        FleetScheduler, format_status,
+        FleetScheduler, format_status, offline_status,
     )
+
+    if args.status:
+        st = offline_status(args.workdir)
+        if args.json:
+            print(json.dumps(st, indent=1))
+        else:
+            print(format_status(st))
+        return 0
 
     if args.resume:
         fleet = FleetScheduler.resume(

@@ -81,6 +81,20 @@ maybe_servesmoke() {
   fi
 }
 
+# ~10-second observability smoke (tools/obs.py smoke) — opt-in via
+# SPARKNET_OBSSMOKE=1.  Runs a 2-round training per rank (two driver
+# runs sharing one SPARKNET_RUN_ID) plus a live tools/serve.py driven
+# over HTTP, all with tracing on; fails the gate unless
+# `tools/obs.py merge --check` yields a valid merged trace (spans from
+# both ranks, correlation IDs on every span, aligned monotonic
+# timestamps) and `GET /metrics` parses as Prometheus text.
+maybe_obssmoke() {
+  if [ "${SPARKNET_OBSSMOKE:-}" = "1" ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python tools/obs.py smoke --out /tmp/_obssmoke.json > /dev/null
+  fi
+}
+
 # ~10-second sync-vs-async outer-loop parity smoke (tools/roundbench.py)
 # — opt-in via SPARKNET_ROUNDBENCH=1.  Fails the gate unless the
 # pipelined loop (harvest_lag + AsyncCheckpointWriter) reproduces the
@@ -101,10 +115,12 @@ case "${1:-}" in
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
   --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
+  --obssmoke) SPARKNET_OBSSMOKE=1 maybe_obssmoke ;;
   --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
-             && maybe_feedbench && maybe_servesmoke && maybe_roundbench ;;
+             && maybe_feedbench && maybe_servesmoke && maybe_roundbench \
+             && maybe_obssmoke ;;
   "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
-             && maybe_servesmoke && maybe_roundbench ;;
-  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--all]" >&2
+             && maybe_servesmoke && maybe_roundbench && maybe_obssmoke ;;
+  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--obssmoke|--all]" >&2
      exit 2 ;;
 esac

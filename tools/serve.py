@@ -18,6 +18,10 @@ Endpoints:
                          429 on admission rejection (typed reason),
                          404 unknown model, 503 engine dead.
   GET  /healthz          engine liveness + stats (503 when dead).
+  GET  /metrics          Prometheus text exposition of the telemetry
+                         registry (queue depth, p50/p99, rejections,
+                         request/infer latency histograms; see
+                         sparknet_tpu/utils/telemetry.py).
   GET  /v1/models        loaded models with shapes/classes/bytes.
   POST /v1/models/load   {"name": m, "weights": path?} — hot-load.
   POST /v1/models/evict  {"name": m}.
@@ -93,6 +97,16 @@ def make_handler(engine, house):
             if self.path == "/healthz":
                 st = engine.stats()
                 self._send(200 if st["alive"] else 503, st)
+            elif self.path == "/metrics":
+                from sparknet_tpu.utils import telemetry
+                body = telemetry.get_registry().render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path == "/v1/models":
                 self._send(200, {"models": house.loaded()})
             else:

@@ -65,10 +65,19 @@ def _schedules(rng):
     ]
 
 
+# telemetry env survives the scrub so a traced soak (SPARKNET_TRACE_DIR
+# set, then `tools/obs.py merge` over the dir) yields the one-timeline
+# chaos story: fault injection, restarts, rollbacks, recovered rounds,
+# correlated across every rank and attempt
+_KEEP_ENV = ("SPARKNET_SOAK", "SPARKNET_TELEMETRY", "SPARKNET_TRACE_DIR",
+             "SPARKNET_METRICS_SNAP", "SPARKNET_METRICS_SNAP_S",
+             "SPARKNET_RUN_ID", "SPARKNET_FLIGHT_EVENTS")
+
+
 def _clean_env():
     os.environ.pop("XLA_FLAGS", None)
     for k in list(os.environ):
-        if k.startswith("SPARKNET_") and k != "SPARKNET_SOAK":
+        if k.startswith("SPARKNET_") and k not in _KEEP_ENV:
             os.environ.pop(k)
 
 
