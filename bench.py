@@ -500,6 +500,14 @@ def run_child() -> None:
         except Exception as e:  # this tier must not sink the bench either
             _log(f"serving measurement failed: {e}")
             serving = {"error": str(e)}
+    # provenance: git sha + config fingerprint + the telemetry plane's
+    # correlation IDs, so every capture joins the perf ledger
+    # (tools/perfwatch.py) without filename archaeology — the reason
+    # BENCH_r01..r05 could never be joined into a trajectory
+    from sparknet_tpu.utils import perfledger
+    fp = perfledger.fingerprint(
+        model=MODEL, dtype=best, batch=BATCH, world=1,
+        device=f"{dev.platform}/{dev.device_kind}", backend=dev.platform)
     result = {
         "metric": f"{MODEL}_train_images_per_sec",
         "value": b["images_per_sec"],
@@ -525,6 +533,7 @@ def run_child() -> None:
         "feed_in_loop": feed,
         "round_overhead": round_overhead,
         "serving": serving,
+        "provenance": perfledger.provenance(fp),
     }
     print(json.dumps(result), flush=True)
 

@@ -1097,6 +1097,11 @@ def format_status(status: Mapping[str, Any]) -> str:
                        f"+{extras.get('in_flight', 0)} "
                        f"p50 {extras.get('p50_ms', 0):.0f}ms "
                        f"p99 {extras.get('p99_ms', 0):.0f}ms")
+                slo = extras.get("slo") or {}
+                if slo.get("state") == "breach":
+                    hb += f" SLO:BREACH({slo.get('breaches', 0)})"
+                elif slo.get("state"):
+                    hb += " SLO:ok"
             break   # first rank is enough for the one-liner
         note = j.get("metrics_note")
         if note:

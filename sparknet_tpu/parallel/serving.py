@@ -45,12 +45,29 @@ a typed :class:`EngineDead` on every waiter and every later submit —
 never a hang; a per-batch model failure fails THAT batch's requests and
 leaves the engine serving.
 
+- **SLO monitor** — :class:`SLOMonitor` evaluates declared SLOs (a p99
+  latency bound and a rejection-rate budget) burn-rate-style over
+  periodic snapshots of the engine's PR-8 metrics: a breach requires
+  the error budget to burn in BOTH a fast and a slow window (the
+  multi-window pattern — a one-second blip never pages, a sustained
+  overload does).  Breaching windows are dumped through the existing
+  telemetry FlightRecorder; ``tools/serve.py`` surfaces the verdict at
+  ``GET /slo`` and the health beacons carry it into
+  ``tools/fleet.py status``.
+
 Env knobs (defaults in :class:`ServeConfig`):
   SPARKNET_SERVE_MAX_DELAY_MS — coalesce deadline (default 5 ms).
   SPARKNET_SERVE_SHAPES       — compiled batch shapes (default 1,4,16,64).
   SPARKNET_SERVE_QUEUE        — admission bound on queued requests (256).
   SPARKNET_SERVE_HBM_MB       — model-house HBM budget (2048 MB).
   SPARKNET_SERVE_DTYPE        — compute dtype, bf16 (default) or f32.
+  SPARKNET_SLO_P99_MS         — declared p99 bound (default: latency SLO
+                                undeclared).
+  SPARKNET_SLO_REJECT_BUDGET  — rejection+failure budget as a fraction
+                                of offered requests (default 0.02).
+  SPARKNET_SLO_WINDOW_S       — slow burn window (default 60 s; the
+                                fast window is SPARKNET_SLO_FAST_S,
+                                default 5 s).
 """
 
 from __future__ import annotations
@@ -153,6 +170,23 @@ class ServeConfig:
     tenant_qps: Mapping[str, float] = dataclasses.field(default_factory=dict)
     beat_every_s: float = 1.0
     seed: int = 0
+    # declared SLOs (see SLOMonitor): a p99 bound (None = latency SLO
+    # undeclared) and a rejection-rate error budget, evaluated over a
+    # fast + slow burn window pair
+    slo_p99_ms: float | None = dataclasses.field(
+        default_factory=lambda: (
+            _env_float("SPARKNET_SLO_P99_MS", 0.0) or None))
+    slo_reject_budget: float = dataclasses.field(
+        default_factory=lambda: _env_float("SPARKNET_SLO_REJECT_BUDGET",
+                                           0.02))
+    slo_window_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("SPARKNET_SLO_WINDOW_S", 60.0))
+    slo_fast_window_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("SPARKNET_SLO_FAST_S", 5.0))
+    slo_burn_fast: float = 4.0    # fast-window burn-rate trip point
+    slo_burn_slow: float = 1.0    # slow-window burn-rate trip point
+    slo_min_requests: int = 20    # don't page on a handful of requests
+    slo_sample_every_s: float = 0.5
 
     def __post_init__(self):
         shapes = tuple(sorted(set(int(s) for s in self.batch_shapes)))
@@ -172,6 +206,17 @@ class ServeConfig:
         for t, q in dict(self.tenant_qps).items():
             if q <= 0:
                 raise ValueError(f"tenant {t!r}: qps cap must be > 0")
+        if self.slo_p99_ms is not None and self.slo_p99_ms <= 0:
+            raise ValueError(f"slo_p99_ms must be > 0, "
+                             f"got {self.slo_p99_ms}")
+        if not 0.0 < self.slo_reject_budget <= 1.0:
+            raise ValueError(f"slo_reject_budget must be in (0, 1], "
+                             f"got {self.slo_reject_budget}")
+        if self.slo_fast_window_s <= 0 or (self.slo_window_s
+                                           < self.slo_fast_window_s):
+            raise ValueError(
+                f"SLO windows need 0 < fast ({self.slo_fast_window_s}) "
+                f"<= slow ({self.slo_window_s})")
 
 
 # ---------------------------------------------------------------------------
@@ -505,6 +550,200 @@ class ServeFuture:
 
 
 # ---------------------------------------------------------------------------
+# SLO monitor — declared objectives evaluated burn-rate-style
+# ---------------------------------------------------------------------------
+
+class SLOMonitor:
+    """Evaluate declared serving SLOs over periodic snapshots of the
+    engine's telemetry counters (the PR-8 metrics: completed / rejected
+    / failed totals + trailing p99).
+
+    Two objectives:
+
+    - **availability**: rejections + failures may consume at most
+      ``reject_budget`` of offered requests.  Evaluated as a burn rate
+      (observed bad-fraction / budget) over a fast AND a slow window —
+      the multi-window pattern: the fast window (default 5 s) must burn
+      at ``burn_fast``× (default 4×) and the slow window (default 60 s)
+      at ``burn_slow``× before a breach is declared, so a one-batch
+      blip never pages but a sustained overload does within seconds.
+    - **latency**: the windowed p99 (max of sampled trailing p99s) must
+      stay under the declared ``p99_ms`` bound in both windows.  The
+      bound is ``None`` by default — an undeclared latency SLO is
+      honestly not evaluated, never silently passed.
+
+    ``p99_ms`` is runtime-declarable (``monitor.p99_ms = bound``) so a
+    load harness can pin the bound it just measured.  On a healthy →
+    breach transition the breaching windows are dumped through the
+    telemetry FlightRecorder (the crash black box picks up SLO context
+    even when nothing crashes); the transition back is recorded too.
+
+    Deliberately engine-agnostic: ``stats_fn`` is any callable
+    returning ``{"completed": int, "rejected": {reason: int},
+    "failed": int, "p99_ms": float}`` — the tests drive it with a
+    scripted fake and a fake clock."""
+
+    def __init__(self, stats_fn: Callable[[], Mapping[str, Any]],
+                 cfg: ServeConfig | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or ServeConfig()
+        self.stats_fn = stats_fn
+        self.p99_ms = self.cfg.slo_p99_ms
+        self._clock = clock
+        keep = int(max(self.cfg.slo_window_s
+                       / max(self.cfg.slo_sample_every_s, 0.05) * 2, 16))
+        self._samples: deque[dict] = deque(maxlen=keep)
+        self._lock = threading.Lock()
+        self.state = "ok"
+        self.breaches = 0
+        self.dumps = 0
+        self._since: float | None = None
+        reg = telemetry.get_registry()
+        self._m_breach = reg.counter(
+            "slo_breach_total", "SLO breach transitions by kind")
+        self._m_ok = reg.gauge(
+            "slo_healthy", "1 while every declared SLO holds")
+        self._m_ok.set(1.0)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- sampling ---------------------------------------------------------
+    def start(self) -> None:
+        """Run the background sampler (idempotent)."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="slo-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.cfg.slo_sample_every_s + 5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.slo_sample_every_s):
+            try:
+                self.evaluate()
+            except Exception:
+                pass   # a broken scrape must not kill the sampler
+
+    def _snapshot(self) -> dict:
+        st = self.stats_fn()
+        rejected = st.get("rejected") or {}
+        if isinstance(rejected, Mapping):
+            rejected = sum(rejected.values())
+        return {"t": self._clock(),
+                "completed": int(st.get("completed", 0)),
+                "rejected": int(rejected),
+                "failed": int(st.get("failed", 0)),
+                "p99_ms": float(st.get("p99_ms", 0.0) or 0.0)}
+
+    def _window(self, samples: list[dict], seconds: float) -> dict:
+        newest = samples[-1]
+        cutoff = newest["t"] - seconds
+        oldest = samples[0]
+        for s in samples:
+            if s["t"] >= cutoff:
+                oldest = s
+                break
+        d_done = newest["completed"] - oldest["completed"]
+        d_rej = newest["rejected"] - oldest["rejected"]
+        d_fail = newest["failed"] - oldest["failed"]
+        total = max(d_done + d_rej + d_fail, 0)
+        bad = max(d_rej + d_fail, 0)
+        frac = bad / total if total else 0.0
+        p99 = max((s["p99_ms"] for s in samples if s["t"] >= cutoff),
+                  default=0.0)
+        return {"seconds": round(newest["t"] - oldest["t"], 2),
+                "requests": total, "bad": bad,
+                "bad_frac": round(frac, 4),
+                "burn": round(frac / self.cfg.slo_reject_budget, 2),
+                "p99_ms": round(p99, 3)}
+
+    # -- evaluation -------------------------------------------------------
+    def evaluate(self) -> dict[str, Any]:
+        """Take a fresh snapshot, evaluate both windows, handle state
+        transitions (recorder events + flight dump on breach).  The
+        returned doc is the ``GET /slo`` body."""
+        snap = self._snapshot()
+        with self._lock:
+            self._samples.append(snap)
+            samples = list(self._samples)
+        fast = self._window(samples, self.cfg.slo_fast_window_s)
+        slow = self._window(samples, self.cfg.slo_window_s)
+        breaches: list[str] = []
+        if (fast["requests"] >= self.cfg.slo_min_requests
+                and fast["burn"] >= self.cfg.slo_burn_fast
+                and slow["burn"] >= self.cfg.slo_burn_slow):
+            breaches.append("availability")
+        if (self.p99_ms is not None and fast["requests"] > 0
+                and fast["p99_ms"] > self.p99_ms
+                and slow["p99_ms"] > self.p99_ms):
+            breaches.append("latency")
+        new_state = "breach" if breaches else "ok"
+        dump_doc = None
+        with self._lock:
+            old_state = self.state
+            self.state = new_state
+            if new_state == "breach" and old_state == "ok":
+                self.breaches += 1
+                self._since = snap["t"]
+            elif new_state == "ok":
+                self._since = None
+            since = self._since
+        if new_state == "breach" and old_state == "ok":
+            for kind in breaches:
+                self._m_breach.inc(kind=kind)
+            rec = telemetry.get_recorder()
+            rec.record("slo_breach", kinds=breaches, fast=fast,
+                       slow=slow, p99_bound_ms=self.p99_ms,
+                       reject_budget=self.cfg.slo_reject_budget)
+            dump_doc = rec.dump("slo_" + "_".join(breaches))
+            with self._lock:
+                self.dumps += 1
+        elif new_state == "ok" and old_state == "breach":
+            telemetry.get_recorder().record(
+                "slo_recovered", fast=fast, slow=slow)
+        self._m_ok.set(0.0 if breaches else 1.0)
+        return {
+            "state": new_state,
+            "breaches": breaches,
+            "declared": {
+                "p99_ms": self.p99_ms,
+                "reject_budget": self.cfg.slo_reject_budget,
+                "window_s": self.cfg.slo_window_s,
+                "fast_window_s": self.cfg.slo_fast_window_s,
+                "burn_fast": self.cfg.slo_burn_fast,
+                "burn_slow": self.cfg.slo_burn_slow,
+            },
+            "windows": {"fast": fast, "slow": slow},
+            "breach_count": self.breaches,
+            "flight_dumps": self.dumps,
+            "breach_since_s": (round(snap["t"] - since, 1)
+                               if since is not None else None),
+        }
+
+    def reset(self) -> None:
+        """Forget windowed history (a deployment/measurement fence):
+        the next evaluation starts from fresh windows.  Load harnesses
+        use it to keep a deliberate saturation probe — whose engine-
+        level rejections are real but intentional — from burning the
+        budget of the leg that follows.  Cumulative counters are
+        untouched; only the window samples and breach state clear."""
+        with self._lock:
+            self._samples.clear()
+            self.state = "ok"
+            self._since = None
+        self._m_ok.set(1.0)
+
+    def summary(self) -> dict[str, Any]:
+        """The cheap, lock-light view the health beacons carry."""
+        with self._lock:
+            return {"state": self.state, "breaches": self.breaches}
+
+
+# ---------------------------------------------------------------------------
 # The engine
 # ---------------------------------------------------------------------------
 
@@ -567,6 +806,10 @@ class InferenceEngine:
         self._m_rej = reg.counter(
             "serve_rejected_total", "admission rejections by reason")
         reg.add_collector(self._publish_gauges)
+        # SLO monitor: burn-rate evaluation over snapshots of the
+        # counters above; its sampler rides a small daemon thread
+        self.slo = SLOMonitor(self.stats, self.cfg, clock=clock)
+        self.slo.start()
         self._harvest_q: "_queue.Queue[Any]" = _queue.Queue(
             maxsize=self.cfg.inflight_batches)
         self._harvester = threading.Thread(
@@ -890,6 +1133,7 @@ class InferenceEngine:
                 "max_queue": self.cfg.max_queue,
             }
         out["models"] = self.models.loaded()
+        out["slo"] = self.slo.summary()
         return out
 
     # -- liveness beacons (PR-2 health plane) -----------------------------
@@ -904,6 +1148,7 @@ class InferenceEngine:
                 "rejected": dict(self.rejected),
                 **self._percentiles(self._lat_ms),
                 "models": sorted(self.models.loaded()),
+                "slo": self.slo.summary(),
             }
 
     def _beat_loop(self) -> None:
@@ -945,6 +1190,7 @@ class InferenceEngine:
             r.event.set()
         self._dispatcher.join(timeout=10.0)
         self._harvester.join(timeout=10.0)
+        self.slo.stop()
         if self._beacon is not None:
             self._beacon.join(timeout=self.cfg.beat_every_s + 5.0)
 

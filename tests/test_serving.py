@@ -612,6 +612,13 @@ def test_serve_http_end_to_end(tmp_path):
         # healthz + hot-load/evict round trip
         hz = http_json(f"{url}/healthz")
         assert hz["alive"] and hz["completed"] >= 1
+        assert hz["slo"]["state"] == "ok"
+        # GET /slo: healthy under this trickle (the handful of tenant-cap
+        # rejections above sits below slo_min_requests — no page)
+        slo = http_json(f"{url}/slo")
+        assert slo["state"] == "ok" and slo["breaches"] == []
+        assert slo["declared"]["reject_budget"] == 0.02
+        assert slo["windows"]["fast"]["requests"] >= 0
         assert http_json(f"{url}/v1/models/load",
                          {"name": "cifar10_quick"})["loaded"]["name"] \
             == "cifar10_quick"

@@ -108,6 +108,20 @@ maybe_roundbench() {
   fi
 }
 
+# ~10-second performance gate (tools/perfwatch.py perfgate) — opt-in
+# via SPARKNET_PERFGATE=1.  Runs a ~2s-leg CPU bench smoke through the
+# regression sentinel against the committed perf/LEDGER.jsonl (CPU
+# fingerprints never gate against the TPU history — wide CPU bands via
+# --min-band-pct for rigs that HAVE CPU history), then a sentinel
+# self-test: a planted slow feed leg (BENCH_FEED_DELAY_S) must exit
+# non-zero with stage attribution naming the decode stage.
+maybe_perfgate() {
+  if [ "${SPARKNET_PERFGATE:-}" = "1" ]; then
+    timeout -k 10 480 env JAX_PLATFORMS=cpu \
+      python tools/perfwatch.py perfgate --json /tmp/_perfgate.json
+  fi
+}
+
 case "${1:-}" in
   --chaos) run_chaos ;;
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
@@ -116,11 +130,13 @@ case "${1:-}" in
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
   --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
   --obssmoke) SPARKNET_OBSSMOKE=1 maybe_obssmoke ;;
+  --perfgate) SPARKNET_PERFGATE=1 maybe_perfgate ;;
   --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
              && maybe_feedbench && maybe_servesmoke && maybe_roundbench \
-             && maybe_obssmoke ;;
+             && maybe_obssmoke && maybe_perfgate ;;
   "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
-             && maybe_servesmoke && maybe_roundbench && maybe_obssmoke ;;
-  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--obssmoke|--all]" >&2
+             && maybe_servesmoke && maybe_roundbench && maybe_obssmoke \
+             && maybe_perfgate ;;
+  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--obssmoke|--perfgate|--all]" >&2
      exit 2 ;;
 esac

@@ -18,6 +18,12 @@ Endpoints:
                          429 on admission rejection (typed reason),
                          404 unknown model, 503 engine dead.
   GET  /healthz          engine liveness + stats (503 when dead).
+  GET  /slo              declared-SLO verdict (p99 bound + rejection
+                         budget evaluated burn-rate-style over fast and
+                         slow windows; see serving.SLOMonitor) —
+                         200 while healthy, 503 on breach (breaching
+                         windows are dumped through the telemetry
+                         FlightRecorder).
   GET  /metrics          Prometheus text exposition of the telemetry
                          registry (queue depth, p50/p99, rejections,
                          request/infer latency histograms; see
@@ -97,6 +103,9 @@ def make_handler(engine, house):
             if self.path == "/healthz":
                 st = engine.stats()
                 self._send(200 if st["alive"] else 503, st)
+            elif self.path == "/slo":
+                st = engine.slo.evaluate()
+                self._send(200 if st["state"] == "ok" else 503, st)
             elif self.path == "/metrics":
                 from sparknet_tpu.utils import telemetry
                 body = telemetry.get_registry().render().encode()
@@ -204,6 +213,16 @@ def main(argv=None) -> int:
                     metavar="TENANT=QPS",
                     help="per-tenant QPS cap (repeatable; '*' caps "
                          "tenants without an explicit entry)")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="declared p99 latency bound for GET /slo "
+                         "(default SPARKNET_SLO_P99_MS; unset = latency "
+                         "SLO undeclared)")
+    ap.add_argument("--slo-reject-budget", type=float, default=None,
+                    help="rejection-rate error budget as a fraction "
+                         "(default SPARKNET_SLO_REJECT_BUDGET, 0.02)")
+    ap.add_argument("--slo-window-s", type=float, default=None,
+                    help="slow burn window seconds "
+                         "(default SPARKNET_SLO_WINDOW_S, 60)")
     args = ap.parse_args(argv)
 
     from sparknet_tpu.parallel.serving import (
@@ -221,7 +240,14 @@ def main(argv=None) -> int:
         hbm_budget_mb=(args.hbm_budget_mb if args.hbm_budget_mb is not None
                        else base.hbm_budget_mb),
         dtype=args.dtype or base.dtype,
-        tenant_qps=parse_quotas(args.quota))
+        tenant_qps=parse_quotas(args.quota),
+        slo_p99_ms=(args.slo_p99_ms if args.slo_p99_ms is not None
+                    else base.slo_p99_ms),
+        slo_reject_budget=(args.slo_reject_budget
+                           if args.slo_reject_budget is not None
+                           else base.slo_reject_budget),
+        slo_window_s=(args.slo_window_s if args.slo_window_s is not None
+                      else base.slo_window_s))
 
     house = ModelHouse(cfg)
     for name, weights in parse_models(args.models):

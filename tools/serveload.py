@@ -174,6 +174,21 @@ def run_report(model: str = "lenet", weights: str | None = None,
     report["saturation"] = sat
     sat_qps = max(sat["achieved_qps"], 1.0)
 
+    # the p99 bound: queue drain time at measured throughput (doubled
+    # for slack) + deadline + 5x the saturation p99 — crossing it means
+    # the queue is NOT bounding latency, i.e. admission control failed.
+    # Declared as the engine's latency SLO so GET /slo and the per-leg
+    # slo_* verdicts below judge against the bound this very run
+    # measured.
+    p99_bound_ms = (2000.0 * cfg.max_queue / sat_qps
+                    + 5.0 * max(sat["p99_ms"], 1.0) + cfg.max_delay_ms)
+    report["p99_bound_ms"] = round(p99_bound_ms, 1)
+    if engine is not None:
+        engine.slo.p99_ms = p99_bound_ms
+        # fence off the saturation probe: its engine-level rejections
+        # are the probe working as intended, not paced-leg budget spend
+        engine.slo.reset()
+
     # paced sweep with the exactness audit at every point (claim (c))
     sweep = []
     for frac in fractions:
@@ -189,6 +204,14 @@ def run_report(model: str = "lenet", weights: str | None = None,
              f"mismatches {point['exact_mismatches']}")
         sweep.append(point)
     report["sweep"] = sweep
+    if engine is not None:
+        # SLO verdict over the paced traffic (before overload): must be
+        # healthy — paced legs stay inside both the rejection budget
+        # and the declared p99 bound
+        report["slo_paced"] = engine.slo.evaluate()
+        _log(f"slo after paced sweep: {report['slo_paced']['state']} "
+             f"(burn fast "
+             f"{report['slo_paced']['windows']['fast']['burn']}x)")
 
     # overload leg (claim (b)): 2x saturation through the bounded queue.
     # Client concurrency must exceed the admission bound or the closed
@@ -202,15 +225,28 @@ def run_report(model: str = "lenet", weights: str | None = None,
                            refs=refs, submit=submit)
     over["fraction_of_saturation"] = overload_x
     report["overload"] = over
-    # the bound: queue drain time at measured throughput (doubled for
-    # slack) + deadline + 5x the saturation p99 — crossing it means the
-    # queue is NOT bounding latency, i.e. admission control failed
-    p99_bound_ms = (2000.0 * cfg.max_queue / sat_qps
-                    + 5.0 * max(sat["p99_ms"], 1.0) + cfg.max_delay_ms)
-    report["p99_bound_ms"] = round(p99_bound_ms, 1)
     _log(f"overload {overload_x}x: achieved {over['achieved_qps']} "
          f"p99 {over['p99_ms']} (bound {p99_bound_ms:.0f}) "
          f"rejected {over['rejected']}")
+    if engine is not None:
+        # SLO verdict under overload: the rejection budget burns (the
+        # typed rejections ARE the error budget spend), so this leg
+        # must breach — with a flight-recorder dump capturing the
+        # breaching windows
+        report["slo_overload"] = engine.slo.evaluate()
+        _log(f"slo under overload: {report['slo_overload']['state']} "
+             f"(burn fast "
+             f"{report['slo_overload']['windows']['fast']['burn']}x, "
+             f"dumps {report['slo_overload']['flight_dumps']})")
+
+    if not url:
+        import jax
+        d = jax.devices()[0]
+        report["device"] = f"{d.platform}/{d.device_kind}"
+    from sparknet_tpu.utils import perfledger
+    report["provenance"] = perfledger.provenance(perfledger.fingerprint(
+        model=model, dtype=cfg.dtype, batch=max(cfg.batch_shapes),
+        world=1, device=report.get("device")))
 
     mismatches = sum(p["exact_mismatches"] or 0 for p in sweep)
     mismatches += sat["exact_mismatches"] or 0
@@ -231,6 +267,13 @@ def run_report(model: str = "lenet", weights: str | None = None,
         # (c) bit-identical to solo runs at every swept QPS
         "exact_mismatches": None if refs is None else mismatches,
         "bit_identical": None if refs is None else mismatches == 0,
+        # SLO monitor verdicts (in-process only): paced traffic healthy,
+        # overload a declared breach with a flight dump
+        "slo_paced_healthy": (report.get("slo_paced", {}).get("state")
+                              == "ok" if engine is not None else None),
+        "slo_overload_breached": (
+            report.get("slo_overload", {}).get("state") == "breach"
+            if engine is not None else None),
     }
     if engine is not None:
         report["engine_stats"] = engine.stats()
@@ -275,7 +318,10 @@ def main(argv=None) -> int:
         args.window = min(args.window, 16)
         args.queue = args.queue or 32   # overload must trip the bound
         shapes = (1, 4, 8)
-        fractions = (1.0,)
+        # paced below saturation: pacing AT capacity on the smoke's
+        # tiny queue rejects legitimately, which would make the
+        # "paced traffic holds its SLO" assert vacuous
+        fractions = (0.5,)
     else:
         shapes = (tuple(int(s) for s in args.shapes.split(","))
                   if args.shapes else None)
@@ -306,6 +352,12 @@ def main(argv=None) -> int:
         if not v["overload_rejected"]:
             bad.append("overload produced zero rejections (admission "
                        "control never engaged)")
+        if v["slo_paced_healthy"] is False:
+            bad.append("SLO monitor reported a breach under paced "
+                       "traffic")
+        if v["slo_overload_breached"] is False:
+            bad.append("SLO monitor failed to declare a breach under "
+                       "2x overload")
         if bad:
             _log("SMOKE FAIL: " + "; ".join(bad))
             return 1
