@@ -37,7 +37,9 @@ host stalls with ckpt+guard+audit on, sync vs async — see
 measure_round_overhead): BENCH_ROUND=0 to skip, BENCH_ROUND_N/_TAU/
 _LAG/_BATCH/_EVERY; serving tier (closed-loop latency/QPS through the
 inference engine — see measure_serving): BENCH_SERVING=0 to skip,
-BENCH_SERVE_MODEL/_CLIENTS/_WINDOW/_SECONDS.
+BENCH_SERVE_MODEL/_CLIENTS/_WINDOW/_SECONDS; vertical fusion:
+BENCH_FUSE=off|auto|all|<plan.json> pins SPARKNET_FUSE for the child
+(graph/fusion.py; captures carry the resulting fuse_plan id).
 """
 
 from __future__ import annotations
@@ -86,6 +88,12 @@ def _log(msg: str) -> None:
 def run_child() -> None:
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(os.path.dirname(__file__), ".jax_cache"))
+    # BENCH_FUSE pins the vertical-fusion plan source for every net this
+    # child builds (off | auto | all | <plan.json> — graph/fusion.py);
+    # unset inherits the ambient SPARKNET_FUSE (default auto).  Must land
+    # before the first Net construction: the plan latches there.
+    if os.environ.get("BENCH_FUSE"):
+        os.environ["SPARKNET_FUSE"] = os.environ["BENCH_FUSE"]
     import jax
 
     if os.environ.get("BENCH_PLATFORM"):
@@ -106,6 +114,7 @@ def run_child() -> None:
         BENCH_SOLVER_PROTOTXT,
         build_bench_model,
         peak_flops,
+        record_fusion_plan,
         scanned_train_block,
         step_cost_flops,
     )
@@ -200,6 +209,9 @@ def run_child() -> None:
             "eval_images_per_sec": round(eval_img_s, 1),
             "mfu": round(mfu, 4) if mfu is not None else None,
             "flops_per_step": flops_per_step,
+            # the train net's vertical-fusion plan id — the ledger
+            # fingerprint field keeping fused/unfused bands separate
+            "fuse_plan": record_fusion_plan(solver.train_net),
         }
 
     def measure_feed(dtype: str) -> dict:
@@ -507,7 +519,8 @@ def run_child() -> None:
     from sparknet_tpu.utils import perfledger
     fp = perfledger.fingerprint(
         model=MODEL, dtype=best, batch=BATCH, world=1,
-        device=f"{dev.platform}/{dev.device_kind}", backend=dev.platform)
+        device=f"{dev.platform}/{dev.device_kind}", backend=dev.platform,
+        fuse_plan=b.get("fuse_plan"))
     result = {
         "metric": f"{MODEL}_train_images_per_sec",
         "value": b["images_per_sec"],
@@ -525,6 +538,7 @@ def run_child() -> None:
         "dtype": best,
         "dtype_note": ("mixed precision; f32 master params/losses/BN stats"
                        if best == "bf16" else None),
+        "fuse_plan": b.get("fuse_plan"),
         "batch": BATCH,
         "iters_per_block": ITERS,
         "reps": REPS,
@@ -577,6 +591,7 @@ _CONFIG_ENVS = ("BENCH_PLATFORM", "BENCH_MODEL", "BENCH_BATCH",
                 "SPARKNET_ASYNC_CKPT",
                 "BENCH_SERVE_MODEL", "BENCH_SERVE_CLIENTS",
                 "BENCH_SERVE_WINDOW", "BENCH_SERVE_SECONDS",
+                "BENCH_FUSE", "SPARKNET_FUSE",
                 "SPARKNET_SERVE_SHAPES", "SPARKNET_SERVE_MAX_DELAY_MS",
                 "SPARKNET_SERVE_QUEUE", "SPARKNET_SERVE_DTYPE",
                 "SPARKNET_SERVE_INFLIGHT")

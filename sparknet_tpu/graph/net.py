@@ -207,6 +207,8 @@ class Net:
             raise ValueError(
                 f"input_overrides for non-input blobs: {sorted(unknown)}")
         self._detect_hfuse_groups()
+        self._detect_vfuse_chains()
+        self._fuse_skip_noted: set[str] = set()
 
     def _detect_hfuse_groups(self) -> None:
         """Horizontal fusion of sibling 1x1 convolutions (default ON,
@@ -249,6 +251,51 @@ class Net:
             if len(members) >= 2:
                 self._hfuse_first[members[0].lp.name] = members
                 self._hfuse_member.update(m.lp.name for m in members[1:])
+
+    def _detect_vfuse_chains(self) -> None:
+        """Vertical conv+bias+relu(+pool/LRN) chain fusion, planned by
+        ``graph/fusion.py`` from the SPARKNET_FUSE source (off | auto
+        [default, profile-worklist-driven] | all | <plan.json>) —
+        latched at Net construction like the hfuse toggle.  Runs AFTER
+        hfuse detection: horizontal groups keep their members, vertical
+        chains take what's left."""
+        from . import fusion
+        self._fuse_plan = fusion.resolve_plan(self)
+        self._vfuse_head: dict[str, fusion.FusedChain] = {}
+        self._vfuse_member: set[str] = set()
+        if self._fuse_plan is None:
+            return
+        for ch in self._fuse_plan.chains:
+            if not all(m in self._node_by_name for m in ch.members):
+                continue   # plan from another net's namespace
+            self._vfuse_head[ch.members[0]] = ch
+            self._vfuse_member.update(ch.members[1:])
+
+    def fuse_plan_id(self) -> str:
+        """Short id of the active vertical-fusion plan (``off`` when
+        none) — the perf-ledger fingerprint field that keeps fused and
+        unfused captures out of each other's baseline bands."""
+        plan = getattr(self, "_fuse_plan", None)
+        return plan.plan_id() if plan is not None else "off"
+
+    def _note_unfused_run(self, reason: str) -> None:
+        """A fusable net executing unfused (ranged run, eps injection,
+        blob introspection) used to be silent — a profile captured from
+        such a run would pool into the fused baseline band.  One
+        instant() per (net, reason) plus an always-on counter make the
+        mislabel visible; trace-time cost only."""
+        from ..utils import telemetry
+        telemetry.get_registry().counter(
+            "fusion_unfused_runs_total",
+            "runs of a fusable net that skipped fusion").inc(
+                reason=reason)
+        if reason not in self._fuse_skip_noted:
+            self._fuse_skip_noted.add(reason)
+            telemetry.instant(
+                "fusion.unfused_run", cat="graph", reason=reason,
+                net=self.name or "?",
+                hfuse_groups=len(getattr(self, "_hfuse_first", {})),
+                vfuse_chains=len(getattr(self, "_vfuse_head", {})))
 
     @staticmethod
     def _check_batch_insensitive(lp, impl, bottoms, bshapes, tainted) -> None:
@@ -458,7 +505,7 @@ class Net:
                     f"unknown layer {nm!r} for {which}= "
                     f"(layers: {self.layer_names()})")
         blobs, _, _ = self._run(params, inputs, train, rng, upto=upto,
-                                eps=eps, start=start)
+                                eps=eps, start=start, introspect=True)
         return blobs
 
     def _cast(self, arrs, dtype):
@@ -470,7 +517,7 @@ class Net:
 
     def _run(self, params, inputs, train, rng, upto: str | None = None,
              eps: Mapping[str, jax.Array] | None = None,
-             start: str | None = None):
+             start: str | None = None, introspect: bool = False):
         """The layer-by-layer forward shared by apply/apply_all.
 
         With ``compute_dtype`` set (bf16 on TPU), params and activations
@@ -520,13 +567,26 @@ class Net:
                     if t in eps:
                         last_producer[t] = n.lp.name
         started = start is None
-        # horizontal 1x1-sibling fusion: full-net runs only (ranged runs
-        # and eps injection keep the plain per-layer path); on by
-        # default (exact transform, measured -5.6% GoogLeNet step).
-        # SPARKNET_NO_HFUSE=1 restores per-layer execution — latched at
-        # Net construction (_detect_hfuse_groups), not per trace
-        hfuse_on = (bool(self._hfuse_first) and start is None
-                    and upto is None and not eps and self._hfuse_enabled)
+        # fusion runs on full-net, non-introspected runs only (ranged
+        # runs and eps injection keep the plain per-layer path, and
+        # apply_all must surface REAL intermediate blobs).  Horizontal
+        # 1x1-sibling fusion: on by default (exact transform, measured
+        # -5.6% GoogLeNet step), SPARKNET_NO_HFUSE=1 restores per-layer
+        # execution.  Vertical chains: planned per SPARKNET_FUSE
+        # (graph/fusion.py).  Both latched at Net construction.
+        full_run = start is None and upto is None and not eps \
+            and not introspect
+        hfuse_on = (bool(self._hfuse_first) and full_run
+                    and self._hfuse_enabled)
+        vfuse_on = bool(self._vfuse_head) and full_run
+        if not full_run and (
+                (self._hfuse_first and self._hfuse_enabled)
+                or self._vfuse_head):
+            # a fusable net running unfused must not be silent — a
+            # profile captured from this run is NOT the fused baseline
+            self._note_unfused_run(
+                "ranged" if (start is not None or upto is not None)
+                else "eps" if eps else "introspect")
         hstash: dict[str, jax.Array] = {}
         for ni, node in enumerate(self.nodes):
             if not started:
@@ -538,6 +598,11 @@ class Net:
                 # bound inputs; nothing to execute)
                 if upto is not None and node.lp.name == upto:
                     break
+                continue
+            if vfuse_on and node.lp.name in self._vfuse_member:
+                # executed inside its chain head's fused block; its
+                # intermediate blob is single-consumer by legality
+                # (graph/fusion.py), so nothing downstream misses it
                 continue
             missing = [b for b in node.bottoms if b not in blobs]
             if missing:
@@ -553,6 +618,20 @@ class Net:
                 # masks its forward actually used
                 layer_rng = jax.random.fold_in(rng, ni)
             stateful = getattr(node.impl, "has_state", False)
+            if vfuse_on and node.lp.name in self._vfuse_head:
+                ch = self._vfuse_head[node.lp.name]
+                members = [self._node_by_name[m] for m in ch.members]
+                assert not any(
+                    getattr(m.impl, "has_state", False)
+                    or m.impl.needs_rng(m.lp, train)
+                    or any(w for w in m.loss_weights())
+                    for m in members), (
+                    f"vfuse chain {ch.scope()!r} admitted a stateful/"
+                    f"rng/loss member; fix graph/fusion.py legality")
+                final = self._apply_fused_chain(ch, members, new_params,
+                                                blobs, cd, train)
+                blobs[members[-1].tops[0]] = final
+                continue
             if hfuse_on and node.lp.name in self._hfuse_member:
                 # sibling 1x1 conv: its slice of the fused conv was
                 # stashed when the group's first member ran
@@ -633,6 +712,53 @@ class Net:
             if upto is not None and node.lp.name == upto:
                 break
         return blobs, loss, new_params
+
+    def _apply_fused_chain(self, ch, members, params, blobs, cd, train):
+        """Execute one planned vertical chain as a single block.
+
+        The head conv runs through its own impl (XLA's MXU tiling is
+        already optimal; on eligible stems that includes the
+        space-to-depth rewrite).  An LRN tail with a fused epilogue
+        collapses [ReLU+]LRN into ``ops.vision.lrn_chain_epilogue`` —
+        the Pallas one-VMEM-trip kernel on TPU, the scale-residual
+        custom-VJP reference elsewhere.  Every other member applies its
+        own impl inside the shared ``L[a+b+...]`` scope, so the whole
+        chain profiles as ONE row (the post-fusion view perfwatch's
+        worklist consumes) and those segments stay bit-identical to
+        per-layer execution."""
+        from ..ops.vision import lrn_chain_epilogue, lrn_geometry
+        head = members[0]
+        x = blobs[head.bottoms[0]]
+        p = self.node_params(params, head)
+        if cd is not None:
+            x = self._cast([x], cd)[0]
+            p = self._cast(p, cd)
+        with jax.named_scope(f"L[{ch.scope()}]"):
+            (y,) = head.impl.apply(head.lp, p, [x], train, None)
+            i = 1
+            while i < len(members):
+                m = members[i]
+                nxt = members[i + 1] if i + 1 < len(members) else None
+                if (ch.epilogue == "relu+lrn" and m.lp.type == "ReLU"
+                        and nxt is not None and nxt.lp.type == "LRN"):
+                    size, alpha, beta, k, _ = lrn_geometry(nxt.lp)
+                    y = lrn_chain_epilogue(y, size, alpha, beta, k,
+                                           relu=True)
+                    i += 2
+                    continue
+                if (ch.epilogue in ("lrn", "relu+lrn")
+                        and m.lp.type == "LRN" and nxt is None):
+                    size, alpha, beta, k, _ = lrn_geometry(m.lp)
+                    y = lrn_chain_epilogue(y, size, alpha, beta, k,
+                                           relu=False)
+                    i += 1
+                    continue
+                mp = self.node_params(params, m)
+                if cd is not None:
+                    mp = self._cast(mp, cd)
+                (y,) = m.impl.apply(m.lp, mp, [y], train, None)
+                i += 1
+        return y
 
     # -- introspection (FFI-parity helpers; reference: ccaffe.cpp:86-139,
     #    Net.scala:64-66) --------------------------------------------------

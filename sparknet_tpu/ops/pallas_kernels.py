@@ -53,36 +53,53 @@ def _fwd_window(size: int) -> tuple[int, int]:
     return pre, size - 1 - pre
 
 
-def _lrn_fwd_kernel(x_ref, y_ref, scale_ref, *, size, alpha, beta, k):
+def _lrn_fwd_kernel(x_ref, y_ref, scale_ref, *, size, alpha, beta, k,
+                    relu=False):
     # Math in f32 regardless of I/O dtype; bf16 blocks cast at the VMEM
-    # boundary so mixed-precision nets keep f32 window sums.
+    # boundary so mixed-precision nets keep f32 window sums.  With
+    # ``relu`` the block consumes the producer conv's biased output
+    # directly and applies the chain's ReLU in-register — the vertical
+    # fusion pass's LRN epilogue (graph/fusion.py) — so the post-ReLU
+    # activation never round-trips through HBM between the two layers.
     x = x_ref[:].astype(jnp.float32)
+    a = jnp.maximum(x, 0.0) if relu else x
     pre, post = _fwd_window(size)
-    scale = k + (alpha / size) * _window_sum(x * x, pre, post)
+    scale = k + (alpha / size) * _window_sum(a * a, pre, post)
     scale_ref[:] = scale.astype(scale_ref.dtype)
-    y_ref[:] = (x * scale ** -beta).astype(y_ref.dtype)
+    y_ref[:] = (a * scale ** -beta).astype(y_ref.dtype)
 
 
-def _lrn_infer_kernel(x_ref, y_ref, *, size, alpha, beta, k):
+def _lrn_infer_kernel(x_ref, y_ref, *, size, alpha, beta, k, relu=False):
     """Forward without the scale residual — the primal/inference path
     (a pallas output cannot be dead-code-eliminated by XLA, so writing
     scale when nothing consumes it costs a full HBM pass)."""
     x = x_ref[:].astype(jnp.float32)
+    a = jnp.maximum(x, 0.0) if relu else x
     pre, post = _fwd_window(size)
-    scale = k + (alpha / size) * _window_sum(x * x, pre, post)
-    y_ref[:] = (x * scale ** -beta).astype(y_ref.dtype)
+    scale = k + (alpha / size) * _window_sum(a * a, pre, post)
+    y_ref[:] = (a * scale ** -beta).astype(y_ref.dtype)
 
 
-def _lrn_bwd_kernel(x_ref, scale_ref, dy_ref, dx_ref, *, size, alpha, beta):
+def _lrn_bwd_kernel(x_ref, scale_ref, dy_ref, dx_ref, *, size, alpha, beta,
+                    relu=False):
+    # The ReLU'd activation is recomputed from the saved pre-activation
+    # (one VPU max) rather than stored — residuals stay (x, scale),
+    # exactly Caffe's CrossMapBackward memory footprint even with the
+    # epilogue fused on top.
     x = x_ref[:].astype(jnp.float32)
     scale = scale_ref[:].astype(jnp.float32)
     dy = dy_ref[:].astype(jnp.float32)
-    y = x * scale ** -beta
+    a = jnp.maximum(x, 0.0) if relu else x
+    y = a * scale ** -beta
     pre, post = _fwd_window(size)
     ratio = _window_sum(dy * y / scale, post, pre)  # reflected window
-    dx_ref[:] = (dy * scale ** -beta
-                 - (2.0 * alpha * beta / size) * x * ratio).astype(
-                     dx_ref.dtype)
+    da = (dy * scale ** -beta
+          - (2.0 * alpha * beta / size) * a * ratio)
+    if relu:
+        # relu_layer.cpp Backward: dx = da * (x > 0); ties at exactly 0
+        # route no gradient, matching the unfused ReLU->LRN pair
+        da = jnp.where(x > 0, da, 0.0)
+    dx_ref[:] = da.astype(dx_ref.dtype)
 
 
 def _specs(n, c, s):
@@ -91,13 +108,13 @@ def _specs(n, c, s):
     return grid, spec
 
 
-def _fwd_call(x, size, alpha, beta, k):
+def _fwd_call(x, size, alpha, beta, k, relu):
     n, c, h, w = x.shape
     xs = x.reshape(n, c, h * w)
     grid, spec = _specs(n, c, h * w)
     y, scale = pl.pallas_call(
         functools.partial(_lrn_fwd_kernel, size=size, alpha=alpha,
-                          beta=beta, k=k),
+                          beta=beta, k=k, relu=relu),
         out_shape=(jax.ShapeDtypeStruct(xs.shape, xs.dtype),
                    jax.ShapeDtypeStruct(xs.shape, xs.dtype)),
         grid=grid,
@@ -108,15 +125,21 @@ def _fwd_call(x, size, alpha, beta, k):
     return y.reshape(x.shape), scale.reshape(x.shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
-def lrn_across_channels(x, size: int, alpha: float, beta: float, k: float):
-    """Caffe ACROSS_CHANNELS LRN as a fused Pallas kernel."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def relu_lrn_across_channels(x, size: int, alpha: float, beta: float,
+                             k: float, relu: bool = False):
+    """Caffe ACROSS_CHANNELS LRN as a fused Pallas kernel, with the
+    producing chain's zero-slope ReLU optionally folded in-register
+    (``relu=True``) — the vertical fusion pass's LRN epilogue: the conv
+    output is read from HBM ONCE, bias/ReLU/window-sum/normalize all
+    happen in VMEM, and only the normalized activation is written back
+    (plus ``scale`` on the VJP path, Caffe's own residual)."""
     n, c, h, w = x.shape
     xs = x.reshape(n, c, h * w)
     grid, spec = _specs(n, c, h * w)
     y = pl.pallas_call(
         functools.partial(_lrn_infer_kernel, size=size, alpha=alpha,
-                          beta=beta, k=k),
+                          beta=beta, k=k, relu=relu),
         out_shape=jax.ShapeDtypeStruct(xs.shape, xs.dtype),
         grid=grid,
         in_specs=[spec],
@@ -126,18 +149,18 @@ def lrn_across_channels(x, size: int, alpha: float, beta: float, k: float):
     return y.reshape(x.shape)
 
 
-def _lrn_vjp_fwd(x, size, alpha, beta, k):
-    y, scale = _fwd_call(x, size, alpha, beta, k)
+def _lrn_vjp_fwd(x, size, alpha, beta, k, relu):
+    y, scale = _fwd_call(x, size, alpha, beta, k, relu)
     return y, (x, scale)
 
 
-def _lrn_vjp_bwd(size, alpha, beta, k, res, dy):
+def _lrn_vjp_bwd(size, alpha, beta, k, relu, res, dy):
     x, scale = res
     n, c, h, w = x.shape
     grid, spec = _specs(n, c, h * w)
     dx = pl.pallas_call(
         functools.partial(_lrn_bwd_kernel, size=size, alpha=alpha,
-                          beta=beta),
+                          beta=beta, relu=relu),
         out_shape=jax.ShapeDtypeStruct((n, c, h * w), x.dtype),
         grid=grid,
         in_specs=[spec, spec, spec],
@@ -148,7 +171,13 @@ def _lrn_vjp_bwd(size, alpha, beta, k, res, dy):
     return (dx.reshape(x.shape),)
 
 
-lrn_across_channels.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
+relu_lrn_across_channels.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
+
+
+def lrn_across_channels(x, size: int, alpha: float, beta: float, k: float):
+    """Caffe ACROSS_CHANNELS LRN as a fused Pallas kernel (the
+    ``relu=False`` face of :func:`relu_lrn_across_channels`)."""
+    return relu_lrn_across_channels(x, size, alpha, beta, k, False)
 
 
 # ---------------------------------------------------------------------------

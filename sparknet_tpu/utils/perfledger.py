@@ -49,7 +49,12 @@ LEDGER_RELPATH = os.path.join("perf", "LEDGER.jsonl")
 
 # fingerprint fields, in canonical key order
 FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
-                      "backend")
+                      "backend", "fuse_plan")
+
+# entries written before the vertical fusion pass existed carry no
+# fuse_plan field; they were structurally unfused, so they pool with
+# today's explicit "off" captures instead of fragmenting the history
+_FINGERPRINT_DEFAULTS = {"fuse_plan": "off"}
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -83,25 +88,34 @@ def git_sha(root: str | None = None, short: bool = True) -> str | None:
 def fingerprint(model: str | None = None, dtype: str | None = None,
                 batch: int | None = None, world: int | None = None,
                 device: str | None = None,
-                backend: str | None = None) -> dict[str, Any]:
+                backend: str | None = None,
+                fuse_plan: str | None = None) -> dict[str, Any]:
     """Canonical config fingerprint.  ``backend`` defaults to the
     platform half of ``device`` (``"tpu/TPU v5 lite"`` -> ``"tpu"``) —
-    the field the baseline isolation hinges on."""
+    the field the baseline isolation hinges on.  ``fuse_plan`` is the
+    vertical-fusion plan id (``Net.fuse_plan_id()``): a fused capture
+    and an unfused one are different programs, so they must never pool
+    into one baseline band."""
     if backend is None and device:
         backend = str(device).split("/", 1)[0]
     return {"model": model or "unknown", "dtype": dtype or "unknown",
             "batch": int(batch) if batch is not None else 0,
             "world": int(world) if world is not None else 1,
             "device": device or "unknown",
-            "backend": backend or "unknown"}
+            "backend": backend or "unknown",
+            "fuse_plan": fuse_plan or "off"}
 
 
 def fp_key(fp: Mapping[str, Any]) -> str:
     """The fingerprint as one canonical string — the baseline grouping
     key.  Two captures gate against each other iff their keys match, so
-    device/dtype/batch isolation is structural, not a special case."""
-    return "|".join(f"{k}={fp.get(k, 'unknown')}"
-                    for k in FINGERPRINT_FIELDS)
+    device/dtype/batch isolation is structural, not a special case.
+    Fields newer than an entry (fuse_plan) read as their historical
+    default, so the committed pre-fusion history keeps gating."""
+    def val(k):
+        v = fp.get(k)
+        return _FINGERPRINT_DEFAULTS.get(k, "unknown") if v is None else v
+    return "|".join(f"{k}={val(k)}" for k in FINGERPRINT_FIELDS)
 
 
 def provenance(result_fp: Mapping[str, Any] | None = None) -> dict[str, Any]:
@@ -396,6 +410,7 @@ def entries_from_bench(doc: Mapping[str, Any], path: str | None = None, *,
     device = doc.get("device") or device_hint
     model = _model_from_metric(doc.get("metric")) or "unknown"
     batch = doc.get("batch")
+    fuse = doc.get("fuse_plan")
     out: list[dict] = []
 
     by_dtype = doc.get("by_dtype") or {
@@ -409,7 +424,8 @@ def entries_from_bench(doc: Mapping[str, Any], path: str | None = None, *,
         }}
     for dtype, run in by_dtype.items():
         fp = fingerprint(model=model, dtype=dtype, batch=batch, world=1,
-                         device=device)
+                         device=device, fuse_plan=run.get("fuse_plan")
+                         or fuse)
         metrics = {
             "train_img_s": run.get("images_per_sec"),
             "eval_img_s": run.get("eval_images_per_sec"),
@@ -542,7 +558,8 @@ def entries_from_op_table(doc: Mapping[str, Any],
     fp = fingerprint(model=summary.get("model"),
                      dtype=summary.get("dtype"),
                      batch=summary.get("batch"), world=1,
-                     device=summary.get("device"))
+                     device=summary.get("device"),
+                     fuse_plan=summary.get("fuse_plan"))
     # profile captures run with profiling overhead — their MFU/img_s
     # must not pool into the bench baselines, hence the profile_ prefix
     metrics: dict[str, Any] = {

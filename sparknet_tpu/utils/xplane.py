@@ -224,13 +224,38 @@ def device_plane(planes: list[Plane]) -> Plane:
     return best
 
 
-def op_tables(log_dir: str, *, top: int = 30) -> dict:
+# One optimized-HLO instruction line: `%name.123 = ... metadata={...
+# op_name="jit(f)/.../L[conv1]/conv" ...}` — the join key for traces
+# whose events carry instruction names but no scope stat (the CPU
+# TfrtCpuClient/Eigen runtime).
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([A-Za-z0-9_.\-]+)\s*=\s*.*?"
+    r"metadata=\{[^}]*?op_name=\"([^\"]*)\"", re.M)
+
+
+def hlo_layer_map(compiled_hlo_text: str) -> dict[str, str]:
+    """instruction name -> scope path, from the optimized HLO's op_name
+    metadata.  TPU traces carry the scope as a per-event stat; CPU thunk
+    traces carry only instruction names, so the executor's L[...] layer
+    attribution needs this side-channel join (the profiled program's
+    ``compiled.as_text()`` is the source of truth — same executable,
+    same instruction names the thunk events report)."""
+    return {name: op_name
+            for name, op_name in _HLO_INSTR_RE.findall(compiled_hlo_text)
+            if op_name}
+
+
+def op_tables(log_dir: str, *, top: int = 30,
+              layer_map: dict[str, str] | None = None) -> dict:
     """Aggregate the newest trace under ``log_dir``.
 
     Returns ``{plane, total_ms, by_category: [...], by_op: [...]}`` where
     rows carry total_ms, count, pct, gflops_per_s (achieved, from XLA's
     model-flops stat) and gb_per_s (achieved HBM bandwidth proxy from
     bytes_accessed).  Only leaf events on the "XLA Ops" line count.
+    ``layer_map`` (see :func:`hlo_layer_map`) supplies scopes for events
+    that carry none of their own — the CPU-runtime path to a by_layer
+    table.
     """
     plane = device_plane(parse_xspace(find_xplane_file(log_dir)))
     events = []
@@ -248,6 +273,11 @@ def op_tables(log_dir: str, *, top: int = 30) -> dict:
                     e for e in evs
                     if not e.meta.name.startswith(("ThunkExecutor",
                                                    "ThreadpoolListener")))
+
+    if layer_map:
+        for e in events:
+            if not e.meta.scope:
+                e.meta.scope = layer_map.get(e.meta.name, "")
 
     def category(m) -> str:
         if m.category:

@@ -392,7 +392,8 @@ def test_bench_cpu_smoke(tmp_path):
                BENCH_FEED_BATCH="8",
                BENCH_ATTEMPTS="1", BENCH_TIMEOUT_S="280",
                BENCH_ROUND="0",  # the round leg has its own gate (roundbench)
-               BENCH_SERVING="0")  # as does serving (servesmoke)
+               BENCH_SERVING="0",  # as does serving (servesmoke)
+               BENCH_FUSE="off")  # and vertical fusion (fusebench)
     env.pop("XLA_FLAGS", None)  # conftest's 8-device flag slows the child
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run([sys.executable, os.path.join(root, "bench.py")],
@@ -438,7 +439,8 @@ def test_bench_feed_overlap_nondegenerate(tmp_path):
                BENCH_FEED_BATCH="16", BENCH_FEED_DELAY_S=str(delay),
                BENCH_ATTEMPTS="1", BENCH_TIMEOUT_S="280",
                BENCH_ROUND="0",  # the round leg has its own gate (roundbench)
-               BENCH_SERVING="0")  # as does serving (servesmoke)
+               BENCH_SERVING="0",  # as does serving (servesmoke)
+               BENCH_FUSE="off")  # and vertical fusion (fusebench)
     env.pop("XLA_FLAGS", None)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run([sys.executable, os.path.join(root, "bench.py")],

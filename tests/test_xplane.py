@@ -203,3 +203,25 @@ def test_by_layer_attribution(tmp_path):
     assert layers["pool1"]["total_ms"] == pytest.approx(1.0)
     assert layers["(outside layers)"]["total_ms"] == pytest.approx(1.0)
     assert "by layer" in xplane.format_tables(tables)
+
+
+def test_hlo_layer_map_joins_cpu_thunk_events():
+    """CPU-runtime traces carry instruction names but no tf_op scope;
+    the optimized-HLO op_name metadata supplies the join
+    (xplane.hlo_layer_map + op_tables(layer_map=...))."""
+    hlo = '''
+HloModule jit_block_fn, entry_computation_layout={...}
+
+%fused_computation (p0: f32[4,96,55,55]) -> f32[4,96,55,55] {
+  ROOT %mul.1 = f32[] multiply(%a, %b)
+}
+
+ENTRY %main {
+  %convolution.14 = f32[4,96,55,55]{3,2,1,0} convolution(%p0, %p1), metadata={op_name="jit(block_fn)/L[conv1+relu1+pool1+norm1]/conv_general_dilated" source_file="a.py"}
+  ROOT %loop_fusion.3 = f32[4,96,55,55]{3,2,1,0} fusion(%convolution.14), kind=kLoop, metadata={op_name="jit(block_fn)/transpose(jvp(L[norm2]))/mul"}
+}
+'''
+    lmap = xplane.hlo_layer_map(hlo)
+    assert "L[conv1+relu1+pool1+norm1]" in lmap["convolution.14"]
+    assert "L[norm2]" in lmap["loop_fusion.3"]
+    assert "mul.1" not in lmap  # no metadata, no entry

@@ -508,7 +508,11 @@ def run_lrn() -> None:
                 y = impl.apply(lp, [], [xx], True, None)[0]
                 return jnp.mean(y).astype(jnp.float32)
 
-            for variant, env in (("reduce_window", None), ("cumsum", "1")):
+            # "=0"/"=1" pin each form; unset is the shipping auto
+            # default (lrn_use_cumsum picks by channel count), measured
+            # as its own variant so the flip is auditable
+            for variant, env in (("reduce_window", "0"), ("cumsum", "1"),
+                                 ("auto", None)):
                 if env is None:
                     os.environ.pop("SPARKNET_LRN_CUMSUM", None)
                 else:

@@ -392,69 +392,11 @@ def diff_profiles(a_doc: dict, b_doc: dict, *, top: int = 12) -> dict:
             "fusion_worklist": worklist}
 
 
-# layers achieving more than this are MXU-bound (big convs / FCs), not
-# bandwidth-bound fusion candidates
-_MXU_GFLOPS_S = 5000.0
-# the aggregation pseudo-row profile tables carry
-_NON_LAYERS = ("(outside layers)",)
-
-
-def _chain_kind(layer: str) -> str:
-    name = layer.lower()
-    if "norm" in name:
-        return "conv+bias+relu+LRN"
-    if "pool" in name:
-        return "conv+bias+relu+pool"
-    if "relu" in name:
-        return "bias+relu"
-    return "elementwise chain"
-
-
-def fusion_worklist(doc: dict, *, top: int = 12,
-                    min_pct: float = 0.3) -> dict:
-    """Rank the unfused conv+bias+relu(+pool/LRN) chains of one capture
-    by reclaimable ms against the capture's own best fused-chain
-    bandwidth (the VERDICT.md method: the googlenet LRN chains run at
-    555 GB/s where neighboring fused chains reach ~1013 GB/s)."""
-    rows = [r for r in doc.get("by_layer") or []
-            if r.get("op") not in _NON_LAYERS
-            and r.get("gb_per_s") and r.get("total_ms")]
-    if not rows:
-        return {"note": "capture has no by_layer table — profile with "
-                        "tools/profile_step.py to get one",
-                "candidates": []}
-    # reference bandwidth: the best a non-trivial chain in THIS capture
-    # actually achieves (pct floor keeps sub-0.1% slivers from setting
-    # an unreachable bar)
-    ref_rows = [r for r in rows if (r.get("pct") or 0.0) >= 0.8]
-    ref = max((r["gb_per_s"] for r in ref_rows), default=None)
-    if ref is None:
-        ref = max(r["gb_per_s"] for r in rows)
-    candidates = []
-    for r in rows:
-        if (r.get("pct") or 0.0) < min_pct:
-            continue
-        if (r.get("gflops_per_s") or 0.0) > _MXU_GFLOPS_S:
-            continue   # MXU-bound: more bandwidth won't buy anything
-        gb = r["gb_per_s"]
-        if gb >= 0.95 * ref:
-            continue   # already at the fused-chain roofline
-        reclaim = r["total_ms"] * (1.0 - gb / ref)
-        kind = _chain_kind(r["op"])
-        cand = {"chain": r["op"], "kind": kind,
-                "total_ms": r["total_ms"], "pct": r.get("pct"),
-                "gb_per_s": gb, "ref_gb_per_s": round(ref, 1),
-                "reclaimable_ms": round(reclaim, 2)}
-        if "LRN" in kind:
-            cand["note"] = ("LRN chain — the class VERDICT.md pins at "
-                            "555 GB/s (googlenet bf16 conv2/norm2) vs "
-                            "~1013 GB/s on neighboring fused chains")
-        candidates.append(cand)
-    candidates.sort(key=lambda c: -c["reclaimable_ms"])
-    return {"ref_gb_per_s": round(ref, 1),
-            "reclaimable_ms_total": round(
-                sum(c["reclaimable_ms"] for c in candidates), 2),
-            "candidates": candidates[:top]}
+# The worklist itself lives in sparknet_tpu.graph.fusion — the vertical
+# fusion planner consumes the SAME ranking this CLI prints (ROADMAP
+# item 4: library, not a copy).  Re-exported here for callers that knew
+# it under the perfwatch name.
+from sparknet_tpu.graph.fusion import fusion_worklist  # noqa: E402,F401
 
 
 def cmd_diff(args) -> int:
@@ -484,6 +426,14 @@ def cmd_diff(args) -> int:
         note = "" if r["status"] == "both" else f"  [{r['status']}]"
         print(f"    {r['op']:<26} {a_ms:>9} -> {b_ms:>9} ms "
               f"({r['delta_ms']:+.2f}){gb}{note}")
+    moved = [r for r in out["layers"] if r["status"] != "both"]
+    if moved:
+        # a layer row vanishing while an a+b+c row appears IS the
+        # fusion pass's signature (each chain becomes one L[...] scope)
+        print("  layer rows present on one side only:")
+        for r in moved[:args.top]:
+            ms = r["a_ms"] if r["a_ms"] is not None else r["b_ms"]
+            print(f"    {r['layer']:<44} {ms:>9.2f} ms [{r['status']}]")
     wl = out["fusion_worklist"]
     if wl.get("candidates"):
         print(f"  fusion-candidate worklist for B "
@@ -497,6 +447,15 @@ def cmd_diff(args) -> int:
                 print(f"        {c['note']}")
     elif wl.get("note"):
         print(f"  {wl['note']}")
+    else:
+        print("  fusion-candidate worklist for B: empty — no unfused "
+              "chain runs below the capture's fused-chain band")
+    for c in wl.get("fused_chains") or []:
+        verdict = ("at ref band" if c["at_ref_band"]
+                   else "BELOW ref band")
+        print(f"    fused {c['chain']:<34} {c['total_ms']:>8.2f} ms @ "
+              f"{c['gb_per_s']:>7.1f} GB/s ({verdict}, "
+              f"ref {c['ref_gb_per_s']})")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=1)

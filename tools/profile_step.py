@@ -64,6 +64,7 @@ def main() -> None:
         build_bench_model,
         eval_cost_flops,
         peak_flops,
+        record_fusion_plan,
         scanned_eval_block,
         scanned_train_block,
         step_cost_flops,
@@ -128,11 +129,32 @@ def main() -> None:
     peak = peak_flops(dev.device_kind)
     mfu = (flops_per_step / step_s / peak) if (flops_per_step and peak) else None
 
-    tables = xplane.op_tables(out_dir, top=args.top)
+    # CPU-runtime traces carry instruction names but no scope stats; the
+    # optimized HLO of the SAME compiled block supplies the
+    # name -> op_name join that recovers L[...] layer attribution
+    # (xplane.hlo_layer_map).  Cheap on re-compile: the persistent
+    # compilation cache already holds this executable.
+    layer_map = None
+    try:
+        if args.eval:
+            lowered = block.lower(params, eval_batch, jnp.zeros(()))
+        else:
+            lowered = block.lower(params, state, 0, batch, step_rng)
+        layer_map = xplane.hlo_layer_map(lowered.compile().as_text())
+    except Exception as e:
+        print(f"[profile] no HLO layer map: {e}", file=sys.stderr)
+
+    tables = xplane.op_tables(out_dir, top=args.top, layer_map=layer_map)
     print(xplane.format_tables(tables))
+    # the profiled net's vertical-fusion plan: stamped into the summary
+    # (the perf-ledger fingerprint field) and recorded next to the
+    # op_table as fusion_plan.json so a capture is reproducible —
+    # SPARKNET_FUSE=profiles/<model>/fusion_plan.json replays it exactly
+    prof_net = solver.test_net if args.eval else solver.train_net
     summary = {
         "model": args.model, "batch": args.batch, "dtype": args.dtype,
         "mode": "eval_forward" if args.eval else "train_step",
+        "fuse_plan": record_fusion_plan(prof_net, out_dir),
         "device": f"{dev.platform}/{dev.device_kind}",
         "step_ms": round(step_s * 1e3, 2),
         "img_s": round(args.batch / step_s, 1),

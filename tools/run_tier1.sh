@@ -108,6 +108,21 @@ maybe_roundbench() {
   fi
 }
 
+# ~7-second vertical-fusion parity gate (tools/fusebench.py) — opt-in
+# via SPARKNET_FUSEBENCH=1.  Fails the gate unless fused execution
+# (SPARKNET_FUSE=all) reproduces per-layer execution bit-for-bit in the
+# forward (f32 + bf16), matches gradients inside the documented ulp
+# bound on every chain shape (conv+bias+relu, +pool, +LRN), refuses a
+# planted unfusable (fan-out) hotspot with a recorded reason, and does
+# not slow the LRN-chain train step down.  (A fast in-tree smoke of the
+# same contracts always runs inside tier-1: tests/test_fusion.py.)
+maybe_fusebench() {
+  if [ "${SPARKNET_FUSEBENCH:-}" = "1" ]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      python tools/fusebench.py --out /tmp/_fusebench.json
+  fi
+}
+
 # ~10-second performance gate (tools/perfwatch.py perfgate) — opt-in
 # via SPARKNET_PERFGATE=1.  Runs a ~2s-leg CPU bench smoke through the
 # regression sentinel against the committed perf/LEDGER.jsonl (CPU
@@ -131,12 +146,13 @@ case "${1:-}" in
   --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
   --obssmoke) SPARKNET_OBSSMOKE=1 maybe_obssmoke ;;
   --perfgate) SPARKNET_PERFGATE=1 maybe_perfgate ;;
+  --fusebench) SPARKNET_FUSEBENCH=1 maybe_fusebench ;;
   --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
              && maybe_feedbench && maybe_servesmoke && maybe_roundbench \
-             && maybe_obssmoke && maybe_perfgate ;;
+             && maybe_obssmoke && maybe_fusebench && maybe_perfgate ;;
   "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
              && maybe_servesmoke && maybe_roundbench && maybe_obssmoke \
-             && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--obssmoke|--perfgate|--all]" >&2
+             && maybe_fusebench && maybe_perfgate ;;
+  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--obssmoke|--fusebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac
