@@ -101,10 +101,17 @@ ENV_JOB_TAG = "SPARKNET_FLEET_JOB"
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DRIVER = os.path.join(_REPO, "tests", "multihost_driver.py")
+SERVE_TOOL = os.path.join(_REPO, "tools", "serve.py")
 
 # models the built-in driver workload can train (the zoo driver trains
 # lenet; anything else needs an explicit JobSpec.cmd)
 DRIVER_MODELS = ("lenet",)
+
+# job kinds: "train" runs to a completion artifact; "serve" is a
+# long-lived serving replica — it never finishes on its own, the
+# scheduler decides its end (release_job -> drain -> COMPLETED, or
+# preemption -> drain -> requeue)
+JOB_KINDS = ("train", "serve")
 
 
 class FleetError(RuntimeError):
@@ -120,9 +127,19 @@ class JobSpec:
     elements may use the placeholders ``{out}`` (completion artifact —
     REQUIRED: its existence is how the fleet distinguishes "finished"
     from "checkpointed and stopped"), ``{ckpt}`` (the job's checkpoint
-    dir), ``{world}`` and ``{rounds}``."""
+    dir), ``{world}``, ``{rounds}`` and ``{endpoint}`` (the replica
+    endpoint file serve-kind jobs publish).
+
+    ``kind="serve"`` makes the job a serving replica: the built-in cmd
+    launches ``tools/serve.py --models <model>`` on an ephemeral port
+    publishing its endpoint into the job dir, the completion-artifact
+    rule is waived (a replica never "finishes" — the scheduler's
+    ``release_job`` ends it through the drain path), and ``model`` may
+    be any zoo name or comma list (the replica process validates it
+    loudly at load time)."""
 
     name: str
+    kind: str = "train"
     tenant: str = "default"
     priority: int = 0
     world: int = 4                 # gang size in device slices
@@ -134,7 +151,9 @@ class JobSpec:
     guard: bool = False            # arm the numerical-integrity guard
     audit_every: int = 0           # cross-replica audit cadence
     max_restarts: int = 2          # per launch episode (see FleetScheduler)
-    timeout_s: float = 300.0       # per attempt
+    timeout_s: float | None = 300.0   # per attempt (None = unbounded —
+                                      # the serve-kind default: replicas
+                                      # are long-lived by design)
     round_deadline_s: float | None = None   # straggler deadline
     preemptible: bool = True
     not_before_s: float = 0.0      # delay placement this long after submit
@@ -152,14 +171,19 @@ class JobSpec:
             raise ValueError(f"{self.name}: rounds must be >= 1")
         if self.max_restarts < 0:
             raise ValueError(f"{self.name}: max_restarts must be >= 0")
+        if self.kind not in JOB_KINDS:
+            raise ValueError(f"{self.name}: kind must be one of "
+                             f"{JOB_KINDS}, got {self.kind!r}")
         if self.cmd is not None:
             object.__setattr__(self, "cmd", tuple(self.cmd))
-            if not any("{out}" in c for c in self.cmd):
+            if self.kind == "train" \
+                    and not any("{out}" in c for c in self.cmd):
                 raise ValueError(
                     f"{self.name}: explicit cmd must reference {{out}} — "
                     f"the completion artifact is how the fleet tells a "
-                    f"finished job from a preempted one")
-        elif self.model not in DRIVER_MODELS:
+                    f"finished job from a preempted one (serve-kind jobs "
+                    f"are exempt: the scheduler decides their end)")
+        elif self.kind == "train" and self.model not in DRIVER_MODELS:
             raise ValueError(
                 f"{self.name}: model {self.model!r} has no built-in "
                 f"driver (known: {', '.join(DRIVER_MODELS)}); pass an "
@@ -277,6 +301,8 @@ class FleetJob:
         self.started_at: float | None = None
         self.preempt_requested = False
         self.preempt_deadline: float | None = None
+        self.release_requested = False       # scale-down, not eviction
+        self.drain_deadline: float | None = None
         self.runner = None
         self.thread: threading.Thread | None = None
         self.procs: list = []        # live Popen handles (latest attempt)
@@ -296,9 +322,19 @@ class FleetJob:
     def ckpt_dir(self) -> str:
         return os.path.join(self.job_dir, "ckpt")
 
+    @property
+    def endpoint_path(self) -> str:
+        """Where a serve-kind replica publishes its ephemeral endpoint
+        (url + pid + models) once its socket is up."""
+        return os.path.join(self.job_dir, "endpoint.json")
+
     def completed_ok(self) -> bool:
         """The completion artifact exists — the ONLY signal that a clean
-        exit was the job finishing rather than checkpoint-and-stop."""
+        exit was the job finishing rather than checkpoint-and-stop.
+        Serve-kind jobs have no artifact: their end is a scheduler
+        decision (release), never something the process proves."""
+        if self.spec.kind == "serve":
+            return False
         return os.path.exists(self.out_path)
 
     def build_cmd(self) -> list[str]:
@@ -306,8 +342,21 @@ class FleetJob:
         os.makedirs(self.ckpt_dir, exist_ok=True)
         if spec.cmd is not None:
             sub = {"out": self.out_path, "ckpt": self.ckpt_dir,
-                   "world": str(spec.world), "rounds": str(spec.rounds)}
+                   "world": str(spec.world), "rounds": str(spec.rounds),
+                   "endpoint": self.endpoint_path}
             return [c.format(**sub) for c in spec.cmd]
+        if spec.kind == "serve":
+            # a serving replica: ephemeral port, endpoint published into
+            # the job dir (the ServingFleet poll loop registers it with
+            # the router); SPARKNET_SERVE_* knobs ride spec.env.  A
+            # stale endpoint from the previous attempt must not route —
+            # the fresh attempt republishes once its socket is up.
+            try:
+                os.unlink(self.endpoint_path)
+            except OSError:
+                pass
+            return [sys.executable, SERVE_TOOL, "--models", spec.model,
+                    "--port", "0", "--endpoint-file", self.endpoint_path]
         cmd = [sys.executable, DRIVER, "--strategy", spec.strategy,
                "--out", self.out_path, "--ckpt-dir", self.ckpt_dir,
                "--rounds", str(spec.rounds),
@@ -358,6 +407,7 @@ class FleetScheduler:
                  aging_rate: float = 1.0 / 60.0,
                  preempt: bool = True,
                  preempt_grace_s: float = 10.0,
+                 drain_grace_s: float | None = None,
                  max_preempts: int = 10,
                  platform: str | None = "cpu",
                  backoff_base: float = 0.2,
@@ -375,7 +425,14 @@ class FleetScheduler:
         self.aging_rate = aging_rate
         self.preempt_enabled = preempt
         self.preempt_grace_s = preempt_grace_s
+        self.drain_grace_s = (preempt_grace_s if drain_grace_s is None
+                              else drain_grace_s)
         self.max_preempts = max_preempts
+        # job name -> drain hook (start() / done() -> bool): a stopping
+        # job with a hook drains FIRST (no new work routed, queued work
+        # finishes), then takes the SIGTERM path — how evicting a
+        # serving replica stays lossless (see router.RouterDrainHook)
+        self.drain_hooks: dict[str, Any] = {}
         self.platform = platform
         self.backoff_base = backoff_base
         self.extra_env = dict(extra_env or {})
@@ -500,6 +557,8 @@ class FleetScheduler:
         job.started_at = self._clock()
         job.preempt_requested = False
         job.preempt_deadline = None
+        job.release_requested = False
+        job.drain_deadline = None
         job.signaled_pids = set()
         job.procs = []
         job.episodes += 1
@@ -546,26 +605,93 @@ class FleetScheduler:
             except (ProcessLookupError, OSError):
                 pass
 
-    def preempt_job(self, job: FleetJob, *, by: str = "") -> None:
-        """Start a graceful preemption: stop the supervision loop, open
-        the SIGTERM grace window.  Harvest decides requeue-vs-complete
-        when the runner returns."""
+    def register_drain_hook(self, name: str, hook) -> None:
+        """Attach a drain fence to job ``name``: any stop (preemption,
+        release, shutdown) will ``hook.start()`` first and hold the
+        SIGTERM until ``hook.done()`` or ``drain_grace_s`` expires."""
+        self.drain_hooks[name] = hook
+
+    def _begin_stop(self, job: FleetJob, *, release: bool,
+                    by: str = "") -> None:
+        """Common preempt/release entry: stop the supervision loop, then
+        either open the drain window (hooked jobs — SIGTERM is deferred
+        to :meth:`_escalate_preemptions`) or SIGTERM immediately."""
         if job.state not in (RUNNING, PREEMPTING):
             return
         job.preempt_requested = True
+        job.release_requested = job.release_requested or release
         job.state = PREEMPTING
-        job.preempt_deadline = self._clock() + self.preempt_grace_s
         if job.runner is not None:
             job.runner.cancel()
-        self._signal_job(job, signal.SIGTERM)
+        hook = self.drain_hooks.get(job.name)
+        if hook is not None and job.preempt_deadline is None \
+                and job.drain_deadline is None:
+            try:
+                hook.start()
+                job.drain_deadline = self._clock() + self.drain_grace_s
+                self._journal_ev("drain", job=job.name,
+                                 release=release, by=by)
+            except Exception as e:
+                print(f"fleet: drain hook for {job.name!r} failed "
+                      f"({e!r}); falling through to SIGTERM",
+                      file=sys.stderr, flush=True)
+                job.drain_deadline = None
+        if job.drain_deadline is None and job.preempt_deadline is None:
+            job.preempt_deadline = self._clock() + self.preempt_grace_s
+            self._signal_job(job, signal.SIGTERM)
+
+    def preempt_job(self, job: FleetJob, *, by: str = "") -> None:
+        """Start a graceful preemption: stop the supervision loop, drain
+        if hooked, open the SIGTERM grace window.  Harvest decides
+        requeue-vs-complete when the runner returns."""
+        if job.state not in (RUNNING, PREEMPTING):
+            return
+        self._begin_stop(job, release=False, by=by)
         self._journal_ev("preempt", job=job.name, by=by)
         print(f"fleet: preempting {job.name!r}"
               + (f" for {by!r}" if by else ""), file=sys.stderr, flush=True)
+
+    def release_job(self, name: str) -> None:
+        """Gracefully END a job by scheduler decision — the serving
+        scale-down path: drain (via the registered hook), SIGTERM, and
+        at harvest the job is COMPLETED, not requeued.  Loud on unknown
+        names; a no-op on already-terminal jobs."""
+        job = self.jobs.get(name)
+        if job is None:
+            raise FleetError(f"release of unknown job {name!r}")
+        if job.state in TERMINAL:
+            return
+        if job.state == QUEUED:
+            # never launched: nothing to drain or signal
+            job.state = COMPLETED
+            job.release_requested = True
+            self._journal_ev("release", job=name, queued=True)
+            self._journal_ev("complete", job=name, released=True)
+            return
+        self._begin_stop(job, release=True, by="release")
+        self._journal_ev("release", job=name)
+        print(f"fleet: releasing {job.name!r} (drain, then stop)",
+              file=sys.stderr, flush=True)
 
     def _escalate_preemptions(self) -> None:
         now = self._clock()
         for job in self.jobs.values():
             if job.state != PREEMPTING:
+                continue
+            if job.drain_deadline is not None:
+                # drain window: no signals while the hook drains — the
+                # queued work this stop must not lose is still finishing
+                hook = self.drain_hooks.get(job.name)
+                try:
+                    done = True if hook is None else bool(hook.done())
+                except Exception:
+                    done = True      # a broken hook must not wedge a stop
+                if done or now > job.drain_deadline:
+                    self._journal_ev("drain_done", job=job.name,
+                                     ok=bool(done))
+                    job.drain_deadline = None
+                    job.preempt_deadline = now + self.preempt_grace_s
+                    self._signal_job(job, signal.SIGTERM)
                 continue
             # catch workers spawned after the first SIGTERM volley
             self._signal_job(job, signal.SIGTERM)
@@ -627,6 +753,14 @@ class FleetScheduler:
                 self._journal_ev("complete", job=job.name)
                 print(f"fleet: {job.name!r} completed", file=sys.stderr,
                       flush=True)
+            elif job.release_requested:
+                # a scheduler-decided end (serving scale-down): the
+                # drain already emptied it, the exit IS the completion
+                job.state = COMPLETED
+                job.drain_deadline = None
+                self._journal_ev("complete", job=job.name, released=True)
+                print(f"fleet: {job.name!r} released", file=sys.stderr,
+                      flush=True)
             elif job.preempt_requested or rc == 0:
                 # a clean exit WITHOUT the completion artifact is a
                 # checkpoint-and-stop (our preemption, or the job's own
@@ -644,6 +778,7 @@ class FleetScheduler:
                     job.submitted_at = self._clock()  # aging restarts
                     job.preempt_requested = False
                     job.preempt_deadline = None
+                    job.drain_deadline = None
                     self._journal_ev("requeue", job=job.name,
                                      preempts=job.preempt_count)
             else:
@@ -796,6 +931,8 @@ class FleetScheduler:
             metrics = job_metrics(job.job_dir)
             jobs.append({
                 "job": job.name,
+                "kind": job.spec.kind,
+                "model": job.spec.model,
                 "tenant": job.spec.tenant,
                 "state": job.state,
                 "priority": job.spec.priority,
@@ -816,9 +953,13 @@ class FleetScheduler:
         for t in sorted({j.spec.tenant for j in self.jobs.values()}):
             by_tenant[t] = {"used": self._tenant_used(t),
                             "quota": self.tenants.get(t)}
-        return {"devices": {"total": self.allocator.total,
-                            "free": self.allocator.free_count},
-                "tenants": by_tenant, "jobs": jobs}
+        out = {"devices": {"total": self.allocator.total,
+                           "free": self.allocator.free_count},
+               "tenants": by_tenant, "jobs": jobs}
+        serving = serving_status(self.workdir, jobs)
+        if serving:
+            out["serving"] = serving
+        return out
 
     # -- crash recovery ---------------------------------------------------
     @classmethod
@@ -886,6 +1027,11 @@ class FleetScheduler:
             job.all_pids = set(pids.get(name, set()))
             if terminal.get(name) == QUARANTINED:
                 job.state = QUARANTINED
+            elif terminal.get(name) == COMPLETED \
+                    and spec.kind == "serve":
+                # a released replica has no out artifact; the journal's
+                # word is the only (and sufficient) completion proof
+                job.state = COMPLETED
             # submit() already flipped state to COMPLETED when the out
             # artifact exists — covering jobs that finished unsupervised
             if job.state == QUEUED:
@@ -1002,6 +1148,11 @@ def offline_status(workdir: str) -> dict[str, Any]:
             c["attempts"] += 1
         elif kind == "preempt":
             state[name] = PREEMPTING
+        elif kind == "release":
+            # scale-down in flight: draining, then stopping; the
+            # matching "complete" (released=True) lands at harvest
+            if state.get(name) not in TERMINAL:
+                state[name] = PREEMPTING
         elif kind == "requeue":
             state[name] = QUEUED
             slots.pop(name, None)
@@ -1047,7 +1198,9 @@ def offline_status(workdir: str) -> dict[str, Any]:
         metrics = job_metrics(job_dir)
         c = counters.get(name, {})
         jobs.append({
-            "job": name, "tenant": spec.tenant, "state": st,
+            "job": name, "kind": spec.kind, "model": spec.model,
+            "tenant": spec.tenant,
+            "state": st,
             "priority": spec.priority,
             "eff_priority": float(spec.priority),  # no live clock offline
             "world": spec.world, "slots": job_slots,
@@ -1065,8 +1218,39 @@ def offline_status(workdir: str) -> dict[str, Any]:
                      "quota": tenants.get(t)}
                  for t in sorted({j["tenant"] for j in jobs} |
                                  set(tenants))}
-    return {"devices": {"total": devices, "free": max(free, 0)},
-            "tenants": by_tenant, "jobs": jobs}
+    out = {"devices": {"total": devices, "free": max(free, 0)},
+           "tenants": by_tenant, "jobs": jobs}
+    serving = serving_status(os.path.abspath(workdir), jobs)
+    if serving:
+        out["serving"] = serving
+    return out
+
+
+def serving_status(workdir: str, jobs: list[dict]) -> dict[str, Any]:
+    """The serving-fleet half of a status view: per-model replica
+    counts (from serve-kind job rows), the autoscaler's last decision +
+    reason (``autoscale.json``), and the router table
+    (``router.json``) — both written atomically by the live fleet, so
+    this works on a dead one too.  Empty when the workdir never served."""
+    out: dict[str, Any] = {}
+    serve_jobs = [j for j in jobs if j.get("kind") == "serve"]
+    if serve_jobs:
+        models: dict[str, dict[str, int]] = {}
+        for j in serve_jobs:
+            key = j.get("model") or j["job"].rsplit("-", 1)[0]
+            m = models.setdefault(key, {"replicas": 0, "running": 0})
+            m["replicas"] += 1
+            if j["state"] in (RUNNING, PREEMPTING):
+                m["running"] += 1
+        out["models"] = models
+    for fname, key in (("autoscale.json", "autoscale"),
+                       ("router.json", "router")):
+        try:
+            with open(os.path.join(workdir, fname)) as f:
+                out[key] = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+    return out
 
 
 def format_status(status: Mapping[str, Any]) -> str:
@@ -1111,4 +1295,30 @@ def format_status(status: Mapping[str, Any]) -> str:
             f"{j['priority']:>5} {j['eff_priority']:>6.1f} "
             f"{j['world']:>4} {rnd:>3}/{j['rounds_target']:<3} "
             f"{j['episodes']:>3} {j['preempts']:>3}  {hb}")
+    serving = status.get("serving") or {}
+    auto = (serving.get("autoscale") or {}).get("models") or {}
+    for model, m in sorted((serving.get("models") or {}).items()):
+        line = (f"serving: {model:<20} replicas "
+                f"{m['running']}/{m['replicas']}")
+        rec = auto.get(model) or {}
+        last = rec.get("last")
+        if rec.get("backlog") is not None:
+            line += f" backlog {rec['backlog']}"
+        if last:
+            age = time.time() - (serving.get("autoscale") or {}).get(
+                "t", time.time())
+            line += (f" | last {last['action']} ({last['reason']})"
+                     + (f" {age:.0f}s ago" if age >= 1 else ""))
+        lines.append(line)
+    router = serving.get("router") or {}
+    for rid, r in sorted((router.get("replicas") or {}).items()):
+        lines.append(f"router:  {rid:<20} {r.get('state', '?'):<9} "
+                     f"out={r.get('outstanding', 0)} "
+                     f"done={r.get('completed', 0)} "
+                     f"fail={r.get('failed', 0)} "
+                     f"models={','.join(r.get('models') or [])}")
+    counts = router.get("counts")
+    if counts:
+        lines.append("router:  " + " ".join(
+            f"{k}={v}" for k, v in sorted(counts.items())))
     return "\n".join(lines)

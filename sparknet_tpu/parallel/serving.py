@@ -60,6 +60,11 @@ Env knobs (defaults in :class:`ServeConfig`):
   SPARKNET_SERVE_SHAPES       — compiled batch shapes (default 1,4,16,64).
   SPARKNET_SERVE_QUEUE        — admission bound on queued requests (256).
   SPARKNET_SERVE_HBM_MB       — model-house HBM budget (2048 MB).
+  SPARKNET_SERVE_FORCE_ADMIT  — 1 admits models larger than the whole
+                                budget (default: typed OverBudget).
+  SPARKNET_SERVE_QUOTAS       — tenant=qps[,tenant=qps...] caps (the
+                                env spelling of --quota; how fleet
+                                replicas inherit tenant caps).
   SPARKNET_SERVE_DTYPE        — compute dtype, bf16 (default) or f32.
   SPARKNET_SLO_P99_MS         — declared p99 bound (default: latency SLO
                                 undeclared).
@@ -115,6 +120,25 @@ class UnknownModel(ServingError):
     ``ModelHouse.load`` / the server's ``/v1/models/load``."""
 
 
+class OverBudget(ServingError):
+    """Typed load-time rejection: the model ALONE exceeds the house's
+    HBM budget (``SPARKNET_SERVE_HBM_MB``), so no amount of LRU eviction
+    could make it fit.  Raised before any warm-up compile is paid.
+    Override with ``ModelHouse.load(..., force=True)`` (the server's
+    ``{"force": true}`` load payload, or ``SPARKNET_SERVE_FORCE_ADMIT=1``
+    for every load) when oversubscribing HBM is a deliberate choice."""
+
+    def __init__(self, name: str, param_mb: float, budget_mb: float):
+        self.model = name
+        self.param_mb = param_mb
+        self.budget_mb = budget_mb
+        super().__init__(
+            f"model {name!r} needs {param_mb:.1f} MB of params but the "
+            f"HBM budget is {budget_mb:g} MB — it could never fit; "
+            f"load with force=True (or SPARKNET_SERVE_FORCE_ADMIT=1) to "
+            f"admit it anyway")
+
+
 # ---------------------------------------------------------------------------
 # Env knob parsing
 # ---------------------------------------------------------------------------
@@ -127,6 +151,25 @@ def _env_float(name: str, default: float) -> float:
         return float(raw)
     except ValueError:
         raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
+def _env_quotas(name: str) -> dict[str, float]:
+    """``SPARKNET_SERVE_QUOTAS=acme=200,beta=50`` -> {tenant: qps} (the
+    env spelling of ``--quota``, so fleet-launched replicas inherit
+    tenant caps with no per-replica CLI)."""
+    raw = os.environ.get(name, "")
+    quotas: dict[str, float] = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        tenant, _, qps = item.partition("=")
+        try:
+            quotas[tenant] = float(qps)
+        except ValueError:
+            raise ValueError(
+                f"{name} wants tenant=qps pairs, got {item!r}") from None
+    return quotas
 
 
 def _env_shapes(name: str, default: tuple[int, ...]) -> tuple[int, ...]:
@@ -167,7 +210,8 @@ class ServeConfig:
                                                "bf16"))
     # per-tenant offered-QPS caps (the fleet's tenant vocabulary; absent
     # tenant = uncapped, "*" caps every tenant without an explicit entry)
-    tenant_qps: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    tenant_qps: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: _env_quotas("SPARKNET_SERVE_QUOTAS"))
     beat_every_s: float = 1.0
     seed: int = 0
     # declared SLOs (see SLOMonitor): a p99 bound (None = latency SLO
@@ -301,7 +345,8 @@ class LoadedModel:
     request path never compiles)."""
 
     def __init__(self, name: str, net_param, cfg: ServeConfig,
-                 weights: str | None = None):
+                 weights: str | None = None,
+                 max_param_mb: float | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -321,6 +366,15 @@ class LoadedModel:
             from ..solvers.solver import load_weights_into
             self.params = load_weights_into(self.net, self.params, weights)
         self.weights = weights
+        self.param_bytes = sum(
+            np.asarray(b).nbytes for blobs in self.params.values()
+            for b in blobs)
+        # budget verdict BEFORE warm-up: an over-budget model is a typed
+        # rejection that never pays (or holds the house through) the
+        # per-shape compiles
+        if max_param_mb is not None and self.param_bytes > max_param_mb \
+                * 2**20:
+            raise OverBudget(name, self.param_bytes / 2**20, max_param_mb)
         out_blob = self.net.output_blobs[-1]
         self.classes = int(self.net.blob_shapes[out_blob][-1])
         # f32 result rows regardless of compute dtype: the demux hands
@@ -335,9 +389,6 @@ class LoadedModel:
             jax.block_until_ready(self._fwd(
                 self.params,
                 jnp.zeros((s,) + self.in_shape, jnp.float32)))
-        self.param_bytes = sum(
-            np.asarray(b).nbytes for blobs in self.params.values()
-            for b in blobs)
         from ..utils.profiling import fwd_cost_flops
         big = self.batch_shapes[-1]
         flops = fwd_cost_flops(
@@ -383,8 +434,11 @@ class ModelHouse:
     ``load`` builds + warm-up-compiles OUTSIDE the lock (loading model B
     must not stall serving model A), then admits it and LRU-evicts until
     the budget holds again (the newly loaded model is never the victim).
-    A single model larger than the whole budget is admitted alone with a
-    stderr note — refusing it would make the budget a denial of service.
+    A single model larger than the whole budget is a typed
+    :class:`OverBudget` rejection at load time, BEFORE any warm-up
+    compile — unless forced (``force=True`` per call, or
+    ``SPARKNET_SERVE_FORCE_ADMIT=1`` for every load), in which case it
+    is admitted alone with a stderr note.
     """
 
     def __init__(self, cfg: ServeConfig):
@@ -393,7 +447,8 @@ class ModelHouse:
         self._models: "OrderedDict[str, LoadedModel]" = OrderedDict()
         self.evictions = 0
 
-    def load(self, name: str, weights: str | None = None) -> LoadedModel:
+    def load(self, name: str, weights: str | None = None,
+             force: bool | None = None) -> LoadedModel:
         with self._lock:
             hit = self._models.get(name)
             if hit is not None and hit.weights == weights:
@@ -403,7 +458,11 @@ class ModelHouse:
         if name not in zoo:
             raise UnknownModel(
                 f"model {name!r} not in the zoo (known: {sorted(zoo)})")
-        lm = LoadedModel(name, zoo[name](), self.cfg, weights=weights)
+        if force is None:
+            force = os.environ.get("SPARKNET_SERVE_FORCE_ADMIT") == "1"
+        lm = LoadedModel(name, zoo[name](), self.cfg, weights=weights,
+                         max_param_mb=None if force
+                         else self.cfg.hbm_budget_mb)
         with self._lock:
             self._models[name] = lm
             self._models.move_to_end(name)
@@ -424,8 +483,8 @@ class ModelHouse:
         total = sum(m.param_bytes for m in self._models.values())
         if total > budget:
             print(f"[serving] model {keep!r} alone exceeds the "
-                  f"{self.cfg.hbm_budget_mb:.0f} MB HBM budget "
-                  f"({total / 2**20:.1f} MB) — admitted anyway",
+                  f"{self.cfg.hbm_budget_mb:g} MB HBM budget "
+                  f"({total / 2**20:.1f} MB) — force-admitted anyway",
                   file=sys.stderr)
 
     def get(self, name: str) -> LoadedModel:

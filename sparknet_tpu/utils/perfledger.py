@@ -49,12 +49,16 @@ LEDGER_RELPATH = os.path.join("perf", "LEDGER.jsonl")
 
 # fingerprint fields, in canonical key order
 FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
-                      "backend", "fuse_plan")
+                      "backend", "fuse_plan", "replicas")
 
 # entries written before the vertical fusion pass existed carry no
 # fuse_plan field; they were structurally unfused, so they pool with
-# today's explicit "off" captures instead of fragmenting the history
-_FINGERPRINT_DEFAULTS = {"fuse_plan": "off"}
+# today's explicit "off" captures instead of fragmenting the history.
+# Likewise entries before the serving fleet were single-engine captures:
+# they read as replicas=1 so the committed serving history keeps gating
+# against fresh single-engine runs, while fleet captures (replicas=N)
+# band separately.
+_FINGERPRINT_DEFAULTS = {"fuse_plan": "off", "replicas": 1}
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -89,13 +93,16 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
                 batch: int | None = None, world: int | None = None,
                 device: str | None = None,
                 backend: str | None = None,
-                fuse_plan: str | None = None) -> dict[str, Any]:
+                fuse_plan: str | None = None,
+                replicas: int | None = None) -> dict[str, Any]:
     """Canonical config fingerprint.  ``backend`` defaults to the
     platform half of ``device`` (``"tpu/TPU v5 lite"`` -> ``"tpu"``) —
     the field the baseline isolation hinges on.  ``fuse_plan`` is the
     vertical-fusion plan id (``Net.fuse_plan_id()``): a fused capture
     and an unfused one are different programs, so they must never pool
-    into one baseline band."""
+    into one baseline band.  ``replicas`` is the serving-fleet size —
+    a one-engine capture (the default, 1) and an N-replica routed
+    capture are different deployments with different qps bands."""
     if backend is None and device:
         backend = str(device).split("/", 1)[0]
     return {"model": model or "unknown", "dtype": dtype or "unknown",
@@ -103,7 +110,8 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
             "world": int(world) if world is not None else 1,
             "device": device or "unknown",
             "backend": backend or "unknown",
-            "fuse_plan": fuse_plan or "off"}
+            "fuse_plan": fuse_plan or "off",
+            "replicas": int(replicas) if replicas is not None else 1}
 
 
 def fp_key(fp: Mapping[str, Any]) -> str:
@@ -521,6 +529,40 @@ def entries_from_serving(doc: Mapping[str, Any], path: str | None = None, *,
                        round_tag=round_tag, t=t, **prov)]
 
 
+def entries_from_serving_fleet(doc: Mapping[str, Any],
+                               path: str | None = None, *,
+                               round_tag: str | None = None,
+                               t: float | None = None,
+                               device_hint: str | None = None
+                               ) -> list[dict]:
+    """serveload ``--fleet`` reports (BENCH_serving_fleet_*): N routed
+    replicas.  ``replicas`` rides the fingerprint, so these never pool
+    with (or pollute) the single-engine serving bands."""
+    if not doc or doc.get("error"):
+        return []
+    prov = _prov_fields(doc)
+    shapes = doc.get("batch_shapes") or []
+    fp = fingerprint(model=doc.get("model"), dtype=doc.get("dtype"),
+                     batch=max(shapes) if shapes else None, world=1,
+                     device=doc.get("device") or device_hint,
+                     replicas=doc.get("replicas"))
+    sat = doc.get("saturation") or {}
+    solo = doc.get("solo") or {}
+    v = doc.get("verdicts") or {}
+    metrics = {
+        "serve_fleet_sat_qps": sat.get("achieved_qps"),
+        "serve_fleet_sat_p99_ms": sat.get("p99_ms"),
+        "serve_fleet_solo_qps": solo.get("achieved_qps"),
+        "serve_fleet_speedup_x": v.get("fleet_scaling_x")
+        or doc.get("value"),
+        "serve_fleet_mismatches": v.get("exact_mismatches"),
+    }
+    return [make_entry("serving_fleet", path, fp,
+                       {k: val for k, val in metrics.items()
+                        if val is not None},
+                       round_tag=round_tag, t=t, **prov)]
+
+
 def entries_from_roundbench(doc: Mapping[str, Any],
                             path: str | None = None, *,
                             round_tag: str | None = None,
@@ -626,6 +668,9 @@ def entries_from_any(doc: Mapping[str, Any], path: str | None = None, *,
     if doc.get("metric") == "serving_dynamic_vs_batch1_speedup_x":
         return entries_from_serving(doc, path, round_tag=round_tag, t=t,
                                     device_hint=device_hint)
+    if doc.get("metric") == "serving_fleet_scaling_x":
+        return entries_from_serving_fleet(doc, path, round_tag=round_tag,
+                                          t=t, device_hint=device_hint)
     if "summary" in doc and "by_category" in doc:
         return entries_from_op_table(doc, path, round_tag=round_tag, t=t)
     if "stall_total_sync_s" in doc:

@@ -1,0 +1,746 @@
+"""Serving fleet coverage: the request router (consistent-hash home,
+depth spill, typed failover, drain fences), the SLO-driven autoscaler
+policy, serve-kind JobSpecs as first-class fleet tenants (release +
+preemption routed through drain hooks), and the replica-death-under-load
+chaos contract: kill a replica mid-sweep and the router fails over with
+typed errors only, zero hangs, and the survivors' answers stay
+bit-identical to solo references.
+
+Router/autoscaler units run on scripted stub clients and fake clocks;
+the under-load paths use real in-process engines (two replicas over one
+compiled lenet house — same kernels, distinct queues/dispatchers); the
+subprocess end-to-end (real serve.py replicas placed by the
+FleetScheduler, SIGKILL chaos, ResilientRunner healing) is the
+``run_tier1.sh --fleetservesmoke`` gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.parallel.autoscale import Autoscaler, AutoscaleConfig
+from sparknet_tpu.parallel.fleet import (
+    COMPLETED, PREEMPTING, QUEUED, RUNNING,
+    FleetJournal, FleetScheduler, JobSpec, format_status, offline_status,
+)
+from sparknet_tpu.parallel.router import (
+    InProcessReplica, Router, RouterConfig, RouterDrainHook, _hrw,
+)
+from sparknet_tpu.parallel.serving import (
+    EngineDead, InferenceEngine, ModelHouse, Overloaded, OverBudget,
+    ServeConfig, UnknownModel, run_closed_loop, solo_references,
+)
+
+pytestmark = pytest.mark.router
+
+
+# ---------------------------------------------------------------------------
+# Stub transport (no jax): scriptable replica clients
+# ---------------------------------------------------------------------------
+
+class StubFuture:
+    def __init__(self, value=None, error=None, gate=None):
+        self.value = value
+        self.error = error
+        self.gate = gate            # threading.Event to wait on
+
+    def done(self):
+        return self.gate is None or self.gate.is_set()
+
+    def result(self, timeout=None):
+        if self.gate is not None and not self.gate.wait(
+                timeout if timeout is not None else 30.0):
+            raise TimeoutError("stub future never released")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class StubClient:
+    """Replica client with scriptable behavior per submit."""
+
+    def __init__(self, rid, models=("m",), behavior=None):
+        self.rid = rid
+        self.models = frozenset(models)
+        self.behavior = behavior     # callable(model, x, tenant) -> future
+        self.calls = 0
+
+    def submit(self, model, x, tenant):
+        self.calls += 1
+        if self.behavior is not None:
+            return self.behavior(model, x, tenant)
+        return StubFuture(value=(self.rid, float(np.sum(x))))
+
+    def alive(self):
+        return True
+
+    def describe(self):
+        return {"transport": "stub"}
+
+
+def router_with(clients, **cfg) -> Router:
+    r = Router(RouterConfig(**cfg))
+    for c in clients:
+        r.add_replica(c.rid, c)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Placement: rendezvous home + depth spill
+# ---------------------------------------------------------------------------
+
+def test_home_is_stable_and_rehomes_only_on_membership_change():
+    clients = [StubClient(f"r{i}") for i in range(4)]
+    r = router_with(clients)
+    home = r.home("m")
+    assert all(r.home("m") == home for _ in range(10))
+    # the analytic answer: highest rendezvous hash wins
+    assert home == max((c.rid for c in clients),
+                       key=lambda rid: _hrw("m", rid))
+    # removing a non-home replica does not move the model
+    bystander = next(c.rid for c in clients if c.rid != home)
+    r.mark_dead(bystander, "test")
+    assert r.home("m") == home
+    # removing the home re-homes deterministically to the runner-up
+    r.mark_dead(home, "test")
+    survivors = [c.rid for c in clients
+                 if c.rid not in (home, bystander)]
+    assert r.home("m") == max(survivors,
+                              key=lambda rid: _hrw("m", rid))
+
+
+def test_requests_ride_home_until_spill_depth_then_least_loaded():
+    gate = threading.Event()
+    clients = [StubClient(f"r{i}",
+                          behavior=lambda m, x, t: StubFuture(
+                              value="held", gate=gate))
+               for i in range(3)]
+    r = router_with(clients, spill_depth=4)
+    home = r.home("m")
+    futs = [r.submit("m", np.ones(2)) for _ in range(4)]
+    # below the spill depth everything rode the home replica
+    assert r.outstanding(home) == 4
+    assert r.counts["spills"] == 0
+    spilled = [r.submit("m", np.ones(2)) for _ in range(3)]
+    assert r.counts["spills"] == 3, "deep home queue must spill"
+    assert r.outstanding(home) == 4      # spill went elsewhere
+    others = [c.rid for c in clients if c.rid != home]
+    assert sum(r.outstanding(o) for o in others) == 3
+    gate.set()
+    for f in futs + spilled:
+        f.result(5.0)
+    assert r.outstanding(home) == 0
+
+
+def test_unknown_model_typed():
+    r = router_with([StubClient("r0", models=("m",))])
+    with pytest.raises(UnknownModel, match="no replica serves"):
+        r.submit("nope", np.ones(2))
+
+
+# ---------------------------------------------------------------------------
+# Failover: typed, bounded, never a hang
+# ---------------------------------------------------------------------------
+
+def _home_first(a: str, b: str) -> tuple[str, str]:
+    """(home, other) for model "m" — so tests can pin the failing
+    replica onto the placement path deterministically."""
+    return (a, b) if _hrw("m", a) > _hrw("m", b) else (b, a)
+
+
+def test_submit_failover_on_dead_replica():
+    bad_rid, ok_rid = _home_first("a", "b")
+    dead = StubClient(bad_rid, behavior=lambda m, x, t: (_ for _ in ()
+                      ).throw(EngineDead("gone")))
+    ok = StubClient(ok_rid)
+    r = router_with([dead, ok])
+    # the home replica is dead: every request must land on the survivor
+    for _ in range(6):
+        res = r.classify("m", np.ones(2), timeout=5.0)
+        assert res[0] == ok_rid
+    assert r.stats()["gone"][bad_rid]["state"] == "DEAD"
+    assert r.counts["failovers"] >= 1
+
+
+def test_mid_request_death_fails_over_in_result():
+    bad_rid, ok_rid = _home_first("a", "b")
+    boom = EngineDead("died mid-request")
+    flaky = StubClient(bad_rid,
+                       behavior=lambda m, x, t: StubFuture(error=boom))
+    ok = StubClient(ok_rid)
+    r = router_with([flaky, ok])
+    t0 = time.monotonic()
+    res = r.submit("m", np.ones(2)).result(10.0)
+    assert res[0] == ok_rid
+    assert time.monotonic() - t0 < 5.0
+    assert r.counts["deaths"] >= 1
+    assert flaky.calls == 1     # it accepted, then died mid-request
+
+
+def test_all_replicas_dead_is_typed_never_hangs():
+    mk = lambda rid: StubClient(rid, behavior=lambda m, x, t: (
+        _ for _ in ()).throw(EngineDead(f"{rid} down")))
+    r = router_with([mk("a"), mk("b"), mk("c")], max_failovers=5)
+    t0 = time.monotonic()
+    with pytest.raises(EngineDead, match="no live replica|failed over"):
+        r.classify("m", np.ones(2), timeout=10.0)
+    assert time.monotonic() - t0 < 5.0
+    assert r.replica_ids() == []
+
+
+def test_overload_spills_once_then_propagates_typed():
+    always_full = lambda rid: StubClient(rid, behavior=lambda m, x, t: (
+        _ for _ in ()).throw(Overloaded("queue_full", rid)))
+    a, b = always_full("a"), always_full("b")
+    r = router_with([a, b])
+    with pytest.raises(Overloaded):
+        r.submit("m", np.ones(2))
+    # both replicas were offered the work before the typed answer
+    assert a.calls == 1 and b.calls == 1
+    # one full + one free replica: the spill absorbs the rejection
+    r2 = router_with([always_full("full"), StubClient("free")])
+    res = r2.classify("m", np.ones(2), timeout=5.0)
+    assert res[0] == "free"
+
+
+# ---------------------------------------------------------------------------
+# Drain: fence, settle, release
+# ---------------------------------------------------------------------------
+
+def test_drain_fences_placement_and_waits_for_outstanding():
+    gate = threading.Event()
+    mk = lambda rid: StubClient(rid, behavior=lambda m, x, t: StubFuture(
+        value=rid, gate=gate))
+    r = router_with([mk("a"), mk("b")])
+    victim = r.home("m")
+    other = "a" if victim == "b" else "b"
+    held = r.submit("m", np.ones(2))          # rides home == victim
+    assert held._rep.rid == victim
+    hook = RouterDrainHook(r, victim)
+    hook.start()
+    # fenced: new requests never land on the draining replica
+    fenced = [r.submit("m", np.ones(2)) for _ in range(4)]
+    assert all(f._rep.rid == other for f in fenced)
+    assert hook.done() is False, "outstanding work blocks the drain"
+    gate.set()
+    held.result(5.0)
+    for f in fenced:
+        f.result(5.0)
+    assert hook.done() is True
+    assert r.stats()["gone"][victim]["state"] == "RELEASED"
+    assert hook.done() is True      # idempotent after release
+
+
+def test_blocking_drain_times_out_dirty_but_releases():
+    gate = threading.Event()
+    slow = StubClient("slow", behavior=lambda m, x, t: StubFuture(
+        value="slow", gate=gate))
+    r = router_with([slow])
+    r.submit("m", np.ones(2))
+    assert r.drain("slow", timeout_s=0.2) is False
+    assert "slow" in r.stats()["gone"]
+    gate.set()
+
+
+def test_router_config_validation_and_env(monkeypatch):
+    with pytest.raises(ValueError, match="spill_depth"):
+        RouterConfig(spill_depth=0)
+    with pytest.raises(ValueError, match="max_failovers"):
+        RouterConfig(max_failovers=-1)
+    with pytest.raises(ValueError, match="drain_grace_s"):
+        RouterConfig(drain_grace_s=0)
+    monkeypatch.setenv("SPARKNET_ROUTER_SPILL_DEPTH", "7")
+    monkeypatch.setenv("SPARKNET_ROUTER_FAILOVERS", "5")
+    cfg = RouterConfig()
+    assert cfg.spill_depth == 7 and cfg.max_failovers == 5
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler policy (scripted stats, fake clock)
+# ---------------------------------------------------------------------------
+
+class Scaler:
+    """Autoscaler rig with one mutable stats doc + action recorders."""
+
+    def __init__(self, tmp_path, *, up_ok=True, **cfg_over):
+        self.now = 0.0
+        self.stats = {"m": [self._rep("r0")]}
+        self.ups: list[str] = []
+        self.downs: list[str] = []
+        self.up_ok = up_ok
+        cfg = AutoscaleConfig(**{
+            "min_replicas": 1, "max_replicas": 3, "up_queue": 8.0,
+            "down_idle_s": 5.0, "cooldown_s": 4.0,
+            "sample_every_s": 1.0, **cfg_over})
+        self.state_path = str(tmp_path / "autoscale.json")
+        self.auto = Autoscaler(
+            lambda: self.stats,
+            lambda m: (self.ups.append(m), self.up_ok)[1],
+            lambda m: (self.downs.append(m), "r0")[1],
+            cfg=cfg, state_path=self.state_path,
+            clock=lambda: self.now)
+
+    @staticmethod
+    def _rep(rid, queue=0, outstanding=0, rejected=0, breach=False):
+        return {"rid": rid, "queue_depth": queue,
+                "outstanding": outstanding, "rejected_total": rejected,
+                "slo_breach": breach}
+
+
+def test_autoscale_up_on_backlog_with_cooldown(tmp_path):
+    s = Scaler(tmp_path)
+    s.stats["m"] = [s._rep("r0", queue=20)]
+    (dec,) = s.auto.evaluate()
+    assert dec["action"] == "up" and s.ups == ["m"]
+    assert "backlog" in dec["reason"]
+    s.now = 2.0                       # inside cooldown: hold
+    assert s.auto.evaluate() == []
+    s.now = 6.0                       # cooldown over, still burning
+    (dec,) = s.auto.evaluate()
+    assert dec["action"] == "up" and len(s.ups) == 2
+
+
+def test_autoscale_up_on_slo_breach_and_rejections(tmp_path):
+    s = Scaler(tmp_path)
+    s.stats["m"] = [s._rep("r0", breach=True)]
+    (dec,) = s.auto.evaluate()
+    assert dec["action"] == "up" and "SLO breach" in dec["reason"]
+    s2 = Scaler(tmp_path)
+    s2.stats["m"] = [s2._rep("r0", rejected=10)]
+    (dec,) = s2.auto.evaluate()
+    assert dec["action"] == "up" and "rejections" in dec["reason"]
+    # the counter is cumulative: no NEW rejections, no new pressure
+    s2.now = 10.0
+    assert s2.auto.evaluate() == []
+
+
+def test_autoscale_blocked_by_budget_is_recorded(tmp_path):
+    s = Scaler(tmp_path, up_ok=False)
+    s.stats["m"] = [s._rep("r0", queue=50)]
+    (dec,) = s.auto.evaluate()
+    assert dec["action"] == "up_blocked"
+    assert "budget" in dec["reason"]
+    assert s.auto.last["m"]["action"] == "up_blocked"
+
+
+def test_autoscale_hold_at_max_then_down_after_idle(tmp_path):
+    s = Scaler(tmp_path)
+    s.stats["m"] = [s._rep(f"r{i}", queue=30) for i in range(3)]
+    (dec,) = s.auto.evaluate()
+    assert dec["action"] == "hold_at_max"
+    # quiet now: idle clock starts, down only after the idle window
+    s.stats["m"] = [s._rep(f"r{i}") for i in range(3)]
+    s.now = 10.0
+    assert s.auto.evaluate() == []
+    s.now = 13.0
+    assert s.auto.evaluate() == []
+    s.now = 16.0
+    (dec,) = s.auto.evaluate()
+    assert dec["action"] == "down" and s.downs == ["m"]
+    # never below the floor
+    s.stats["m"] = [s._rep("r0")]
+    s.now = 40.0
+    assert s.auto.evaluate() == []
+
+
+def test_autoscale_persists_state_json(tmp_path):
+    s = Scaler(tmp_path)
+    s.stats["m"] = [s._rep("r0", queue=20)]
+    s.auto.evaluate()
+    doc = json.load(open(s.state_path))
+    assert doc["models"]["m"]["replicas"] == 1
+    assert doc["models"]["m"]["last"]["action"] == "up"
+    assert doc["config"]["max_replicas"] == 3
+
+
+def test_autoscale_config_validation():
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscaleConfig(min_replicas=4, max_replicas=2)
+    with pytest.raises(ValueError, match="up_queue"):
+        AutoscaleConfig(up_queue=0)
+    with pytest.raises(ValueError, match="down_idle_s"):
+        AutoscaleConfig(down_idle_s=0)
+
+
+# ---------------------------------------------------------------------------
+# Serve-kind JobSpecs + drain-hooked release/preempt in the scheduler
+# ---------------------------------------------------------------------------
+
+def test_serve_jobspec_grammar_and_cmd():
+    spec = JobSpec(name="serve-lenet-0", kind="serve", model="lenet",
+                   world=1, timeout_s=None)
+    again = JobSpec.from_json(json.loads(json.dumps(spec.to_json())))
+    assert again == spec
+    with pytest.raises(ValueError, match="kind"):
+        JobSpec(name="x", kind="batch")
+    # serve jobs are exempt from the {out} rule and the driver-model
+    # check (any zoo name, validated by the replica process)
+    JobSpec(name="s", kind="serve", model="googlenet",
+            cmd=("prog", "--endpoint", "{endpoint}"))
+    with pytest.raises(ValueError, match="out"):
+        JobSpec(name="t", kind="train", cmd=("prog",))
+
+
+def test_serve_build_cmd_publishes_endpoint(tmp_path):
+    from sparknet_tpu.parallel.fleet import FleetJob
+    spec = JobSpec(name="serve-lenet-0", kind="serve", model="lenet",
+                   world=1)
+    job = FleetJob(spec, str(tmp_path / "j"), 0, 0.0)
+    cmd = job.build_cmd()
+    assert "serve.py" in cmd[1]
+    assert cmd[cmd.index("--models") + 1] == "lenet"
+    assert cmd[cmd.index("--endpoint-file") + 1] == job.endpoint_path
+    assert "--port" in cmd and cmd[cmd.index("--port") + 1] == "0"
+    assert job.completed_ok() is False   # serve jobs never self-complete
+
+
+class HeldRunner:
+    """FakeRunner that ignores cancel (workers keep 'running' until the
+    test releases them) — how a draining replica behaves."""
+
+    def __init__(self, job):
+        self.job = job
+        self.release = threading.Event()
+        self.canceled = False
+        self.failure = None
+        self.rc = 0
+        self.workdir = os.path.join(job.job_dir, "runner")
+
+    def cancel(self):
+        self.canceled = True
+
+    def run(self):
+        assert self.release.wait(timeout=30)
+        return self.rc
+
+
+class FakeHook:
+    def __init__(self):
+        self.started = False
+        self.done_flag = False
+
+    def start(self):
+        self.started = True
+
+    def done(self):
+        return self.done_flag
+
+
+def serve_fleet(tmp_path, **kw):
+    runners = {}
+
+    def factory(job, cmd, env):
+        r = HeldRunner(job)
+        runners.setdefault(job.name, []).append(r)
+        return r
+
+    sched = FleetScheduler(str(tmp_path / "fleet"), 4,
+                           runner_factory=factory,
+                           preempt_grace_s=5.0, **kw)
+    return sched, runners
+
+
+def settle(sched, cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sched.step()
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError("condition never settled")
+
+
+def test_release_routes_through_drain_then_completes(tmp_path):
+    sched, runners = serve_fleet(tmp_path, drain_grace_s=30.0)
+    job = sched.submit(JobSpec(name="serve-a", kind="serve",
+                               model="lenet", world=1, timeout_s=None))
+    hook = FakeHook()
+    sched.register_drain_hook("serve-a", hook)
+    sched.step()
+    assert job.state == RUNNING
+    sched.release_job("serve-a")
+    assert job.state == PREEMPTING and hook.started
+    assert job.drain_deadline is not None
+    sched.step()
+    # still draining: the SIGTERM window must NOT have opened
+    assert job.preempt_deadline is None
+    assert runners["serve-a"][0].canceled     # but restarts are off
+    hook.done_flag = True
+    sched.step()
+    assert job.drain_deadline is None
+    assert job.preempt_deadline is not None   # now the SIGTERM path
+    runners["serve-a"][0].release.set()       # worker exits cleanly
+    settle(sched, lambda: job.state == COMPLETED)
+    events = [e["ev"] for e in FleetJournal.read(sched.journal.path)]
+    assert ["release" in events, "drain" in events,
+            "drain_done" in events] == [True, True, True]
+    assert events.index("drain") < events.index("drain_done")
+    completes = [e for e in FleetJournal.read(sched.journal.path)
+                 if e["ev"] == "complete"]
+    assert completes and completes[-1].get("released") is True
+
+
+def test_release_drain_deadline_expires_dirty(tmp_path):
+    sched, runners = serve_fleet(tmp_path, drain_grace_s=0.05)
+    job = sched.submit(JobSpec(name="serve-a", kind="serve",
+                               model="lenet", world=1, timeout_s=None))
+    hook = FakeHook()                        # never reports done
+    sched.register_drain_hook("serve-a", hook)
+    sched.step()
+    sched.release_job("serve-a")
+    time.sleep(0.1)
+    sched.step()                             # deadline passed: escalate
+    assert job.drain_deadline is None
+    assert job.preempt_deadline is not None
+    drain_done = [e for e in FleetJournal.read(sched.journal.path)
+                  if e["ev"] == "drain_done"]
+    assert drain_done and drain_done[-1]["ok"] is False
+    runners["serve-a"][0].release.set()
+    settle(sched, lambda: job.state == COMPLETED)
+
+
+def test_preempt_serve_job_drains_then_requeues(tmp_path):
+    sched, runners = serve_fleet(tmp_path, drain_grace_s=30.0)
+    job = sched.submit(JobSpec(name="serve-a", kind="serve",
+                               model="lenet", world=1, timeout_s=None))
+    hook = FakeHook()
+    sched.register_drain_hook("serve-a", hook)
+    sched.step()
+    sched.preempt_job(job, by="big-training-job")
+    assert job.state == PREEMPTING and hook.started
+    hook.done_flag = True
+    sched.step()
+    runners["serve-a"][0].release.set()
+    # preemption (not release): the replica REQUEUES to come back when
+    # capacity frees — and relaunches as a fresh episode
+    settle(sched, lambda: job.state in (QUEUED, RUNNING))
+    assert job.preempt_count == 1
+    assert job.state == RUNNING     # capacity was free: relaunched
+    assert len(runners["serve-a"]) == 2
+
+
+def test_release_of_queued_job_completes_without_signals(tmp_path):
+    sched, _ = serve_fleet(tmp_path)
+    # world > budget free after filler occupies it
+    filler = sched.submit(JobSpec(name="filler", kind="serve",
+                                  model="lenet", world=4,
+                                  timeout_s=None))
+    sched.step()
+    assert filler.state == RUNNING
+    job = sched.submit(JobSpec(name="serve-q", kind="serve",
+                               model="lenet", world=1, timeout_s=None))
+    sched.step()
+    assert job.state == QUEUED
+    sched.release_job("serve-q")
+    assert job.state == COMPLETED
+
+
+def test_offline_status_and_resume_after_release(tmp_path):
+    sched, runners = serve_fleet(tmp_path)
+    job = sched.submit(JobSpec(name="serve-a", kind="serve",
+                               model="lenet", world=1, timeout_s=None))
+    hook = FakeHook()
+    hook.done_flag = True
+    sched.register_drain_hook("serve-a", hook)
+    sched.step()
+    sched.release_job("serve-a")
+    sched.step()
+    runners["serve-a"][0].release.set()
+    settle(sched, lambda: job.state == COMPLETED)
+    workdir = sched.workdir
+    st = offline_status(workdir)
+    (row,) = st["jobs"]
+    assert row["kind"] == "serve" and row["state"] == COMPLETED
+    sched.journal.close()
+    # resume: the released replica must STAY completed (no out artifact
+    # exists — the journal's word is the completion proof for serve)
+    resumed = FleetScheduler.resume(
+        workdir, runner_factory=lambda j, c, e: HeldRunner(j))
+    assert resumed.jobs["serve-a"].state == COMPLETED
+
+
+def test_status_surfaces_router_and_autoscale_state(tmp_path):
+    workdir = tmp_path / "fleet"
+    workdir.mkdir()
+    (workdir / "autoscale.json").write_text(json.dumps({
+        "t": time.time(),
+        "models": {"lenet": {"replicas": 2, "backlog": 9,
+                             "last": {"action": "up",
+                                      "reason": "backlog 9.0/replica "
+                                                ">= 8", "at": 1.0}}}}))
+    (workdir / "router.json").write_text(json.dumps({
+        "replicas": {"serve-lenet-0": {
+            "state": "ACTIVE", "outstanding": 3, "completed": 41,
+            "failed": 0, "models": ["lenet"]}},
+        "counts": {"requests": 44, "spills": 2, "failovers": 1,
+                   "rejections": 0, "deaths": 1, "drains": 0}}))
+    jobs = [{
+        "job": "serve-lenet-0", "kind": "serve", "model": "lenet",
+        "tenant": "serving",
+        "state": RUNNING, "priority": 0, "eff_priority": 0.0,
+        "world": 1, "slots": [0], "episodes": 1, "attempts": 1,
+        "preempts": 0, "round": None, "rounds_target": 1,
+        "heartbeats": {0: {"round": 7, "phase": "serving", "age_s": 0.2,
+                           "extras": {"serving": True, "queue_depth": 3,
+                                      "in_flight": 2, "p50_ms": 5.0,
+                                      "p99_ms": 12.0,
+                                      "models": ["lenet"]}}},
+        "metrics": {}, "metrics_note": "",
+    }]
+    from sparknet_tpu.parallel.fleet import serving_status
+    serving = serving_status(str(workdir), jobs)
+    assert serving["models"]["lenet"]["running"] == 1
+    assert serving["autoscale"]["models"]["lenet"]["last"]["action"] \
+        == "up"
+    table = format_status({
+        "devices": {"total": 4, "free": 3},
+        "tenants": {"serving": {"used": 1, "quota": None}},
+        "jobs": jobs, "serving": serving})
+    assert "serving: lenet" in table
+    assert "last up (backlog 9.0/replica >= 8)" in table
+    assert "router:  serve-lenet-0" in table and "out=3" in table
+    assert "failovers=1" in table
+    # per-replica queue depth rides the job row's serving beacon fold
+    assert "q3+2" in table
+
+
+# ---------------------------------------------------------------------------
+# Replica death under load (in-process engines; the chaos satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lenet_house():
+    cfg = ServeConfig(batch_shapes=(1, 4, 8), max_delay_ms=3.0,
+                      max_queue=64, dtype="f32", beat_every_s=10.0)
+    house = ModelHouse(cfg)
+    house.load("lenet")
+    return house
+
+
+def two_replica_router(house):
+    r = Router(RouterConfig(spill_depth=8, max_failovers=3))
+    engines = []
+    for i in range(2):
+        eng = InferenceEngine(house, house.cfg)
+        engines.append(eng)
+        r.add_replica(f"rep{i}", InProcessReplica(f"rep{i}", eng))
+    return r, engines
+
+
+def test_replica_death_under_load_typed_failover_exact(lenet_house):
+    """Kill one of two live replicas mid-sweep: zero hangs, zero
+    non-typed errors, every completed answer bit-identical to its solo
+    reference, and the router records the death + failovers."""
+    rng = np.random.default_rng(0)
+    inputs = [rng.normal(size=(1, 28, 28)).astype(np.float32)
+              for _ in range(8)]
+    refs = solo_references(lenet_house.get("lenet"), inputs)
+    r, engines = two_replica_router(lenet_house)
+    victim_idx = int(r.home("lenet")[-1])
+    killer = threading.Timer(
+        0.4, lambda: engines[victim_idx].stop())
+    killer.start()
+    t0 = time.monotonic()
+    rep = run_closed_loop(
+        None, "lenet", inputs, clients=4, window=2, duration_s=1.2,
+        refs=refs, timeout_s=15.0,
+        submit=lambda idx, x: r.submit("lenet", x, tenant="chaos"))
+    wall = time.monotonic() - t0
+    killer.join()
+    try:
+        assert wall < 10.0, f"sweep wall {wall:.1f}s — something hung"
+        assert rep["errors"] == 0, \
+            f"{rep['errors']} requests errored past failover"
+        assert rep["exact_mismatches"] == 0
+        assert rep["completed"] > 0
+        st = r.stats()
+        assert st["counts"]["deaths"] >= 1
+        assert st["gone"][f"rep{victim_idx}"]["state"] == "DEAD"
+        # the survivor is still routable after the sweep
+        res = r.classify("lenet", inputs[0], timeout=10.0)
+        assert np.array_equal(res.probs, refs[res.padded_to][0])
+    finally:
+        for eng in engines:
+            eng.stop()
+
+
+def test_both_replicas_dead_mid_load_typed_not_hang(lenet_house):
+    r, engines = two_replica_router(lenet_house)
+    x = np.zeros((1, 28, 28), np.float32)
+    r.classify("lenet", x, timeout=10.0)       # warm path works
+    for eng in engines:
+        eng.stop()
+    t0 = time.monotonic()
+    with pytest.raises(EngineDead):
+        r.classify("lenet", x, timeout=10.0)
+    assert time.monotonic() - t0 < 8.0
+
+
+# ---------------------------------------------------------------------------
+# OverBudget: typed load-time rejection + force override
+# ---------------------------------------------------------------------------
+
+def test_overbudget_typed_rejection_and_force(capsys):
+    cfg = ServeConfig(batch_shapes=(1,), max_delay_ms=1.0, dtype="f32",
+                      hbm_budget_mb=0.5)
+    house = ModelHouse(cfg)
+    with pytest.raises(OverBudget, match="force=True"):
+        house.load("lenet")
+    assert house.loaded() == {}, "a rejected model must not be admitted"
+    lm = house.load("lenet", force=True)
+    assert lm.param_bytes > 0.5 * 2**20
+    assert set(house.loaded()) == {"lenet"}
+    assert "force-admitted" in capsys.readouterr().err
+
+
+def test_overbudget_env_force_knob(monkeypatch):
+    monkeypatch.setenv("SPARKNET_SERVE_FORCE_ADMIT", "1")
+    cfg = ServeConfig(batch_shapes=(1,), max_delay_ms=1.0, dtype="f32",
+                      hbm_budget_mb=0.5)
+    house = ModelHouse(cfg)
+    assert house.load("lenet").name == "lenet"
+
+
+# ---------------------------------------------------------------------------
+# Perf ledger: replicas joins the fingerprint without fragmenting history
+# ---------------------------------------------------------------------------
+
+def test_replicas_fingerprint_pools_single_engine_history():
+    from sparknet_tpu.utils import perfledger as pl
+    old_entry_fp = {"model": "lenet", "dtype": "bf16", "batch": 8,
+                    "world": 1, "device": "cpu/cpu", "backend": "cpu"}
+    fresh_single = pl.fingerprint(model="lenet", dtype="bf16", batch=8,
+                                  world=1, device="cpu/cpu")
+    fleet3 = pl.fingerprint(model="lenet", dtype="bf16", batch=8,
+                            world=1, device="cpu/cpu", replicas=3)
+    # pre-fleet entries read as replicas=1: history keeps gating
+    assert pl.fp_key(old_entry_fp) == pl.fp_key(fresh_single)
+    assert pl.fp_key(fleet3) != pl.fp_key(fresh_single)
+
+
+def test_fleet_report_ingests_with_replica_fingerprint():
+    from sparknet_tpu.utils import perfledger as pl
+    doc = {
+        "metric": "serving_fleet_scaling_x", "model": "lenet",
+        "replicas": 3, "dtype": "bf16", "batch_shapes": [1, 4, 8],
+        "device": "cpu/cpu", "value": 0.91,
+        "solo": {"achieved_qps": 240.0},
+        "saturation": {"achieved_qps": 655.0, "p99_ms": 18.0},
+        "verdicts": {"fleet_scaling_x": 0.91, "exact_mismatches": 0},
+    }
+    (entry,) = pl.entries_from_any(doc, "BENCH_serving_fleet_r11.json")
+    assert entry["fp"]["replicas"] == 3
+    assert entry["metrics"]["serve_fleet_sat_qps"] == 655.0
+    assert entry["metrics"]["serve_fleet_speedup_x"] == 0.91
+    assert entry["metrics"]["serve_fleet_mismatches"] == 0
+    assert entry["round"] == "r11"
+    # directions: qps up-good, mismatches down-good, both gateable
+    assert pl.higher_is_better("serve_fleet_sat_qps") is True
+    assert pl.higher_is_better("serve_fleet_mismatches") is False

@@ -25,7 +25,12 @@ Usage:
 telemetry registry snapshots the workers wrote — no scheduler process
 needed, nothing is launched or signalled.  ``--json`` emits the same
 data as one machine-readable JSON document so external scrapers never
-parse the human table.
+parse the human table.  A workdir that hosts a serving fleet
+(``tools/serve.py --fleet`` / serve-kind jobs) additionally gets the
+serving rows: per-model replica counts, the autoscaler's last scale
+decision + reason (``autoscale.json``), the router table with
+per-replica state/outstanding/failure counts (``router.json``), and
+per-replica queue depth folded from the serving beacon extras.
 
 Exit code 0 when every job completed; 3 when any was quarantined (each
 leaves a ``postmortem.json`` in its job dir).

@@ -504,6 +504,11 @@ def build_trajectory(ledger: pl.PerfLedger) -> dict:
                            "serve_overload_p99_ms"))
             row.setdefault("sha", e.get("sha"))
             row.setdefault("device", fp.get("device"))
+        elif src == "serving_fleet":
+            row.update(fleet_sat_qps=m.get("serve_fleet_sat_qps"),
+                       fleet_replicas=fp.get("replicas"))
+            row.setdefault("sha", e.get("sha"))
+            row.setdefault("device", fp.get("device"))
     ordered = [rounds[t] for t in sorted(rounds, key=pl._round_sort_key)]
     return {"generated_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
             "git_sha": pl.git_sha(),
@@ -530,20 +535,26 @@ def render_trajectory_md(traj: dict) -> str:
         "by hand.",
         "",
         "| round | sha | device | config | train img/s | MFU | "
-        "eval img/s | feed img/s | serve qps (sat) | overload p99 ms |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "eval img/s | feed img/s | serve qps (sat) | overload p99 ms | "
+        "fleet qps (N) |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in traj["rounds"]:
         cfg = "—"
         if r.get("model"):
             cfg = f"{r['model']}/{r.get('dtype')}/b{r.get('batch')}"
+        fleet = "—"
+        if r.get("fleet_sat_qps") is not None:
+            fleet = (f"{r['fleet_sat_qps']:g} "
+                     f"(x{r.get('fleet_replicas')})")
         lines.append(
-            "| {} | {} | {} | {} | {} | {} | {} | {} | {} | {} |".format(
+            "| {} | {} | {} | {} | {} | {} | {} | {} | {} | {} | {} |"
+            .format(
                 r["round"], r.get("sha") or "—", r.get("device") or "—",
                 cfg, _fmt(r.get("train_img_s")), _fmt(r.get("mfu")),
                 _fmt(r.get("eval_img_s")), _fmt(r.get("feed_img_s")),
                 _fmt(r.get("serve_sat_qps")),
-                _fmt(r.get("serve_overload_p99_ms"))))
+                _fmt(r.get("serve_overload_p99_ms")), fleet))
     lines += ["", _TRAJ_END]
     return "\n".join(lines)
 

@@ -81,6 +81,22 @@ maybe_servesmoke() {
   fi
 }
 
+# Serving-fleet smoke (tools/serveload.py --fleet 2 --smoke) — opt-in
+# via SPARKNET_FLEETSERVESMOKE=1.  Two replica subprocesses placed as
+# serve-kind fleet tenants behind the request router: paced load must
+# stay error-free and bit-identical to local solo references, a
+# SIGKILLed replica must fail over typed-only (zero request errors,
+# zero hangs) and heal back to N, and a mid-load scale-down must drain
+# losslessly to COMPLETED.  (~10 s on a multicore rig; single-core CI
+# boxes pay replica startup serially, hence the generous timeout.)
+maybe_fleetservesmoke() {
+  if [ "${SPARKNET_FLEETSERVESMOKE:-}" = "1" ]; then
+    timeout -k 10 480 env JAX_PLATFORMS=cpu \
+      python tools/serveload.py --fleet 2 --smoke \
+      --out /tmp/_fleetservesmoke.json > /dev/null
+  fi
+}
+
 # ~10-second observability smoke (tools/obs.py smoke) — opt-in via
 # SPARKNET_OBSSMOKE=1.  Runs a 2-round training per rank (two driver
 # runs sharing one SPARKNET_RUN_ID) plus a live tools/serve.py driven
@@ -144,15 +160,18 @@ case "${1:-}" in
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
   --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
+  --fleetservesmoke) SPARKNET_FLEETSERVESMOKE=1 maybe_fleetservesmoke ;;
   --obssmoke) SPARKNET_OBSSMOKE=1 maybe_obssmoke ;;
   --perfgate) SPARKNET_PERFGATE=1 maybe_perfgate ;;
   --fusebench) SPARKNET_FUSEBENCH=1 maybe_fusebench ;;
   --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
-             && maybe_feedbench && maybe_servesmoke && maybe_roundbench \
+             && maybe_feedbench && maybe_servesmoke \
+             && maybe_fleetservesmoke && maybe_roundbench \
              && maybe_obssmoke && maybe_fusebench && maybe_perfgate ;;
   "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
-             && maybe_servesmoke && maybe_roundbench && maybe_obssmoke \
+             && maybe_servesmoke && maybe_fleetservesmoke \
+             && maybe_roundbench && maybe_obssmoke \
              && maybe_fusebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--obssmoke|--fusebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac

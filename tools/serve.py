@@ -40,6 +40,23 @@ Usage:
 With SPARKNET_HEARTBEAT_DIR set (e.g. by the fleet launcher), the
 engine publishes serving beacons (queue depth, in-flight, p50/p99) that
 ``tools/fleet.py status`` folds into the fleet table.
+
+``--fleet N`` switches to fleet mode (WALKTHROUGH §6.14): N replica
+subprocesses per model, each THIS program in single mode on an
+ephemeral port, placed as ``JobSpec(kind="serve")`` tenants by the
+fleet scheduler; the front serves the request router (consistent-hash
+home, depth spill, typed failover, drain-before-stop) plus fleet
+observability:
+  GET  /healthz            router table + device budget (503 when no
+                           live replica remains).
+  GET  /slo[?model=m]      per-replica SLO verdicts, 200 only while
+                           every (scoped) replica's declared SLO holds.
+  GET  /fleet              the scheduler's status document.
+  POST /v1/scale           {"model": m, "replicas": n} operator resize
+                           (scale-down drains; lossless).
+``--endpoint-file`` (single mode) publishes {url, pid, models}
+atomically once the socket is up — the channel fleet-launched replicas
+use to hand their endpoint to the router.
 """
 
 from __future__ import annotations
@@ -77,7 +94,7 @@ def decode_array(payload: dict) -> np.ndarray:
 
 def make_handler(engine, house):
     from sparknet_tpu.parallel.serving import (
-        EngineDead, Overloaded, ServingError, UnknownModel,
+        EngineDead, OverBudget, Overloaded, ServingError, UnknownModel,
     )
 
     class Handler(BaseHTTPRequestHandler):
@@ -140,7 +157,9 @@ def make_handler(engine, house):
                         "batch_n": res.batch_n, "padded_to": res.padded_to})
                 if self.path == "/v1/models/load":
                     lm = house.load(payload["name"],
-                                    weights=payload.get("weights"))
+                                    weights=payload.get("weights"),
+                                    force=(True if payload.get("force")
+                                           else None))
                     return self._send(200, {"loaded": lm.info()})
                 if self.path == "/v1/models/evict":
                     gone = house.evict(payload["name"])
@@ -150,8 +169,142 @@ def make_handler(engine, house):
                 return self._send(404, {"error": f"no route {self.path!r}"})
             except Overloaded as e:
                 self._send(429, {"error": str(e), "reason": e.reason})
+            except OverBudget as e:
+                # 507 Insufficient Storage: the model alone cannot fit
+                # the HBM budget — retry with {"force": true} to admit
+                self._send(507, {"error": str(e), "reason": "over_budget",
+                                 "param_mb": round(e.param_mb, 1),
+                                 "budget_mb": e.budget_mb})
             except UnknownModel as e:
                 self._send(404, {"error": str(e), "reason": "unknown_model"})
+            except EngineDead as e:
+                self._send(503, {"error": str(e), "reason": "engine_dead"})
+            except (ServingError, TimeoutError, KeyError, ValueError) as e:
+                self._send(400, {"error": str(e)})
+
+    return Handler
+
+
+def make_fleet_handler(fleet):
+    """The front endpoint of ``--fleet`` mode: same wire format as a
+    single replica, but /v1/classify routes through the request router
+    (consistent-hash home + spill + failover) and the observability
+    routes aggregate the whole fleet."""
+    from sparknet_tpu.classify import http_json
+    from sparknet_tpu.parallel.serving import (
+        EngineDead, Overloaded, ServingError, UnknownModel,
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: N802
+            pass
+
+        def _send(self, code: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> dict:
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            return json.loads(self.rfile.read(n).decode()) if n else {}
+
+        def do_GET(self):  # noqa: N802
+            from urllib.parse import parse_qs, urlparse
+            u = urlparse(self.path)
+            if u.path == "/healthz":
+                st = fleet.router.stats()
+                live = [r for r, v in st["replicas"].items()
+                        if v["state"] == "ACTIVE"]
+                self._send(200 if live else 503, {
+                    "alive": bool(live), "router": st,
+                    "devices": {
+                        "total": fleet.sched.allocator.total,
+                        "free": fleet.sched.allocator.free_count}})
+            elif u.path == "/slo":
+                # per-replica verdicts, scoped to ?model= when given —
+                # tenant isolation is judged per model, not fleet-wide
+                model = (parse_qs(u.query).get("model") or [None])[0]
+                docs, ok = {}, True
+                for rid in fleet.router.replica_ids(model=model,
+                                                    live_only=False):
+                    url = fleet._endpoints.get(rid)
+                    if not url:
+                        continue
+                    try:
+                        docs[rid] = http_json(f"{url}/slo", timeout=10.0)
+                    except RuntimeError as e:
+                        if "HTTP 503" in str(e):
+                            docs[rid] = {"state": "breach",
+                                         "error": str(e)}
+                        else:
+                            docs[rid] = {"state": "unknown",
+                                         "error": str(e)}
+                    except OSError as e:
+                        docs[rid] = {"state": "unknown",
+                                     "error": repr(e)}
+                    ok = ok and docs[rid].get("state") == "ok"
+                self._send(200 if (ok and docs) else 503,
+                           {"state": "ok" if (ok and docs) else "breach",
+                            "model": model, "replicas": docs})
+            elif u.path == "/fleet":
+                self._send(200, fleet.sched.status())
+            elif u.path == "/v1/models":
+                models: dict = {}
+                for r in fleet.router.stats()["replicas"].values():
+                    for m in r["models"]:
+                        models.setdefault(m, {"replicas": 0})
+                        models[m]["replicas"] += 1
+                self._send(200, {"models": models})
+            elif u.path == "/metrics":
+                from sparknet_tpu.utils import telemetry
+                body = telemetry.get_registry().render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send(404, {"error": f"no route {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802
+            try:
+                payload = self._read_json()
+            except (ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": f"bad JSON: {e}"})
+            try:
+                if self.path == "/v1/classify":
+                    res = fleet.router.classify(
+                        payload.get("model", ""), decode_array(payload),
+                        tenant=str(payload.get("tenant", "anon")),
+                        timeout=float(payload.get("timeout_s", 30.0)))
+                    return self._send(200, {
+                        "model": res.model, "request_id": res.request_id,
+                        "probs": [float(p) for p in res.probs],
+                        "top": res.top, "queue_ms": res.queue_ms,
+                        "infer_ms": res.infer_ms, "total_ms": res.total_ms,
+                        "batch_n": res.batch_n, "padded_to": res.padded_to})
+                if self.path == "/v1/scale":
+                    model = payload["model"]
+                    want = int(payload["replicas"])
+                    have = fleet.active_replica_jobs(model)
+                    while len(have) < want and fleet.scale_up(model):
+                        have = fleet.active_replica_jobs(model)
+                    while len(have) > want \
+                            and fleet.scale_down(model) is not None:
+                        have = fleet.active_replica_jobs(model)
+                    return self._send(200, {"model": model,
+                                            "replicas": len(have)})
+                return self._send(404, {"error": f"no route {self.path!r}"})
+            except Overloaded as e:
+                self._send(429, {"error": str(e), "reason": e.reason})
+            except UnknownModel as e:
+                self._send(404, {"error": str(e),
+                                 "reason": "unknown_model"})
             except EngineDead as e:
                 self._send(503, {"error": str(e), "reason": "engine_dead"})
             except (ServingError, TimeoutError, KeyError, ValueError) as e:
@@ -223,6 +376,26 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-window-s", type=float, default=None,
                     help="slow burn window seconds "
                          "(default SPARKNET_SLO_WINDOW_S, 60)")
+    ap.add_argument("--endpoint-file", default=None,
+                    help="publish {url, pid, models} here (atomic) once "
+                         "the socket is up — how fleet-launched replicas "
+                         "hand their ephemeral endpoint to the router")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet mode: run N serving replicas per model "
+                         "as fleet tenants behind a request router + "
+                         "autoscaler, and serve the router at --port")
+    ap.add_argument("--fleet-devices", type=int, default=None,
+                    help="device budget for the replica fleet "
+                         "(default: N x models)")
+    ap.add_argument("--fleet-workdir", default=None,
+                    help="fleet state dir (journal, replica job dirs, "
+                         "autoscale.json/router.json; default: a temp "
+                         "dir)")
+    ap.add_argument("--fleet-tenant", default="serving",
+                    help="tenant the replica jobs bill against")
+    ap.add_argument("--fleet-priority", type=int, default=0,
+                    help="priority of the replica jobs (training jobs "
+                         "above it can preempt them — through drain)")
     args = ap.parse_args(argv)
 
     from sparknet_tpu.parallel.serving import (
@@ -249,8 +422,28 @@ def main(argv=None) -> int:
         slo_window_s=(args.slo_window_s if args.slo_window_s is not None
                       else base.slo_window_s))
 
+    # signal handlers FIRST: a replica preempted/shut down while still
+    # warm-up-compiling must exit cleanly (checkpoint-and-stop
+    # semantics), not die to the default SIGTERM disposition
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    if args.fleet:
+        return fleet_main(args, cfg, stop)
+
     house = ModelHouse(cfg)
     for name, weights in parse_models(args.models):
+        if stop.is_set():
+            # preempted while warming up: checkpoint-and-stop semantics
+            # (the fleet requeues us; nothing was serving yet)
+            print("[serve] stopped during warm-up", file=sys.stderr,
+                  flush=True)
+            return 0
         lm = house.load(name, weights=weights)
         print(f"[serve] loaded {name}: in={lm.in_shape} "
               f"classes={lm.classes} {lm.param_bytes / 2**20:.1f} MB, "
@@ -262,13 +455,6 @@ def main(argv=None) -> int:
                                 make_handler(engine, house))
     httpd.daemon_threads = True
     host, port = httpd.server_address[:2]
-    stop = threading.Event()
-
-    def on_signal(signum, frame):
-        stop.set()
-
-    signal.signal(signal.SIGTERM, on_signal)
-    signal.signal(signal.SIGINT, on_signal)
 
     server_thread = threading.Thread(target=httpd.serve_forever,
                                      daemon=True)
@@ -276,10 +462,101 @@ def main(argv=None) -> int:
     # the ready line: tests and operators key off this exact prefix
     print(f"serving on http://{host}:{port} "
           f"(models: {', '.join(sorted(house.loaded()))})", flush=True)
+    if args.endpoint_file:
+        write_endpoint(args.endpoint_file, host, port,
+                       sorted(house.loaded()))
     stop.wait()
     print("[serve] shutting down", file=sys.stderr, flush=True)
     httpd.shutdown()
     engine.stop()
+    return 0
+
+
+def write_endpoint(path: str, host, port: int, models: list) -> None:
+    """Atomic endpoint publication (tmp + rename — a reader never sees
+    a torn doc, the heartbeat-file contract)."""
+    doc = {"url": f"http://{host}:{port}", "pid": os.getpid(),
+           "models": models}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def serve_env_from(cfg) -> dict:
+    """The ServeConfig as env knobs — how fleet replicas inherit the
+    front's serving configuration with no per-replica CLI."""
+    env = {
+        "SPARKNET_SERVE_SHAPES": ",".join(str(s)
+                                          for s in cfg.batch_shapes),
+        "SPARKNET_SERVE_MAX_DELAY_MS": str(cfg.max_delay_ms),
+        "SPARKNET_SERVE_QUEUE": str(cfg.max_queue),
+        "SPARKNET_SERVE_INFLIGHT": str(cfg.inflight_batches),
+        "SPARKNET_SERVE_HBM_MB": str(cfg.hbm_budget_mb),
+        "SPARKNET_SERVE_DTYPE": cfg.dtype,
+        "SPARKNET_SLO_REJECT_BUDGET": str(cfg.slo_reject_budget),
+        "SPARKNET_SLO_WINDOW_S": str(cfg.slo_window_s),
+        "SPARKNET_SLO_FAST_S": str(cfg.slo_fast_window_s),
+    }
+    if cfg.tenant_qps:
+        env["SPARKNET_SERVE_QUOTAS"] = ",".join(
+            f"{t}={q:g}" for t, q in sorted(cfg.tenant_qps.items()))
+    if cfg.slo_p99_ms is not None:
+        env["SPARKNET_SLO_P99_MS"] = str(cfg.slo_p99_ms)
+    return env
+
+
+def fleet_main(args, cfg, stop) -> int:
+    """``--fleet N``: N replicas per model as serve-kind fleet tenants,
+    the request router at the front, the autoscaler closing the SLO
+    loop.  The front process owns no engine — replicas are subprocesses
+    the FleetScheduler placed, each a full single-model server."""
+    import tempfile
+
+    from sparknet_tpu.parallel.autoscale import Autoscaler, fleet_stats_fn
+    from sparknet_tpu.parallel.router import ServingFleet
+
+    model_specs = [name if not weights else f"{name}={weights}"
+                   for name, weights in parse_models(args.models)]
+    if not model_specs:
+        raise SystemExit("--fleet needs at least one --models entry")
+    devices = args.fleet_devices or args.fleet * len(model_specs)
+    workdir = args.fleet_workdir or tempfile.mkdtemp(
+        prefix="sparknet-servefleet-")
+    fleet = ServingFleet(
+        workdir, devices, tenant=args.fleet_tenant,
+        priority=args.fleet_priority, serve_env=serve_env_from(cfg))
+    autoscaler = Autoscaler(
+        fleet_stats_fn(fleet), fleet.scale_up, fleet.scale_down,
+        state_path=os.path.join(workdir, "autoscale.json"))
+    fleet.attach_autoscaler(autoscaler)
+    for spec in model_specs:
+        fleet.ensure(spec, args.fleet)
+    fleet.run_background()
+    try:
+        for spec in model_specs:
+            fleet.wait_ready(spec, args.fleet, timeout_s=300.0)
+    except TimeoutError as e:
+        print(f"[serve] fleet never became ready: {e}", file=sys.stderr,
+              flush=True)
+        fleet.stop()
+        return 1
+
+    httpd = ThreadingHTTPServer((args.host, args.port),
+                                make_fleet_handler(fleet))
+    httpd.daemon_threads = True
+    host, port = httpd.server_address[:2]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    print(f"serving on http://{host}:{port} "
+          f"(fleet: {args.fleet} replica(s) x "
+          f"{', '.join(model_specs)}; workdir {workdir})", flush=True)
+    if args.endpoint_file:
+        write_endpoint(args.endpoint_file, host, port, model_specs)
+    stop.wait()
+    print("[serve] shutting the fleet down", file=sys.stderr, flush=True)
+    httpd.shutdown()
+    fleet.stop()
     return 0
 
 
