@@ -115,6 +115,7 @@ def run_child() -> None:
         build_bench_model,
         peak_flops,
         record_fusion_plan,
+        record_tuning,
         scanned_train_block,
         step_cost_flops,
     )
@@ -212,6 +213,8 @@ def run_child() -> None:
             # the train net's vertical-fusion plan id — the ledger
             # fingerprint field keeping fused/unfused bands separate
             "fuse_plan": record_fusion_plan(solver.train_net),
+            # lowering-autotuner table id (graph/tuner.py), same role
+            "tune_plan": record_tuning(solver.train_net),
         }
 
     def measure_feed(dtype: str) -> dict:
@@ -520,7 +523,7 @@ def run_child() -> None:
     fp = perfledger.fingerprint(
         model=MODEL, dtype=best, batch=BATCH, world=1,
         device=f"{dev.platform}/{dev.device_kind}", backend=dev.platform,
-        fuse_plan=b.get("fuse_plan"))
+        fuse_plan=b.get("fuse_plan"), tune_plan=b.get("tune_plan"))
     result = {
         "metric": f"{MODEL}_train_images_per_sec",
         "value": b["images_per_sec"],
@@ -539,6 +542,7 @@ def run_child() -> None:
         "dtype_note": ("mixed precision; f32 master params/losses/BN stats"
                        if best == "bf16" else None),
         "fuse_plan": b.get("fuse_plan"),
+        "tune_plan": b.get("tune_plan"),
         "batch": BATCH,
         "iters_per_block": ITERS,
         "reps": REPS,

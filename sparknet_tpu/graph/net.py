@@ -208,6 +208,7 @@ class Net:
                 f"input_overrides for non-input blobs: {sorted(unknown)}")
         self._detect_hfuse_groups()
         self._detect_vfuse_chains()
+        self._latch_tune_plan()
         self._fuse_skip_noted: set[str] = set()
 
     def _detect_hfuse_groups(self) -> None:
@@ -277,6 +278,23 @@ class Net:
         unfused captures out of each other's baseline bands."""
         plan = getattr(self, "_fuse_plan", None)
         return plan.plan_id() if plan is not None else "off"
+
+    def _latch_tune_plan(self) -> None:
+        """Resolve SPARKNET_TUNE ONCE at Net construction (the hfuse/
+        vfuse latch discipline: flipping the env after jit never
+        retraces) so a typo'd table path or a drifted/wrong-backend
+        table fails HERE, loudly, not mid-training — and so the
+        tune_plan fingerprint the ledger stamps is the table the traced
+        lowerings actually consulted."""
+        from . import tuner
+        self._tune_plan_id = tuner.active_plan_id()
+
+    def tune_plan_id(self) -> str:
+        """Short id of the lowering-autotuner table active when this net
+        was built (``off`` when none) — the perf-ledger fingerprint
+        field that keeps tuned and untuned captures out of each other's
+        baseline bands (graph/tuner.py)."""
+        return getattr(self, "_tune_plan_id", "off")
 
     def _note_unfused_run(self, reason: str) -> None:
         """A fusable net executing unfused (ranged run, eps injection,

@@ -1,0 +1,1012 @@
+"""Lowering autotuner: measured per-(op, shape, dtype, backend) kernel
+selection behind one trace-time seam.
+
+Round 6→10 proved hand-picked lowering verdicts rot: the LRN cumsum win
+REVERSED on CPU re-probe (ops/vision.py LRN_CUMSUM_AUTO_C note), so any
+env-pinned choice is a regression waiting for the next XLA release.
+Caffe con Troll (arXiv 1504.04343) showed automatic per-layer conv
+strategy selection alone buys up to 4x on CPU; this module is that
+mechanism for every lowering the op library keeps more than one of:
+
+- a **candidate registry** per op family (lrn, conv, pool, lrn_epilogue)
+  where each candidate declares its numerics contract up front —
+  ``exact`` (forward bit-parity with the default lowering) or a declared
+  relative-error bound — plus the backend it requires;
+- a **measurement harness** (:func:`measure_key`): warm-up discard,
+  calibrated-iteration median-of-k fwd+bwd timing, and a numerics check
+  that disqualifies a candidate BEFORE it can win.  A candidate that
+  raises (e.g. Pallas on CPU) records a typed ``skipped`` entry instead
+  of aborting the run — the perf_probe contract, inherited;
+- a **schema-versioned tuning table** (``profiles/<backend>/tuning.json``,
+  the FusionPlan stale-file discipline: newer/drifted/wrong-backend
+  tables are refused loudly) consulted at trace time through one seam,
+  :func:`resolve_lowering`;
+- one knob, ``SPARKNET_TUNE=off|auto|<table path>`` — ``off`` is the
+  bit-parity escape hatch, ``auto`` loads the committed table for the
+  active backend and falls back to the hardcoded defaults on any miss.
+  Read at TRACE time like every other lowering toggle: flipping it after
+  jit has compiled does nothing.
+
+Bit-parity invariant: by default a candidate is eligible to WIN only if
+its measured forward is bit-identical to the default lowering's forward
+and its gradients stay inside the declared bound (1e-5 rel for f32) —
+so ``SPARKNET_TUNE=auto`` can never silently change forward numerics
+vs ``off``.  Non-bit-exact candidates (cumsum vs reduce_window, im2col)
+are still timed and persisted for the record (they are how the default
+heuristics get re-litigated), but only ``--allow-inexact`` lets one win.
+
+The deprecated per-op env pins (``SPARKNET_LRN_CUMSUM``,
+``SPARKNET_FUSE_PALLAS``) route through here as one-release shims that
+map onto pinned table answers and warn once; see :func:`_shim_pin`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import time
+import warnings
+from typing import Any, Callable
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TABLE_VERSION = 1
+TABLE_FILENAME = "tuning.json"
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+_DTYPE_CANON = {
+    "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+    "float64": "f64", "f32": "f32", "bf16": "bf16", "f16": "f16",
+    "f64": "f64",
+}
+
+
+def dtype_str(dtype) -> str:
+    """Canonical short dtype tag for a key ("f32", "bf16", ...)."""
+    import numpy as np
+    name = str(np.dtype(dtype).name) if not isinstance(dtype, str) else dtype
+    return _DTYPE_CANON.get(name, name)
+
+
+def np_dtype(tag: str):
+    import jax.numpy as jnp
+    return {"f32": jnp.float32, "bf16": jnp.bfloat16, "f16": jnp.float16,
+            "f64": jnp.float64}[tag]
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneKey:
+    """One tuning-table key: (op, shape, dtype) plus the op-specific
+    ``extra`` geometry tag (kernel/stride/pad/... for conv and pool,
+    window size for LRN) that makes lowerings comparable."""
+    op: str
+    shape: tuple
+    dtype: str
+    extra: str = ""
+
+    def __str__(self) -> str:
+        return key_str(self.op, self.shape, self.dtype, self.extra)
+
+
+def key_str(op: str, shape, dtype, extra: str = "") -> str:
+    dims = "x".join(str(int(d)) for d in shape)
+    base = f"{op}/{dims}/{dtype_str(dtype)}"
+    return f"{base}/{extra}" if extra else base
+
+
+def parse_key(ks: str) -> TuneKey:
+    parts = ks.split("/")
+    if len(parts) < 3:
+        raise ValueError(f"malformed tuning key {ks!r}")
+    op, dims, dt = parts[0], parts[1], parts[2]
+    shape = tuple(int(d) for d in dims.split("x"))
+    return TuneKey(op, shape, dt, "/".join(parts[3:]))
+
+
+def conv_extra(kh, kw, sh, sw, ph, pw, dh, dw, num_output, group) -> str:
+    return (f"k{kh}x{kw}s{sh}x{sw}p{ph}x{pw}d{dh}x{dw}"
+            f"o{num_output}g{group}")
+
+
+def pool_extra(kh, kw, sh, sw, ph, pw) -> str:
+    return f"max:k{kh}x{kw}s{sh}x{sw}p{ph}x{pw}"
+
+
+def lrn_extra(size: int) -> str:
+    return f"s{size}"
+
+
+def epilogue_extra(size: int, relu: bool) -> str:
+    return f"s{size}:relu{int(bool(relu))}"
+
+
+_CONV_EXTRA_RE = re.compile(
+    r"k(\d+)x(\d+)s(\d+)x(\d+)p(\d+)x(\d+)d(\d+)x(\d+)o(\d+)g(\d+)$")
+_POOL_EXTRA_RE = re.compile(r"max:k(\d+)x(\d+)s(\d+)x(\d+)p(\d+)x(\d+)$")
+_LRN_EXTRA_RE = re.compile(r"s(\d+)$")
+_EPI_EXTRA_RE = re.compile(r"s(\d+):relu([01])$")
+
+
+# ---------------------------------------------------------------------------
+# candidate registry
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One registered lowering for an op family.
+
+    ``exact`` declares forward bit-parity with the default lowering;
+    otherwise ``rtol`` is the declared forward bound.  ``grad_rtol`` is
+    the declared gradient bound (the fusebench 1e-5 contract by
+    default).  ``requires`` names a backend the candidate only runs on
+    (anything else records a typed skip instead of an exception)."""
+    name: str
+    exact: bool = True
+    rtol: float = 1e-5
+    grad_rtol: float = 1e-5
+    requires: str | None = None
+    note: str = ""
+
+
+@dataclasses.dataclass
+class Problem:
+    """A concrete measurement instance for one key: deterministic inputs
+    plus one callable per available candidate.  Candidates the builder
+    could prove unavailable up front (geometry, backend) carry a typed
+    reason in ``unavailable`` instead of a callable."""
+    inputs: tuple
+    fns: dict[str, Callable]
+    unavailable: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSpec:
+    op: str
+    candidates: tuple[Candidate, ...]
+    build: Callable[[TuneKey], Problem]
+    default: Callable[[TuneKey], str]
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def _rand(shape, dtype_tag, seed=0, scale=1.0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal(shape, dtype=np.float32) * scale
+    import jax.numpy as jnp
+    return jnp.asarray(x).astype(np_dtype(dtype_tag))
+
+
+# -- lrn --------------------------------------------------------------------
+
+_LRN_ALPHA, _LRN_BETA, _LRN_K = 1e-4, 0.75, 1.0
+
+
+def _build_lrn(key: TuneKey) -> Problem:
+    from ..ops import vision
+    m = _LRN_EXTRA_RE.match(key.extra)
+    if not m:
+        raise ValueError(f"lrn key needs extra 's<size>', got {key.extra!r}")
+    size = int(m.group(1))
+    pre = (size - 1) // 2
+    post = size - 1 - pre
+    x = _rand(key.shape, key.dtype)
+
+    def plain(xx, use_cumsum):
+        sq = xx * xx
+        ssum = vision.lrn_window_sum(sq, pre, post, use_cumsum=use_cumsum)
+        scale = _LRN_K + (_LRN_ALPHA / size) * ssum
+        return xx / scale ** _LRN_BETA
+
+    fns = {
+        "reduce_window": lambda xx: plain(xx, False),
+        "cumsum": lambda xx: plain(xx, True),
+        "closed_vjp": lambda xx: vision.relu_lrn_reference(
+            xx, size, _LRN_ALPHA, _LRN_BETA, _LRN_K, False),
+    }
+    if _backend() == "tpu":
+        from ..ops.pallas_kernels import lrn_across_channels
+        fns["pallas"] = lambda xx: lrn_across_channels(
+            xx, size, _LRN_ALPHA, _LRN_BETA, _LRN_K)
+    return Problem(inputs=(x,), fns=fns)
+
+
+def _default_lrn(key: TuneKey) -> str:
+    from ..ops.vision import LRN_CUMSUM_AUTO_C
+    if _backend() == "tpu" and key.shape[1] >= LRN_CUMSUM_AUTO_C:
+        return "cumsum"
+    return "reduce_window"
+
+
+_LRN_CANDIDATES = (
+    # reduce_window/cumsum are cross-inexact (same addends, different
+    # association), and ``exact`` means "bit-identical to THIS KEY's
+    # default" — so both declare the association bound; whichever one IS
+    # the default is trivially exact there.  closed_vjp's forward tracks
+    # the default's window-sum formulation (same HLO), so it alone can
+    # promise bit-parity everywhere.
+    Candidate("reduce_window", exact=False, rtol=1e-5,
+              note="lax.reduce_window channel window; AD backward"),
+    Candidate("cumsum", exact=False, rtol=1e-5,
+              note="prefix-sum difference — exact up to float association"),
+    Candidate("closed_vjp",
+              note="same forward HLO, closed-form scale-residual VJP "
+                   "(the fusebench contract)"),
+    Candidate("pallas", exact=False, rtol=1e-4, grad_rtol=1e-4,
+              requires="tpu", note="fused Pallas ACROSS_CHANNELS kernel"),
+)
+
+
+# -- conv -------------------------------------------------------------------
+
+def _build_conv(key: TuneKey) -> Problem:
+    import jax.numpy as jnp
+    from jax import lax
+    from ..ops import vision
+    m = _CONV_EXTRA_RE.match(key.extra)
+    if not m:
+        raise ValueError(f"conv key needs geometry extra, got {key.extra!r}")
+    kh, kw, sh, sw, ph, pw, dh, dw, o, g = (int(v) for v in m.groups())
+    n, c, h, w = key.shape
+    x = _rand(key.shape, key.dtype)
+    wgt = _rand((o, c // g, kh, kw), key.dtype, seed=1, scale=0.05)
+
+    def native(xx, ww):
+        return lax.conv_general_dilated(
+            xx, ww, window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw), feature_group_count=g,
+            dimension_numbers=vision.DIMNUMS)
+
+    fns = {
+        "native": native,
+        "im2col": lambda xx, ww: vision._im2col_conv(
+            xx, ww, kh, kw, sh, sw, ph, pw, dh, dw, g),
+    }
+    unavailable = {}
+    if vision._s2d_geometry_ok(c, kh, kw, sh, sw, ph, pw, dh, dw, g):
+        fns["s2d"] = lambda xx, ww: vision._space_to_depth_conv(
+            xx, ww, kh, kw, sh, sw, ph, pw)
+    else:
+        unavailable["s2d"] = ("geometry ineligible (needs group==1, "
+                              "dilation 1, strided, c_in*s*s<=64, k>=s)")
+    return Problem(inputs=(x, wgt), fns=fns, unavailable=unavailable)
+
+
+def _default_conv(key: TuneKey) -> str:
+    from ..ops import vision
+    m = _CONV_EXTRA_RE.match(key.extra)
+    kh, kw, sh, sw, ph, pw, dh, dw, o, g = (int(v) for v in m.groups())
+    if vision._s2d_geometry_ok(key.shape[1], kh, kw, sh, sw, ph, pw,
+                               dh, dw, g):
+        return "s2d"
+    return "native"
+
+
+_CONV_CANDIDATES = (
+    # native/s2d/im2col are cross-inexact (summation order); see the
+    # LRN candidate note — exactness is measured vs this key's default.
+    Candidate("native", exact=False, rtol=1e-5,
+              note="lax.conv_general_dilated, logical NCHW"),
+    Candidate("s2d", exact=False, rtol=1e-5,
+              note="space-to-depth stride-phase regroup (stem trick); "
+                   "exact up to summation order"),
+    Candidate("im2col", exact=False, rtol=1e-5,
+              note="conv_general_dilated_patches + grouped einsum (the "
+                   "Caffe lowering, for backends whose direct conv is "
+                   "slow — CcT's strategy B)"),
+)
+
+
+# -- pool (MAX) -------------------------------------------------------------
+
+def _build_pool(key: TuneKey) -> Problem:
+    from ..ops import vision
+    m = _POOL_EXTRA_RE.match(key.extra)
+    if not m:
+        raise ValueError(f"pool key needs extra 'max:k..s..p..', "
+                         f"got {key.extra!r}")
+    kh, kw, sh, sw, ph, pw = (int(v) for v in m.groups())
+    n, c, h, w = key.shape
+    oh, ow = vision.pool_output_size(h, w, kh, kw, sh, sw, ph, pw)
+    x = _rand(key.shape, key.dtype)
+
+    fns = {
+        "reduce_window": lambda xx: vision.max_pool(
+            xx, kh, kw, sh, sw, ph, pw, oh, ow),
+    }
+    unavailable = {}
+    if vision._patches_pool_ok(h, w, kh, kw, sh, sw, ph, pw):
+        fns["patches_max"] = lambda xx: vision.max_pool_patches(
+            xx, kh, kw, sh, sw, oh, ow)
+    else:
+        unavailable["patches_max"] = (
+            "padding/remainder ineligible (patches pad with 0, not -inf; "
+            "needs p==0 and (dim-k) %% s == 0)")
+    if _backend() == "tpu":
+        from ..ops.pallas_kernels import max_pool_vmem_bwd
+        fns["pallas_bwd"] = lambda xx: max_pool_vmem_bwd(
+            xx, kh, kw, sh, sw, ph, pw, oh, ow)
+    return Problem(inputs=(x,), fns=fns, unavailable=unavailable)
+
+
+def _default_pool(key: TuneKey) -> str:
+    return "reduce_window"
+
+
+_POOL_CANDIDATES = (
+    Candidate("reduce_window",
+              note="lax.reduce_window -inf; select-and-scatter backward"),
+    Candidate("patches_max",
+              note="patch extraction + argmax/take_along_axis; max is "
+                   "association-free so forward is bit-exact, and the "
+                   "gather routes gradient to the first maximum exactly "
+                   "like select-and-scatter"),
+    Candidate("pallas_bwd", grad_rtol=1e-4, requires="tpu",
+              note="XLA forward, VMEM-resident Pallas backward"),
+)
+
+
+# -- lrn_epilogue (fused-chain tail from graph/fusion.py) -------------------
+
+def _build_epilogue(key: TuneKey) -> Problem:
+    import jax.numpy as jnp
+    from ..ops import vision
+    m = _EPI_EXTRA_RE.match(key.extra)
+    if not m:
+        raise ValueError(f"lrn_epilogue key needs extra 's<size>:relu<0|1>', "
+                         f"got {key.extra!r}")
+    size, relu = int(m.group(1)), bool(int(m.group(2)))
+    x = _rand(key.shape, key.dtype)
+
+    def per_layer(xx):
+        a, scale = vision._relu_lrn_primal(
+            xx, size, _LRN_ALPHA, _LRN_BETA, _LRN_K, relu)
+        return a / scale ** _LRN_BETA
+
+    fns = {
+        "reference": lambda xx: vision.relu_lrn_reference(
+            xx, size, _LRN_ALPHA, _LRN_BETA, _LRN_K, relu),
+        "per_layer": per_layer,
+    }
+    if _backend() == "tpu":
+        from ..ops.pallas_kernels import relu_lrn_across_channels
+        fns["pallas"] = lambda xx: relu_lrn_across_channels(
+            xx, size, _LRN_ALPHA, _LRN_BETA, _LRN_K, relu)
+    return Problem(inputs=(x,), fns=fns)
+
+
+def _default_epilogue(key: TuneKey) -> str:
+    return "pallas" if _backend() == "tpu" else "reference"
+
+
+_EPILOGUE_CANDIDATES = (
+    Candidate("reference",
+              note="XLA [ReLU+]LRN with the closed-form custom VJP"),
+    Candidate("per_layer",
+              note="same forward formulas, plain AD backward (what the "
+                   "unfused per-layer path differentiates)"),
+    Candidate("pallas", exact=False, rtol=1e-4, grad_rtol=1e-4,
+              requires="tpu", note="fused Pallas epilogue kernel"),
+)
+
+
+_REGISTRY: dict[str, OpSpec] = {
+    "lrn": OpSpec("lrn", _LRN_CANDIDATES, _build_lrn, _default_lrn),
+    "conv": OpSpec("conv", _CONV_CANDIDATES, _build_conv, _default_conv),
+    "pool": OpSpec("pool", _POOL_CANDIDATES, _build_pool, _default_pool),
+    "lrn_epilogue": OpSpec("lrn_epilogue", _EPILOGUE_CANDIDATES,
+                           _build_epilogue, _default_epilogue),
+}
+
+# test-registered extra candidates: op -> [(Candidate, factory)], factory
+# called as factory(key, problem) -> callable
+_EXTRA: dict[str, list] = {}
+
+
+def ops() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def candidates_for(op: str) -> list[Candidate]:
+    spec = _REGISTRY.get(op)
+    if spec is None:
+        raise ValueError(f"unknown tunable op {op!r} (have {ops()})")
+    return list(spec.candidates) + [c for c, _ in _EXTRA.get(op, [])]
+
+
+def register_candidate(op: str, cand: Candidate, factory) -> None:
+    """Register an extra candidate for ``op`` (tests plant slow/wrong
+    candidates through this; a production candidate belongs in the
+    static registry above)."""
+    if op not in _REGISTRY:
+        raise ValueError(f"unknown tunable op {op!r} (have {ops()})")
+    _EXTRA.setdefault(op, []).append((cand, factory))
+
+
+def clear_extra_candidates(op: str | None = None) -> None:
+    if op is None:
+        _EXTRA.clear()
+    else:
+        _EXTRA.pop(op, None)
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+def _timing_params(reps, target_s, warmup):
+    if reps is None:
+        reps = int(os.environ.get("SPARKNET_TUNE_REPS", "5"))
+    if target_s is None:
+        target_s = float(os.environ.get("SPARKNET_TUNE_TARGET_S", "0.1"))
+    if warmup is None:
+        warmup = int(os.environ.get("SPARKNET_TUNE_WARMUP", "2"))
+    return max(3, int(reps)), float(target_s), max(1, int(warmup))
+
+
+def _typed_skip(e: BaseException) -> str:
+    msg = str(e).strip().split("\n")[0][:200]
+    return f"{type(e).__name__}: {msg}" if msg else type(e).__name__
+
+
+def _fwdbwd(fn, n_inputs: int):
+    import jax
+    import jax.numpy as jnp
+
+    def loss(*args):
+        return jnp.mean(fn(*args).astype(jnp.float32))
+
+    return jax.jit(jax.value_and_grad(loss, argnums=tuple(range(n_inputs))))
+
+
+def _time_fn(jfn, inputs, reps, target_s, warmup):
+    """Median-of-``reps`` per-call ms with warm-up discard (compile +
+    ``warmup`` executions thrown away) and iteration count calibrated so
+    each rep runs ~``target_s``.  Returns (ms, rel_spread)."""
+    import jax
+    pc = time.perf_counter
+    out = None
+    for _ in range(warmup):
+        out = jfn(*inputs)
+    jax.block_until_ready(out)
+    t0 = pc()
+    jax.block_until_ready(jfn(*inputs))
+    dt = max(pc() - t0, 1e-7)
+    iters = max(1, min(1024, int(round(target_s / dt))))
+    times = []
+    for _ in range(reps):
+        t0 = pc()
+        for _ in range(iters):
+            out = jfn(*inputs)
+        jax.block_until_ready(out)
+        times.append((pc() - t0) / iters)
+    times.sort()
+    med = times[len(times) // 2]
+    spread = (times[-1] - times[0]) / max(med, 1e-12)
+    return med * 1e3, spread
+
+
+def _max_rel(a, b) -> float:
+    import numpy as np
+    a = np.asarray(a).astype(np.float64)
+    b = np.asarray(b).astype(np.float64)
+    denom = max(float(np.max(np.abs(b))), 1e-30)
+    return float(np.max(np.abs(a - b)) / denom)
+
+
+def _eps(dtype_tag: str) -> float:
+    import numpy as np
+    import jax.numpy as jnp
+    return float(jnp.finfo(np_dtype(dtype_tag)).eps)
+
+
+def _numerics_verdict(cand, out, grads, ref_out, ref_grads, dtype_tag):
+    """None if the candidate passes its declared contract vs the default
+    lowering, else a disqualification reason.  Also returns whether the
+    forward was bit-identical (the winner-eligibility bit)."""
+    import numpy as np
+    bit = (np.asarray(out).tobytes() == np.asarray(ref_out).tobytes()
+           and np.asarray(out).shape == np.asarray(ref_out).shape)
+    reason = None
+    if cand.exact and not bit:
+        reason = (f"declared exact but forward differs from default "
+                  f"(max rel err {_max_rel(out, ref_out):.3g})")
+    elif not cand.exact and not bit:
+        tol = max(cand.rtol, 16.0 * _eps(dtype_tag))
+        err = _max_rel(out, ref_out)
+        if not (err <= tol):
+            reason = f"forward rel err {err:.3g} > declared bound {tol:.3g}"
+    if reason is None:
+        gtol = max(cand.grad_rtol, 64.0 * _eps(dtype_tag))
+        for i, (g, rg) in enumerate(zip(grads, ref_grads)):
+            gerr = _max_rel(g, rg)
+            if not (gerr <= gtol):
+                reason = (f"grad[{i}] rel err {gerr:.3g} > declared "
+                          f"bound {gtol:.3g}")
+                break
+    return reason, bit
+
+
+def measure_key(key: TuneKey, *, reps=None, target_s=None, warmup=None,
+                allow_inexact: bool = False) -> dict:
+    """Measure every registered candidate at ``key`` and pick a winner.
+
+    Contract (inherited by every caller, including the staleness gate):
+
+    - a candidate that raises records a typed ``skipped`` entry and the
+      run continues (the perf_probe fix, satellite 2);
+    - a candidate failing its declared numerics contract vs the default
+      lowering is ``disqualified`` — timed for the record, never a
+      winner;
+    - unless ``allow_inexact``, a candidate whose forward is not
+      bit-identical to the default is additionally ``ineligible`` (timed
+      and persisted, cannot win) — this is what keeps
+      ``SPARKNET_TUNE=auto`` forward-bit-equal to ``off``.
+    """
+    import jax
+    spec = _REGISTRY.get(key.op)
+    if spec is None:
+        raise ValueError(f"unknown tunable op {key.op!r} (have {ops()})")
+    reps, target_s, warmup = _timing_params(reps, target_s, warmup)
+    prob = spec.build(key)
+    fns = dict(prob.fns)
+    unavailable = dict(prob.unavailable)
+    cands = list(spec.candidates)
+    for cand, factory in _EXTRA.get(key.op, []):
+        cands.append(cand)
+        try:
+            fns[cand.name] = factory(key, prob)
+        except Exception as e:  # noqa: BLE001 — typed skip, not abort
+            unavailable[cand.name] = _typed_skip(e)
+
+    default = spec.default(key)
+    if default not in fns:
+        raise RuntimeError(f"default lowering {default!r} unavailable at "
+                           f"{key} — registry bug")
+    n_in = len(prob.inputs)
+    ref_fwd = jax.jit(fns[default])
+    ref_out = jax.device_get(ref_fwd(*prob.inputs))
+    ref_fb = _fwdbwd(fns[default], n_in)
+    ref_grads = jax.device_get(ref_fb(*prob.inputs)[1])
+
+    backend = _backend()
+    timings: dict[str, dict] = {}
+    qualified: dict[str, float] = {}
+    for cand in cands:
+        name = cand.name
+        if cand.requires and cand.requires != backend:
+            timings[name] = {"skipped": f"requires {cand.requires} backend "
+                                        f"(running {backend})"}
+            continue
+        if name in unavailable:
+            timings[name] = {"skipped": unavailable[name]}
+            continue
+        if name not in fns:
+            timings[name] = {"skipped": "no implementation registered"}
+            continue
+        try:
+            rec: dict[str, Any] = {}
+            bit = True
+            if name == default:
+                rec["forward_exact"] = True
+            else:
+                out = jax.device_get(jax.jit(fns[name])(*prob.inputs))
+                grads = jax.device_get(_fwdbwd(fns[name], n_in)
+                                       (*prob.inputs)[1])
+                reason, bit = _numerics_verdict(
+                    cand, out, grads, ref_out, ref_grads, key.dtype)
+                rec["forward_exact"] = bool(bit)
+                if reason is not None:
+                    rec["disqualified"] = reason
+            ms, spread = _time_fn(_fwdbwd(fns[name], n_in), prob.inputs,
+                                  reps, target_s, warmup)
+            rec["ms"] = round(ms, 5)
+            rec["rel_spread"] = round(spread, 4)
+            if "disqualified" not in rec:
+                if bit or allow_inexact or name == default:
+                    qualified[name] = ms
+                else:
+                    rec["ineligible"] = ("not forward-bit-identical to "
+                                         f"default {default!r} "
+                                         "(--allow-inexact to permit)")
+            timings[name] = rec
+        except Exception as e:  # noqa: BLE001 — typed skip, not abort
+            timings[name] = {"skipped": _typed_skip(e)}
+
+    if not qualified:
+        raise RuntimeError(f"no qualified candidate at {key} "
+                           f"(timings: {timings})")
+    winner = min(qualified, key=qualified.get)
+    rest = sorted(v for k, v in qualified.items() if k != winner)
+    margin = ((rest[0] - qualified[winner]) / max(qualified[winner], 1e-12)
+              if rest else None)
+    noise = max([0.05] + [r.get("rel_spread", 0.0)
+                          for r in timings.values() if "ms" in r])
+    return {
+        "key": str(key),
+        "op": key.op,
+        "winner": winner,
+        "default": default,
+        "flip": winner != default,
+        "margin": round(margin, 4) if margin is not None else None,
+        "noise_band": round(noise, 4),
+        "timings": timings,
+        "measured_at": time.time(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# tuning table (the FusionPlan stale-file discipline)
+# ---------------------------------------------------------------------------
+
+class TuningTable:
+    """Versioned winners-per-key for one backend, persisted as
+    ``profiles/<backend>/tuning.json``.  A table written by a newer
+    schema, missing required fields, or captured for a different backend
+    is refused with ValueError — a drifted table must never silently
+    change which lowerings execute."""
+
+    def __init__(self, backend: str, entries: list[dict],
+                 provenance: dict | None = None,
+                 version: int = TABLE_VERSION):
+        self.backend = backend
+        self.entries = list(entries)
+        self.provenance = provenance or {}
+        self.version = version
+        self._by_key = {e["key"]: e for e in self.entries}
+
+    def winner(self, key: str) -> str | None:
+        e = self._by_key.get(key)
+        return e["winner"] if e else None
+
+    def entry(self, key: str) -> dict | None:
+        return self._by_key.get(key)
+
+    def table_id(self) -> str:
+        """Short content hash for the perf-ledger ``tune_plan``
+        fingerprint field (like FusionPlan.plan_id): "off" never appears
+        here — that is the no-table sentinel."""
+        if not self.entries:
+            return "tt0"
+        canon = "|".join(sorted(f"{e['key']}={e['winner']}"
+                                for e in self.entries))
+        h = hashlib.sha1(canon.encode()).hexdigest()[:8]
+        return f"tt{len(self.entries)}-{h}"
+
+    def to_doc(self) -> dict:
+        return {
+            "kind": "tuning_table",
+            "version": self.version,
+            "backend": self.backend,
+            "table_id": self.table_id(),
+            "provenance": self.provenance,
+            "entries": self.entries,
+        }
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_doc(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def from_doc(cls, doc: dict, origin: str = "<doc>") -> "TuningTable":
+        if not isinstance(doc, dict) or doc.get("kind") != "tuning_table":
+            raise ValueError(
+                f"{origin}: not a tuning table (kind="
+                f"{doc.get('kind') if isinstance(doc, dict) else type(doc)})")
+        ver = doc.get("version")
+        if not isinstance(ver, int):
+            raise ValueError(f"{origin}: tuning table has no integer "
+                             f"schema version — refusing a drifted file")
+        if ver > TABLE_VERSION:
+            raise ValueError(
+                f"{origin}: tuning table schema v{ver} is newer than this "
+                f"build understands (v{TABLE_VERSION}) — refusing to guess")
+        backend = doc.get("backend")
+        entries = doc.get("entries")
+        if not isinstance(backend, str) or not isinstance(entries, list):
+            raise ValueError(f"{origin}: tuning table missing backend/"
+                             f"entries — refusing a drifted file")
+        for i, e in enumerate(entries):
+            if not (isinstance(e, dict) and isinstance(e.get("key"), str)
+                    and isinstance(e.get("winner"), str)
+                    and isinstance(e.get("timings"), dict)):
+                raise ValueError(
+                    f"{origin}: entry {i} missing key/winner/timings — "
+                    f"refusing a drifted file")
+        return cls(backend, entries, doc.get("provenance") or {}, ver)
+
+    @classmethod
+    def load(cls, path: str) -> "TuningTable":
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError as e:
+                raise ValueError(f"{path}: unparseable tuning table "
+                                 f"({e}) — refusing") from e
+        return cls.from_doc(doc, origin=path)
+
+
+def build_table(keys, *, reps=None, target_s=None, warmup=None,
+                allow_inexact: bool = False,
+                progress=None) -> TuningTable:
+    """Measure ``keys`` and assemble a TuningTable for the active
+    backend, stamped with git sha + perfledger provenance."""
+    from ..utils import perfledger
+    entries = []
+    for key in keys:
+        e = measure_key(key, reps=reps, target_s=target_s, warmup=warmup,
+                        allow_inexact=allow_inexact)
+        e["sha"] = perfledger.git_sha()
+        entries.append(e)
+        if progress is not None:
+            progress(e)
+    fp = perfledger.fingerprint(model="tuner", dtype="-", batch=0)
+    return TuningTable(_backend(), entries,
+                       provenance=perfledger.provenance(fp))
+
+
+# ---------------------------------------------------------------------------
+# trace-time resolution: SPARKNET_TUNE + deprecation shims
+# ---------------------------------------------------------------------------
+
+_TABLE_CACHE: dict[str, tuple[float, TuningTable]] = {}
+_WARNED: set[str] = set()
+
+
+def _warn_once(tag: str, msg: str) -> None:
+    if tag not in _WARNED:
+        _WARNED.add(tag)
+        warnings.warn(msg, DeprecationWarning, stacklevel=3)
+
+
+def deprecated_lrn_cumsum_pin() -> bool | None:
+    """The one-release SPARKNET_LRN_CUMSUM shim: ``=1``/``=0`` still pin
+    the LRN window sum (exactly the retired knob's semantics) but now do
+    it by pinning the table answer, and warn once.  Any other value is
+    ignored, as before.  Remove with the knob next release."""
+    env = os.environ.get("SPARKNET_LRN_CUMSUM", "")
+    if env not in ("0", "1"):
+        return None
+    _warn_once(
+        "SPARKNET_LRN_CUMSUM",
+        "SPARKNET_LRN_CUMSUM is deprecated; it now pins the lowering "
+        "autotuner's lrn answer and will be removed next release — use "
+        "SPARKNET_TUNE=off|auto|<table> (tools/tune.py) instead.")
+    return env == "1"
+
+
+def _shim_pin(op: str) -> str | None:
+    """Deprecated env pins, mapped onto pinned table answers (checked
+    before the table in every SPARKNET_TUNE mode so legacy rigs keep
+    their exact pre-tuner behavior for one release)."""
+    if op == "lrn":
+        pin = deprecated_lrn_cumsum_pin()
+        if pin is not None:
+            return "cumsum" if pin else "reduce_window"
+    if op == "lrn_epilogue":
+        if os.environ.get("SPARKNET_FUSE_PALLAS") == "0":
+            _warn_once(
+                "SPARKNET_FUSE_PALLAS",
+                "SPARKNET_FUSE_PALLAS is deprecated; =0 now pins the "
+                "lowering autotuner's lrn_epilogue answer to the XLA "
+                "reference and will be removed next release — use "
+                "SPARKNET_TUNE=off|auto|<table> (tools/tune.py) instead.")
+            return "reference"
+    return None
+
+
+def default_table_path(backend: str | None = None,
+                       repo: str | None = None) -> str:
+    return os.path.join(repo or _REPO_ROOT, "profiles",
+                        backend or _backend(), TABLE_FILENAME)
+
+
+def _load_cached(path: str) -> TuningTable:
+    mtime = os.path.getmtime(path)
+    hit = _TABLE_CACHE.get(path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    table = TuningTable.load(path)
+    backend = _backend()
+    if table.backend != backend:
+        raise ValueError(
+            f"{path}: tuning table captured for backend "
+            f"{table.backend!r} refused on backend {backend!r} — winners "
+            f"do not transfer across backends (re-run tools/tune.py run)")
+    _TABLE_CACHE[path] = (mtime, table)
+    return table
+
+
+def active_table() -> TuningTable | None:
+    """The tuning table SPARKNET_TUNE selects, or None (hardcoded
+    defaults).  ``off``/``0`` → None; ``auto``/unset → the committed
+    ``profiles/<backend>/tuning.json`` if present; anything else must be
+    a readable table path — a typo here must not silently change which
+    lowerings execute, so it raises."""
+    env = (os.environ.get("SPARKNET_TUNE") or "auto").strip()
+    if env in ("off", "0"):
+        return None
+    if env in ("auto", "1"):
+        path = default_table_path()
+        if not os.path.isfile(path):
+            return None
+        return _load_cached(path)
+    if not os.path.isfile(env):
+        raise ValueError(
+            f"SPARKNET_TUNE={env!r}: not off|auto and no such table file — "
+            f"a typo here must not silently change which lowerings execute")
+    return _load_cached(env)
+
+
+def active_plan_id() -> str:
+    """The perf-ledger ``tune_plan`` fingerprint value for the current
+    process ("off" when no table is active) — latched by Net at build
+    time like fuse_plan_id."""
+    t = active_table()
+    return t.table_id() if t is not None else "off"
+
+
+def resolve_lowering(op: str, shape, dtype, *, extra: str = "") -> str | None:
+    """THE trace-time seam: which lowering should ``op`` use at this
+    (shape, dtype) on this backend?  Returns a candidate name, or None
+    for "use the hardcoded default" (table miss, SPARKNET_TUNE=off, or
+    no committed table).  Deprecated env pins win over the table so
+    legacy rigs keep their exact behavior during the shim release."""
+    pin = _shim_pin(op)
+    if pin is not None:
+        return pin
+    table = active_table()
+    if table is None:
+        return None
+    return table.winner(key_str(op, shape, dtype, extra))
+
+
+def _clear_caches() -> None:
+    """Test hook: forget loaded tables and re-arm one-shot warnings."""
+    _TABLE_CACHE.clear()
+    _WARNED.clear()
+
+
+# ---------------------------------------------------------------------------
+# net walking + staleness
+# ---------------------------------------------------------------------------
+
+def keys_for_net(net, dtype="f32") -> list[TuneKey]:
+    """Every tunable (op, shape, dtype) key a built Net would consult at
+    trace time: conv/pool/lrn layer keys plus the fused-chain epilogue
+    keys from its fusion plan.  Order follows the graph; duplicates
+    (weight-shared towers) collapse."""
+    from ..ops import vision
+    keys: list[TuneKey] = []
+    seen: set[str] = set()
+
+    def add(k: TuneKey):
+        s = str(k)
+        if s not in seen:
+            seen.add(s)
+            keys.append(k)
+
+    fused_lrn: set[str] = set()
+    plan = getattr(net, "_fuse_plan", None)
+    if plan is not None:
+        for ch in getattr(plan, "chains", []):
+            if ch.epilogue in ("lrn", "relu_lrn"):
+                lrn_name = ch.members[-1]
+                fused_lrn.add(lrn_name)
+                node = net._node_by_name.get(lrn_name)
+                if node is not None:
+                    shape = net.blob_shapes.get(node.bottoms[0])
+                    size = vision.lrn_geometry(node.lp)[0]
+                    if shape is not None and len(shape) == 4:
+                        add(TuneKey("lrn_epilogue", tuple(shape), dtype,
+                                    epilogue_extra(
+                                        size, ch.epilogue == "relu_lrn")))
+    for node in net.nodes:
+        if not node.bottoms:
+            continue
+        shape = net.blob_shapes.get(node.bottoms[0])
+        if shape is None or len(shape) != 4:
+            continue
+        t = node.lp.type
+        if t == "Convolution":
+            g = vision.conv_geometry(node.lp)
+            add(TuneKey("conv", tuple(shape), dtype,
+                        conv_extra(*g[:10])))
+        elif t == "Pooling":
+            kh, kw, sh, sw, ph, pw, method = vision._pool_geometry(
+                node.lp, shape)
+            if method == "MAX":
+                add(TuneKey("pool", tuple(shape), dtype,
+                            pool_extra(kh, kw, sh, sw, ph, pw)))
+        elif t == "LRN" and node.lp.name not in fused_lrn:
+            size, _, _, _, region = vision.lrn_geometry(node.lp)
+            if region == "ACROSS_CHANNELS":
+                add(TuneKey("lrn", tuple(shape), dtype, lrn_extra(size)))
+    return keys
+
+
+def staleness_check(table: TuningTable, *, budget_s: float = 60.0,
+                    reps=None, target_s=None, warmup=None,
+                    allow_inexact: bool = False) -> dict:
+    """Re-probe the table's worst-margin and oldest entries within
+    ``budget_s`` and flag any persisted winner that no longer wins by
+    more than the noise band (the r06→r10 LRN reversal, detected by
+    machine instead of by accident).  Returns a report whose ``rotten``
+    list carries the fresh timings; ``ok`` is False iff it is non-empty.
+    """
+    entries = list(table.entries)
+    by_margin = sorted(entries,
+                       key=lambda e: (e.get("margin") is None,
+                                      e.get("margin") or 0.0))
+    by_age = sorted(entries, key=lambda e: e.get("measured_at") or 0.0)
+    order, seen = [], set()
+    for pair in zip(by_margin, by_age):
+        for e in pair:
+            if e["key"] not in seen:
+                seen.add(e["key"])
+                order.append(e)
+    for e in entries:
+        if e["key"] not in seen:
+            order.append(e)
+
+    t0 = time.monotonic()
+    results, rotten = [], []
+    for e in order:
+        if results and (time.monotonic() - t0) > budget_s:
+            break
+        fresh = measure_key(parse_key(e["key"]), reps=reps,
+                            target_s=target_s, warmup=warmup,
+                            allow_inexact=allow_inexact)
+        fresh_ms = {n: r["ms"] for n, r in fresh["timings"].items()
+                    if "ms" in r and "disqualified" not in r
+                    and "ineligible" not in r}
+        band = max(float(e.get("noise_band") or 0.05),
+                   float(fresh["noise_band"]))
+        old_winner = e["winner"]
+        rec = {
+            "key": e["key"],
+            "persisted_winner": old_winner,
+            "persisted_margin": e.get("margin"),
+            "fresh_winner": fresh["winner"],
+            "fresh_timings": fresh["timings"],
+            "noise_band": round(band, 4),
+        }
+        if old_winner not in fresh_ms:
+            rec["rotten"] = (f"persisted winner {old_winner!r} no longer "
+                             f"qualifies: "
+                             f"{fresh['timings'].get(old_winner)}")
+        else:
+            best = min(fresh_ms.values())
+            slack = (fresh_ms[old_winner] - best) / max(best, 1e-12)
+            rec["slack"] = round(slack, 4)
+            if slack > band:
+                rec["rotten"] = (
+                    f"persisted winner {old_winner!r} now "
+                    f"{fresh_ms[old_winner]:.4g} ms vs fresh best "
+                    f"{fresh['winner']!r} {best:.4g} ms "
+                    f"({slack:.1%} slower > {band:.1%} noise band)")
+        results.append(rec)
+        if "rotten" in rec:
+            rotten.append(rec)
+    return {
+        "ok": not rotten,
+        "checked": len(results),
+        "total_entries": len(entries),
+        "budget_s": budget_s,
+        "rotten": rotten,
+        "results": results,
+    }

@@ -49,7 +49,7 @@ LEDGER_RELPATH = os.path.join("perf", "LEDGER.jsonl")
 
 # fingerprint fields, in canonical key order
 FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
-                      "backend", "fuse_plan", "replicas")
+                      "backend", "fuse_plan", "replicas", "tune_plan")
 
 # entries written before the vertical fusion pass existed carry no
 # fuse_plan field; they were structurally unfused, so they pool with
@@ -57,8 +57,11 @@ FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
 # Likewise entries before the serving fleet were single-engine captures:
 # they read as replicas=1 so the committed serving history keeps gating
 # against fresh single-engine runs, while fleet captures (replicas=N)
-# band separately.
-_FINGERPRINT_DEFAULTS = {"fuse_plan": "off", "replicas": 1}
+# band separately.  And entries before the lowering autotuner ran every
+# lowering at its hardcoded default, exactly what SPARKNET_TUNE=off runs
+# today — they read as tune_plan="off" so r01-r11 bands keep gating.
+_FINGERPRINT_DEFAULTS = {"fuse_plan": "off", "replicas": 1,
+                         "tune_plan": "off"}
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -94,7 +97,8 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
                 device: str | None = None,
                 backend: str | None = None,
                 fuse_plan: str | None = None,
-                replicas: int | None = None) -> dict[str, Any]:
+                replicas: int | None = None,
+                tune_plan: str | None = None) -> dict[str, Any]:
     """Canonical config fingerprint.  ``backend`` defaults to the
     platform half of ``device`` (``"tpu/TPU v5 lite"`` -> ``"tpu"``) —
     the field the baseline isolation hinges on.  ``fuse_plan`` is the
@@ -102,7 +106,10 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
     and an unfused one are different programs, so they must never pool
     into one baseline band.  ``replicas`` is the serving-fleet size —
     a one-engine capture (the default, 1) and an N-replica routed
-    capture are different deployments with different qps bands."""
+    capture are different deployments with different qps bands.
+    ``tune_plan`` is the lowering-autotuner table id
+    (``Net.tune_plan_id()``): tuned lowerings are a different program
+    than the hardcoded defaults ("off"), same isolation argument."""
     if backend is None and device:
         backend = str(device).split("/", 1)[0]
     return {"model": model or "unknown", "dtype": dtype or "unknown",
@@ -111,7 +118,8 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
             "device": device or "unknown",
             "backend": backend or "unknown",
             "fuse_plan": fuse_plan or "off",
-            "replicas": int(replicas) if replicas is not None else 1}
+            "replicas": int(replicas) if replicas is not None else 1,
+            "tune_plan": tune_plan or "off"}
 
 
 def fp_key(fp: Mapping[str, Any]) -> str:
@@ -419,6 +427,7 @@ def entries_from_bench(doc: Mapping[str, Any], path: str | None = None, *,
     model = _model_from_metric(doc.get("metric")) or "unknown"
     batch = doc.get("batch")
     fuse = doc.get("fuse_plan")
+    tune = doc.get("tune_plan")
     out: list[dict] = []
 
     by_dtype = doc.get("by_dtype") or {
@@ -433,7 +442,8 @@ def entries_from_bench(doc: Mapping[str, Any], path: str | None = None, *,
     for dtype, run in by_dtype.items():
         fp = fingerprint(model=model, dtype=dtype, batch=batch, world=1,
                          device=device, fuse_plan=run.get("fuse_plan")
-                         or fuse)
+                         or fuse,
+                         tune_plan=run.get("tune_plan") or tune)
         metrics = {
             "train_img_s": run.get("images_per_sec"),
             "eval_img_s": run.get("eval_images_per_sec"),
@@ -601,7 +611,8 @@ def entries_from_op_table(doc: Mapping[str, Any],
                      dtype=summary.get("dtype"),
                      batch=summary.get("batch"), world=1,
                      device=summary.get("device"),
-                     fuse_plan=summary.get("fuse_plan"))
+                     fuse_plan=summary.get("fuse_plan"),
+                     tune_plan=summary.get("tune_plan"))
     # profile captures run with profiling overhead — their MFU/img_s
     # must not pool into the bench baselines, hence the profile_ prefix
     metrics: dict[str, Any] = {
@@ -622,6 +633,60 @@ def entries_from_op_table(doc: Mapping[str, Any],
                        {k: v for k, v in metrics.items() if v is not None},
                        round_tag=round_tag, t=t,
                        notes=f"mode={mode}" if mode else None)]
+
+
+def entries_from_tuning_table(doc: Mapping[str, Any],
+                              path: str | None = None, *,
+                              round_tag: str | None = None,
+                              t: float | None = None) -> list[dict]:
+    """``profiles/<backend>/tuning.json`` (graph/tuner.py): every
+    candidate timing at every key becomes a metric, so the next capture
+    of the same key gates against this one — the staleness check's
+    noise-band argument, but with the ledger's MAD bands and full
+    history behind it.  Metric names: ``tune_ms/<key>`` for the winner
+    (the ``_ms`` suffix makes lower better, like every other timing),
+    ``tune_cand_ms/<key>=<candidate>`` for the rest, and
+    ``tune_margin/<key>`` for the winner's lead over the runner-up
+    (suffix-less -> higher is better: a shrinking margin is the early
+    rot signal)."""
+    if doc.get("kind") != "tuning_table":
+        return []
+    entries = doc.get("entries") or []
+    if not entries:
+        return []
+    prov = doc.get("provenance") or {}
+    backend = doc.get("backend") or "unknown"
+    device = (prov.get("fingerprint") or {}).get("device")
+    if not device or device == "unknown":
+        device = backend
+    dtypes = {parts[2] for e in entries
+              if len(parts := str(e.get("key", "")).split("/")) >= 3}
+    fp = fingerprint(model="tuner",
+                     dtype=dtypes.pop() if len(dtypes) == 1 else "mixed",
+                     batch=0, world=1, device=device, backend=backend,
+                     tune_plan=doc.get("table_id"))
+    metrics: dict[str, Any] = {}
+    for e in entries:
+        key, winner = e.get("key"), e.get("winner")
+        if not key or not winner:
+            continue
+        for cand, rec in (e.get("timings") or {}).items():
+            if not isinstance(rec, Mapping) or rec.get("ms") is None:
+                continue  # typed skip — no measurement, never 0
+            if cand == winner:
+                metrics[f"tune_ms/{key}"] = rec["ms"]
+            else:
+                metrics[f"tune_cand_ms/{key}={cand}"] = rec["ms"]
+        if e.get("margin") is not None:
+            metrics[f"tune_margin/{key}"] = e["margin"]
+    if not metrics:
+        return []
+    ts = [e.get("measured_at") for e in entries
+          if isinstance(e.get("measured_at"), (int, float))]
+    return [make_entry("tuning", path, fp, metrics, round_tag=round_tag,
+                       t=t if t is not None else (max(ts) if ts else None),
+                       sha=prov.get("git_sha"), run=prov.get("run"),
+                       rank=prov.get("rank"), job=prov.get("job"))]
 
 
 def entries_from_metrics_rollup(folded: Mapping[str, Any],
@@ -671,6 +736,9 @@ def entries_from_any(doc: Mapping[str, Any], path: str | None = None, *,
     if doc.get("metric") == "serving_fleet_scaling_x":
         return entries_from_serving_fleet(doc, path, round_tag=round_tag,
                                           t=t, device_hint=device_hint)
+    if doc.get("kind") == "tuning_table":
+        return entries_from_tuning_table(doc, path, round_tag=round_tag,
+                                         t=t)
     if "summary" in doc and "by_category" in doc:
         return entries_from_op_table(doc, path, round_tag=round_tag, t=t)
     if "stall_total_sync_s" in doc:

@@ -105,6 +105,24 @@ def record_fusion_plan(net, out_dir: str | None = None) -> str:
     return net.fuse_plan_id()
 
 
+def record_tuning(net, out_dir: str | None = None) -> str:
+    """The capture-stamping half of the lowering autotuner
+    (graph/tuner.py), mirroring :func:`record_fusion_plan`: returns the
+    net's tune-plan id (the perf-ledger ``tune_plan`` fingerprint field
+    — "off" when no table is active) and, given a profile ``out_dir``,
+    copies the active tuning table next to the op_table so the capture
+    is reproducible — ``SPARKNET_TUNE=<that file>`` replays exactly the
+    lowerings this capture ran."""
+    import os
+    from ..graph import tuner
+    tune_id = net.tune_plan_id() if hasattr(net, "tune_plan_id") else "off"
+    if out_dir is not None and tune_id != "off":
+        table = tuner.active_table()
+        if table is not None and table.table_id() == tune_id:
+            table.save(os.path.join(out_dir, "tuning.json"))
+    return tune_id
+
+
 def step_cost_flops(solver, batch) -> float | None:
     """Model FLOPs of one compiled train step via XLA cost analysis
     (best-effort; a fori_loop block would undercount — cost the single

@@ -68,7 +68,24 @@ def time_block(name: str, make_iter, iters: int = 0,
     The tunneled chip has a ~0.1 s per-dispatch floor, so the trip count
     is a *traced* fori_loop bound (one compile) calibrated per experiment
     until the block runs ≥ TARGET_BLOCK_S; the floor is then subtracted
-    out by differencing two block sizes (N and N/2)."""
+    out by differencing two block sizes (N and N/2).
+
+    A candidate that RAISES (Pallas kernel on CPU, an op a backend can't
+    lower, OOM on a small rig) records a typed ``skipped`` entry and
+    returns None instead of aborting the whole probe run — callers must
+    treat a None per-iter time as "no measurement", never 0.  The
+    autotuner (sparknet_tpu/graph/tuner.py) inherits this contract."""
+    try:
+        return _time_block_measured(name, make_iter, extra)
+    except Exception as e:  # noqa: BLE001 — typed skip, not abort
+        msg = str(e).strip().split("\n")[0][:200]
+        reason = f"{type(e).__name__}: {msg}" if msg else type(e).__name__
+        emit({"exp": name, "skipped": reason, **(extra or {})})
+        log(f"{name}: SKIPPED ({reason})")
+        return None
+
+
+def _time_block_measured(name: str, make_iter, extra: dict | None = None):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -432,6 +449,7 @@ def run_poolbwd() -> None:
     dtype = jnp.bfloat16 if os.environ.get(
         "PROBE_DTYPE", "bf16") == "bf16" else jnp.float32
     totals = {"s&s": 0.0, "pallas": 0.0}
+    skipped: set = set()
     for name, c, hw, k, s, p in GOOGLENET_POOLS:
         oh, ow = pool_output_size(hw, hw, k, k, s, s, p, p)
         x = jnp.asarray(
@@ -454,10 +472,17 @@ def run_poolbwd() -> None:
             ms = time_block(f"poolbwd_{name}_{label}", make_iter(fn), 0,
                             extra={"c": c, "hw": hw, "stride": s,
                                    "batch": batch, "dtype": str(dtype.__name__)})
-            totals["s&s" if label == "ss" else "pallas"] += ms
+            key = "s&s" if label == "ss" else "pallas"
+            if ms is None:  # typed skip (e.g. Pallas on CPU) — a total
+                skipped.add(key)  # with holes would read as a win
+            else:
+                totals[key] += ms
     emit({"exp": "poolbwd_total_ms_per_step",
-          "select_and_scatter": round(totals["s&s"], 3),
-          "pallas_vmem": round(totals["pallas"], 3),
+          "select_and_scatter": (None if "s&s" in skipped
+                                 else round(totals["s&s"], 3)),
+          "pallas_vmem": (None if "pallas" in skipped
+                          else round(totals["pallas"], 3)),
+          "incomplete": sorted(skipped) or None,
           "note": "sum over all 13 GoogLeNet pools, fwd+bwd per iter"})
     log(f"poolbwd totals: s&s {totals['s&s']:.2f} ms vs pallas "
         f"{totals['pallas']:.2f} ms per step-equivalent")
@@ -530,18 +555,23 @@ def run_lrn() -> None:
                                   extra=extra)
                 fb_ms = time_block(f"lrn_{name}_{variant}_fwdbwd", fwdbwd,
                                    extra=extra)
-                # effective traffic at the fwd floor: read x, write y
-                results.setdefault(name, {})[variant] = fb_ms
-                results[name][f"{variant}_fwd_gbps"] = round(
-                    2 * nbytes / max(f_ms, 1e-6) / 1e6, 1)
+                # None = typed skip (time_block contract) — leave the
+                # variant out of the verdict rather than divide by it
+                if fb_ms is not None:
+                    results.setdefault(name, {})[variant] = fb_ms
+                if f_ms is not None:
+                    # effective traffic at the fwd floor: read x, write y
+                    results.setdefault(name, {})[f"{variant}_fwd_gbps"] = \
+                        round(2 * nbytes / max(f_ms, 1e-6) / 1e6, 1)
     finally:
         if saved is None:
             os.environ.pop("SPARKNET_LRN_CUMSUM", None)
         else:
             os.environ["SPARKNET_LRN_CUMSUM"] = saved
     verdict = {
-        name: {"speedup_fwdbwd": round(r["reduce_window"]
-                                       / max(r["cumsum"], 1e-9), 3),
+        name: {"speedup_fwdbwd": (
+                   round(r["reduce_window"] / max(r["cumsum"], 1e-9), 3)
+                   if "reduce_window" in r and "cumsum" in r else None),
                **{k: v for k, v in r.items()}}
         for name, r in results.items()}
     emit({"exp": "lrn_verdict", "dtype": str(jnp.dtype(dtype)),

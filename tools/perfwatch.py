@@ -147,6 +147,9 @@ def cmd_ingest(args) -> int:
         for op_table in sorted(glob.glob(
                 os.path.join(REPO, "profiles", "*", "op_table.json"))):
             total += _ingest_file(ledger, op_table, backfill=True)
+        for tuning in sorted(glob.glob(
+                os.path.join(REPO, "profiles", "*", "tuning.json"))):
+            total += _ingest_file(ledger, tuning, backfill=True)
     for path in args.files:
         total += _ingest_file(ledger, path, device_hint=args.device_hint,
                               round_tag=args.round)

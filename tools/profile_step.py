@@ -65,6 +65,7 @@ def main() -> None:
         eval_cost_flops,
         peak_flops,
         record_fusion_plan,
+        record_tuning,
         scanned_eval_block,
         scanned_train_block,
         step_cost_flops,
@@ -155,6 +156,7 @@ def main() -> None:
         "model": args.model, "batch": args.batch, "dtype": args.dtype,
         "mode": "eval_forward" if args.eval else "train_step",
         "fuse_plan": record_fusion_plan(prof_net, out_dir),
+        "tune_plan": record_tuning(prof_net, out_dir),
         "device": f"{dev.platform}/{dev.device_kind}",
         "step_ms": round(step_s * 1e3, 2),
         "img_s": round(args.batch / step_s, 1),

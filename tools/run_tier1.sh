@@ -139,6 +139,22 @@ maybe_fusebench() {
   fi
 }
 
+# ~10-second lowering-autotuner self-test (tools/tune.py tunebench) —
+# opt-in via SPARKNET_TUNEBENCH=1.  Tunes a 2-op synthetic net on CPU
+# and fails unless the measured winner beats a planted 3x-work slow
+# candidate, a planted numerics-bad candidate is disqualified before it
+# can win, SPARKNET_TUNE=off vs the fresh table is forward-bit-identical
+# (grads <= 1e-5) through the production layers, the fresh table passes
+# the staleness gate, and a planted rotten winner fails it.  (The same
+# contracts run in-process in tests/test_tuner.py; the committed-table
+# parity tests there cover the real profiles/cpu/tuning.json.)
+maybe_tunebench() {
+  if [ "${SPARKNET_TUNEBENCH:-}" = "1" ]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python tools/tune.py tunebench --json /tmp/_tunebench.json
+  fi
+}
+
 # ~10-second performance gate (tools/perfwatch.py perfgate) — opt-in
 # via SPARKNET_PERFGATE=1.  Runs a ~2s-leg CPU bench smoke through the
 # regression sentinel against the committed perf/LEDGER.jsonl (CPU
@@ -164,14 +180,16 @@ case "${1:-}" in
   --obssmoke) SPARKNET_OBSSMOKE=1 maybe_obssmoke ;;
   --perfgate) SPARKNET_PERFGATE=1 maybe_perfgate ;;
   --fusebench) SPARKNET_FUSEBENCH=1 maybe_fusebench ;;
+  --tunebench) SPARKNET_TUNEBENCH=1 maybe_tunebench ;;
   --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
              && maybe_feedbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
-             && maybe_obssmoke && maybe_fusebench && maybe_perfgate ;;
+             && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
+             && maybe_perfgate ;;
   "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
              && maybe_roundbench && maybe_obssmoke \
-             && maybe_fusebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--perfgate|--all]" >&2
+             && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
+  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac
