@@ -406,7 +406,8 @@ def run_child() -> None:
         keep = max(3, (lag + 1 + every - 1 + every - 1) // every + 1)
 
         def leg(name: str, async_on: bool, instrumented: bool) -> dict:
-            saved = os.environ.get("SPARKNET_ASYNC_CKPT")
+            from sparknet_tpu.utils import knobs
+            saved = knobs.raw("SPARKNET_ASYNC_CKPT")
             os.environ["SPARKNET_ASYNC_CKPT"] = "1" if async_on else "0"
             try:
                 with tempfile.TemporaryDirectory() as ck:

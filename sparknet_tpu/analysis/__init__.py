@@ -1,0 +1,29 @@
+"""sparklint — the project-contract static analyzer.
+
+AST-based, multi-pass, stdlib-only (runs in CI without JAX devices).
+Four rule families, each machine-checking a contract the repo already
+claims in prose:
+
+- **TP (trace purity)** — functions reachable from jit / custom_vjp /
+  pallas_call roots must not read env, clocks, host RNG, files, or
+  print: those silently bake trace-time constants into compiled code
+  and break off-vs-auto bit parity and jit cache keys.
+- **KR (knob registry)** — every ``SPARKNET_*`` env read resolves
+  through the typed registry in ``utils/knobs.py``; unregistered
+  reads, registry bypasses, dead registrations, and KNOBS.md drift are
+  errors.
+- **CD (concurrency discipline)** — classes that spawn threads guard
+  cross-thread attribute mutation (or declare ``_unguarded_ok``),
+  worker loops surface errors as typed failures instead of swallowing
+  them, and broad ``except`` needs a reason.
+- **DP (deprecation hygiene)** — knobs/symbols past their one-release
+  window fail lint wherever they still appear.
+
+Entry points: :func:`sparknet_tpu.analysis.engine.load_project`,
+:func:`sparknet_tpu.analysis.engine.run_rules`, and the
+``tools/lint.py`` CLI.  See WALKTHROUGH §6.16 for the suppression
+(``# sparklint: disable=...``) and baseline workflow.
+"""
+
+from .core import Baseline, Finding, SourceFile, Project  # noqa: F401
+from .engine import load_project, run_rules  # noqa: F401

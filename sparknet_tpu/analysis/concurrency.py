@@ -1,0 +1,235 @@
+"""CD rules — thread and typed-error discipline.
+
+The repo's thread contract (DecodePool / EngineDead, WALKTHROUGH §6.10
+and §6.13): worker death surfaces as a typed error on every waiter,
+never a hang and never a silent swallow; shared state mutated from
+both sides of a thread boundary is guarded by a held lock unless the
+class explicitly declares the attribute in an ``_unguarded_ok``
+allowlist (the GIL makes single-word flag writes atomic — the
+allowlist records that the author THOUGHT about it).
+
+  CD001  a class that spawns threading.Thread mutates an attribute
+         from both the spawning side and the worker side with at
+         least one write outside any ``with self.<lock>:`` block, and
+         the attribute is not in ``_unguarded_ok``
+  CD002  a broad except inside a thread-worker method that swallows
+         the error: no re-raise, no use of the caught exception, no
+         parking it on self for a waiter to find
+  CD003  broad ``except Exception`` / ``except BaseException`` / bare
+         ``except`` anywhere — narrow it to the module's typed errors,
+         or baseline it with a reason
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, SourceFile, dotted
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for item in types:
+        if dotted(item).rpartition(".")[2] in _BROAD:
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, sf: SourceFile, node: ast.ClassDef) -> None:
+        self.sf = sf
+        self.node = node
+        self.methods: dict[str, ast.AST] = {
+            it.name: it for it in node.body
+            if isinstance(it, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.unguarded_ok = self._allowlist(node)
+        self.worker_roots = self._thread_targets(node)
+        self.worker_set = self._closure(self.worker_roots)
+
+    @staticmethod
+    def _allowlist(node: ast.ClassDef) -> set[str]:
+        for it in node.body:
+            if isinstance(it, ast.Assign):
+                names = [t.id for t in it.targets
+                         if isinstance(t, ast.Name)]
+                if "_unguarded_ok" in names:
+                    val = it.value
+                    if isinstance(val, ast.Call):  # frozenset({...})
+                        val = val.args[0] if val.args else val
+                    if isinstance(val, (ast.Set, ast.Tuple, ast.List)):
+                        return {e.value for e in val.elts
+                                if isinstance(e, ast.Constant)}
+        return set()
+
+    def _thread_targets(self, node: ast.ClassDef) -> set[str]:
+        roots: set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and \
+                    dotted(n.func).rpartition(".")[2] == "Thread":
+                for kw in n.keywords:
+                    if kw.arg == "target":
+                        attr = _self_attr(kw.value)
+                        if attr and attr in self.methods:
+                            roots.add(attr)
+        return roots
+
+    def _closure(self, roots: set[str]) -> set[str]:
+        seen: set[str] = set()
+        work = list(roots)
+        while work:
+            name = work.pop()
+            if name in seen or name not in self.methods:
+                continue
+            seen.add(name)
+            for n in ast.walk(self.methods[name]):
+                if isinstance(n, ast.Call):
+                    attr = _self_attr(n.func)
+                    if attr and attr in self.methods:
+                        work.append(attr)
+        return seen
+
+    def writes(self, method: str) -> list[tuple[str, int, bool]]:
+        """(attr, line, guarded) for every ``self.x = ...`` in method,
+        guarded = lexically inside ``with self.<attr>:``."""
+        out: list[tuple[str, int, bool]] = []
+
+        def targets_of(node: ast.AST) -> list[ast.AST]:
+            if isinstance(node, ast.Assign):
+                return list(node.targets)
+            if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                return [node.target]
+            return []
+
+        def flat(t: ast.AST) -> list[ast.AST]:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                return [x for e in t.elts for x in flat(e)]
+            return [t]
+
+        def walk(node: ast.AST, depth: int) -> None:
+            inc = 0
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                if any(_self_attr(item.context_expr)
+                       for item in node.items):
+                    inc = 1
+            for t in targets_of(node):
+                for leaf in flat(t):
+                    attr = _self_attr(leaf)
+                    if attr:
+                        out.append((attr, leaf.lineno, depth > 0))
+            for child in ast.iter_child_nodes(node):
+                walk(child, depth + inc)
+
+        walk(self.methods[method], 0)
+        return out
+
+
+def _check_class(project: Project, sf: SourceFile,
+                 info: _ClassInfo) -> tuple[list[Finding],
+                                            set[tuple[str, int]]]:
+    findings: list[Finding] = []
+    cd2_sites: set[tuple[str, int]] = set()
+    if not info.worker_roots:
+        return findings, cd2_sites
+
+    # CD001 — cross-thread unguarded mutation
+    side_writes: dict[str, dict[str, list[tuple[str, int, bool]]]] = \
+        {"main": {}, "worker": {}}
+    for name in info.methods:
+        if name == "__init__":
+            continue  # construction happens-before thread start
+        side = "worker" if name in info.worker_set else "main"
+        for attr, line, guarded in info.writes(name):
+            side_writes[side].setdefault(attr, []).append(
+                (name, line, guarded))
+    for attr in sorted(set(side_writes["main"]) & set(side_writes["worker"])):
+        if attr in info.unguarded_ok:
+            continue
+        all_writes = side_writes["main"][attr] + side_writes["worker"][attr]
+        unguarded = [w for w in all_writes if not w[2]]
+        if not unguarded:
+            continue
+        _, line, _ = min(unguarded, key=lambda w: w[1])
+        f = project.finding(
+            sf, "CD001", "error", line,
+            f"{info.node.name}.{attr} is written from both the spawning "
+            f"side and the thread side with an unguarded write",
+            "hold the class lock for every write, or declare the attr in "
+            "_unguarded_ok with a comment saying why a bare write is safe")
+        if f:
+            findings.append(f)
+
+    # CD002 — swallow in worker loop
+    for name in sorted(info.worker_set):
+        for node in ast.walk(info.methods[name]):
+            if not isinstance(node, ast.ExceptHandler) or \
+                    not _is_broad(node):
+                continue
+            if _handler_surfaces(node):
+                continue
+            f = project.finding(
+                sf, "CD002", "error", node.lineno,
+                f"broad except in thread worker "
+                f"{info.node.name}.{name} swallows the error",
+                "re-raise as the module's typed error, or park it on "
+                "self (self._err = e) for the waiter contract to surface")
+            if f:
+                findings.append(f)
+            cd2_sites.add((sf.rel, node.lineno))
+    return findings, cd2_sites
+
+
+def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises, uses the caught error, parks
+    state on self, or delegates to a method (assumed to surface)."""
+    caught = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if caught and isinstance(node, ast.Name) and node.id == caught:
+            return True
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(_self_attr(t) for t in targets):
+                return True
+        if isinstance(node, ast.Call) and _self_attr(node.func):
+            return True
+    return False
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    cd2_sites: set[tuple[str, int]] = set()
+    for sf in project.files:
+        for node in ast.iter_child_nodes(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                fs, sites = _check_class(project, sf, _ClassInfo(sf, node))
+                findings.extend(fs)
+                cd2_sites.update(sites)
+    # CD003 — broad except anywhere (CD002 sites already reported)
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                    and (sf.rel, node.lineno) not in cd2_sites:
+                kind = "bare except" if node.type is None else \
+                    f"except {ast.unparse(node.type)}"
+                f = project.finding(
+                    sf, "CD003", "error", node.lineno,
+                    f"overbroad handler: {kind}",
+                    "narrow to the module's typed errors, or baseline "
+                    "with a one-line reason")
+                if f:
+                    findings.append(f)
+    return findings

@@ -1,0 +1,196 @@
+"""sparklint core: findings, source files, suppressions, baseline.
+
+Everything here is deliberately stdlib-only and JAX-free — the linter
+must run on a machine with no accelerator and no heavy imports, in
+about a second, so it can sit in tier-1 CI unconditionally.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+
+# Trailing same-line or next-line suppression:
+#   x = 1  # sparklint: disable=TP001,CD003
+#   # sparklint: disable-next-line=KR002
+_SUPPRESS_RE = re.compile(
+    r"#\s*sparklint:\s*disable(?P<next>-next-line)?\s*=\s*"
+    r"(?P<rules>all|[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str          # e.g. "TP001"
+    severity: str      # "error" | "warning"
+    path: str          # repo-relative, forward slashes
+    line: int
+    symbol: str        # enclosing def/class qualname, or "<module>"
+    message: str
+    fix: str = ""      # one-line fix hint
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: line-number-free so entries survive
+        unrelated edits above the finding."""
+        return (self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        out = (f"{self.path}:{self.line}: {self.rule} {self.severity} "
+               f"[{self.symbol}] {self.message}")
+        if self.fix:
+            out += f"\n    fix: {self.fix}"
+        return out
+
+
+class SourceFile:
+    """A parsed file plus the lookups every rule needs: suppression
+    lines, and line -> enclosing-scope qualname."""
+
+    def __init__(self, root: Path, rel: str, text: str) -> None:
+        self.rel = rel
+        self.path = root / rel
+        self.text = text
+        self.tree = ast.parse(text, filename=rel)
+        self.module = self._module_name(rel)
+        self.package = self.module.rpartition(".")[0]
+        self._suppress = self._parse_suppressions(text)
+        self._scopes = self._index_scopes(self.tree)
+
+    @staticmethod
+    def _module_name(rel: str) -> str:
+        parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    @staticmethod
+    def _parse_suppressions(text: str) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            target = i + 1 if m.group("next") else i
+            out.setdefault(target, set()).update(rules)
+        return out
+
+    @staticmethod
+    def _index_scopes(tree: ast.AST) -> list[tuple[int, int, str]]:
+        """(start, end, qualname) for every def/class, innermost
+        resolvable by smallest span."""
+        scopes: list[tuple[int, int, str]] = []
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    scopes.append((child.lineno,
+                                   child.end_lineno or child.lineno, qual))
+                    visit(child, qual)
+                else:
+                    visit(child, prefix)
+
+        visit(tree, "")
+        return scopes
+
+    def symbol_at(self, line: int) -> str:
+        best = None
+        for start, end, qual in self._scopes:
+            if start <= line <= end:
+                if best is None or (end - start) < (best[1] - best[0]):
+                    best = (start, end, qual)
+        return best[2] if best else "<module>"
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self._suppress.get(line, ())
+        return "all" in rules or rule in rules
+
+
+class Project:
+    """The scanned file set plus cross-file lookups."""
+
+    def __init__(self, root: Path, files: list[SourceFile]) -> None:
+        self.root = root
+        self.files = files
+        self.by_module = {f.module: f for f in files}
+        self.by_rel = {f.rel: f for f in files}
+
+    def finding(self, sf: SourceFile, rule: str, severity: str, line: int,
+                message: str, fix: str = "") -> Finding | None:
+        """Build a finding unless a suppression comment covers it."""
+        if sf.suppressed(line, rule):
+            return None
+        return Finding(rule, severity, sf.rel, line, sf.symbol_at(line),
+                       message, fix)
+
+
+class Baseline:
+    """Committed grandfather list for findings that are correct by
+    design (trace-time knobs, deliberate broad excepts).  Entries are
+    keyed (rule, path, symbol) — no line numbers — and each carries a
+    mandatory one-line reason; `tools/lint.py baseline` regenerates the
+    file, preserving reasons for surviving keys."""
+
+    VERSION = 1
+
+    def __init__(self, entries: list[dict[str, str]]) -> None:
+        for e in entries:
+            missing = {"rule", "path", "symbol", "reason"} - set(e)
+            if missing:
+                raise ValueError(f"baseline entry {e} missing {missing}")
+            if not e["reason"].strip():
+                raise ValueError(
+                    f"baseline entry for {e['rule']} at {e['path']} "
+                    f"[{e['symbol']}] needs a non-empty reason")
+        self.entries = entries
+        self._keys = {(e["rule"], e["path"], e["symbol"]) for e in entries}
+        self._hit: set[tuple[str, str, str]] = set()
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        doc = json.loads(path.read_text())
+        if doc.get("kind") != "sparklint_baseline" or \
+                doc.get("version") != cls.VERSION:
+            raise ValueError(f"{path}: not a v{cls.VERSION} sparklint "
+                             f"baseline file")
+        return cls(doc["entries"])
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls([])
+
+    def covers(self, finding: Finding) -> bool:
+        if finding.key() in self._keys:
+            self._hit.add(finding.key())
+            return True
+        return False
+
+    def unused(self) -> list[dict[str, str]]:
+        return [e for e in self.entries
+                if (e["rule"], e["path"], e["symbol"]) not in self._hit]
+
+    @staticmethod
+    def render(entries: list[dict[str, str]]) -> str:
+        doc = {"kind": "sparklint_baseline", "version": Baseline.VERSION,
+               "entries": sorted(entries, key=lambda e: (
+                   e["rule"], e["path"], e["symbol"]))}
+        return json.dumps(doc, indent=1) + "\n"
+
+
+def dotted(node: ast.AST) -> str:
+    """'jax.experimental.pallas_call' for a Name/Attribute chain, ''
+    when the expression is anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""

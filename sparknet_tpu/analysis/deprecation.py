@@ -1,0 +1,67 @@
+"""DP rules — deprecation hygiene.
+
+The registry gives every knob a lifecycle: live -> ``deprecated``
+(one release, DP001 warning) -> ``removed`` (tombstone, DP002 error).
+Symbols follow the same arc through ``knobs.DEPRECATED_SYMBOLS``.
+This is the rule that would have flagged the PR-12 LRN-cumsum /
+fuse-pallas env shims the moment their window closed, instead of a
+ROADMAP note owing their deletion.
+
+  DP001  use of a knob inside its deprecation window (warning — fix
+         before the window closes)
+  DP002  mention of a removed knob outside the registry tombstone
+  DP003  reference to a symbol past its deprecation window
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project
+
+_KNOBS_MODULE = "sparknet_tpu/utils/knobs.py"
+
+
+def check(project: Project) -> list[Finding]:
+    from sparknet_tpu.utils import knobs
+
+    deprecated = {k.name: k.deprecated for k in knobs.all_knobs()
+                  if k.deprecated and not k.removed}
+    removed = {k.name: k.removed for k in knobs.all_knobs() if k.removed}
+    dead_syms = dict(knobs.DEPRECATED_SYMBOLS)
+
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.rel == _KNOBS_MODULE:
+            continue  # the tombstones themselves live here
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                if node.value in removed:
+                    f = project.finding(
+                        sf, "DP002", "error", node.lineno,
+                        f"{node.value} was removed ({removed[node.value]}) "
+                        f"but is still mentioned here",
+                        "delete the mention; the registry tombstone names "
+                        "the replacement")
+                    if f:
+                        findings.append(f)
+                elif node.value in deprecated:
+                    f = project.finding(
+                        sf, "DP001", "warning", node.lineno,
+                        f"{node.value} is deprecated "
+                        f"({deprecated[node.value]})",
+                        "migrate before the one-release window closes")
+                    if f:
+                        findings.append(f)
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                name = node.id if isinstance(node, ast.Name) else node.attr
+                if name in dead_syms:
+                    f = project.finding(
+                        sf, "DP003", "error", node.lineno,
+                        f"{name} is past its deprecation window "
+                        f"({dead_syms[name]})",
+                        "delete the reference")
+                    if f:
+                        findings.append(f)
+    return findings

@@ -1,0 +1,76 @@
+"""sparklint engine: file discovery, rule dispatch, baseline filter.
+
+Scan scope is production code only: ``sparknet_tpu/``, ``tools/`` and
+``bench.py``.  Tests are intentionally out of scope — they monkeypatch
+env and swallow exceptions as a matter of technique — as are generated
+files (``*_pb2.py``) and caches.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Callable, Iterable
+
+from . import concurrency, deprecation, knobrules, purity
+from .core import Baseline, Finding, Project, SourceFile
+
+SCAN_DIRS = ("sparknet_tpu", "tools")
+SCAN_FILES = ("bench.py",)
+BASELINE_REL = "tools/lint_baseline.json"
+
+RULE_FAMILIES: dict[str, Callable[[Project], list[Finding]]] = {
+    "purity": purity.check,
+    "knobs": knobrules.check,
+    "concurrency": concurrency.check,
+    "deprecation": deprecation.check,
+}
+
+
+def iter_source_rels(root: Path) -> list[str]:
+    rels: list[str] = []
+    for d in SCAN_DIRS:
+        base = root / d
+        if not base.is_dir():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            rel = p.relative_to(root).as_posix()
+            if "__pycache__" in rel or rel.endswith("_pb2.py"):
+                continue
+            rels.append(rel)
+    for f in SCAN_FILES:
+        if (root / f).is_file():
+            rels.append(f)
+    return rels
+
+
+def load_project(root: Path, rels: Iterable[str] | None = None) -> Project:
+    rels = list(rels) if rels is not None else iter_source_rels(root)
+    files = []
+    for rel in rels:
+        text = (root / rel).read_text()
+        files.append(SourceFile(root, rel, text))
+    return Project(root, files)
+
+
+def run_rules(project: Project,
+              families: Iterable[str] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for name in (families or RULE_FAMILIES):
+        findings.extend(RULE_FAMILIES[name](project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: Baseline) -> tuple[list[Finding],
+                                                list[Finding]]:
+    """-> (kept, grandfathered)."""
+    kept, covered = [], []
+    for f in findings:
+        (covered if baseline.covers(f) else kept).append(f)
+    return kept, covered
+
+
+def default_baseline(root: Path) -> Baseline:
+    path = root / BASELINE_REL
+    return Baseline.load(path) if path.exists() else Baseline.empty()

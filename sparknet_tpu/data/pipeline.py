@@ -51,11 +51,11 @@ from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 
 
 def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
+    raw = knobs.raw(name, "")
     if not raw:
         return default
     try:

@@ -36,7 +36,7 @@ from typing import Any, Callable, Iterator, Mapping
 
 import jax
 
-from ..utils import faults, telemetry
+from ..utils import faults, knobs, telemetry
 
 
 class FeedStalled(RuntimeError):
@@ -70,6 +70,11 @@ class PrefetchIterator:
       hang between pulls therefore loses no records.
     """
 
+    # _err is the park-then-reraise handoff: the feeder writes it once
+    # and then only the consumer reads/raises it; attribute stores are
+    # atomic under the GIL, so the watchdog's overwrite needs no lock
+    _unguarded_ok = frozenset({"_err"})
+
     _SENTINEL = object()
 
     def __init__(self, it: Iterator[Any], depth: int = 2,
@@ -91,7 +96,7 @@ class PrefetchIterator:
         self._produced = 0    # records pulled from the source (feeder side)
         self._delivered = 0   # batches handed to the consumer
         if stall_timeout is None:
-            env = os.environ.get("SPARKNET_FEED_STALL_S", "")
+            env = knobs.raw("SPARKNET_FEED_STALL_S", "")
             stall_timeout = float(env) if env else None
         self._stall_timeout = stall_timeout
         # chaos hook: SPARKNET_FAULT=slow_feed:<dur> models a degraded
@@ -281,8 +286,7 @@ class DeviceFeed:
         # round-trips; on a bandwidth-bound link they are neutral.  HBM
         # staging stays bounded at putters + 1 batches either way.
         if putters is None:
-            putters = max(1, int(os.environ.get("SPARKNET_FEED_PUTTERS",
-                                                "2") or 2))
+            putters = max(1, knobs.get_int("SPARKNET_FEED_PUTTERS", 2))
         self.stats = stats
         self._sharding = sharding
         self._cast = dict(device_cast) if device_cast else None

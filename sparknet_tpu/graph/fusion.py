@@ -50,6 +50,8 @@ import json
 import os
 from typing import TYPE_CHECKING, Any, Mapping
 
+from ..utils import knobs
+
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from .net import Net
 
@@ -507,7 +509,7 @@ def resolve_plan(net: "Net") -> FusionPlan | None:
     ``auto`` (default) -> derive from the committed profile worklist —
     models without a committed profile run unfused; ``all`` -> every
     legal chain; anything else -> a plan-file path."""
-    env = (os.environ.get("SPARKNET_FUSE") or "auto").strip()
+    env = (knobs.raw("SPARKNET_FUSE") or "auto").strip()
     if env in ("off", "0"):
         return None
     if env == "all":

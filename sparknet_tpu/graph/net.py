@@ -228,9 +228,9 @@ class Net:
         SPARKNET_NO_HFUSE after the first jitted step can never retrace
         the cached executable, so a per-trace read would silently ignore
         the flip.  Per-Net-instance it is at least deterministic."""
-        import os
         from ..ops.vision import conv_geometry
-        self._hfuse_enabled = os.environ.get("SPARKNET_NO_HFUSE") != "1"
+        from ..utils import knobs
+        self._hfuse_enabled = knobs.raw("SPARKNET_NO_HFUSE") != "1"
         ver: dict[str, int] = {}
         groups: dict[tuple, list[_LayerNode]] = {}
         for node in self.nodes:

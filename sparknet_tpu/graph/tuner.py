@@ -35,9 +35,11 @@ vs ``off``.  Non-bit-exact candidates (cumsum vs reduce_window, im2col)
 are still timed and persisted for the record (they are how the default
 heuristics get re-litigated), but only ``--allow-inexact`` lets one win.
 
-The deprecated per-op env pins (``SPARKNET_LRN_CUMSUM``,
-``SPARKNET_FUSE_PALLAS``) route through here as one-release shims that
-map onto pinned table answers and warn once; see :func:`_shim_pin`.
+The pre-tuner per-op env pins completed their one-release deprecation
+window in PR 12 -> 14 and are gone; their names are tombstoned in
+``utils/knobs.py``, so any surviving mention fails sparklint (DP002).
+Pin a lowering by writing a small table and pointing SPARKNET_TUNE at
+it instead.
 """
 
 from __future__ import annotations
@@ -48,8 +50,9 @@ import json
 import os
 import re
 import time
-import warnings
 from typing import Any, Callable
+
+from ..utils import knobs
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -446,11 +449,11 @@ def clear_extra_candidates(op: str | None = None) -> None:
 
 def _timing_params(reps, target_s, warmup):
     if reps is None:
-        reps = int(os.environ.get("SPARKNET_TUNE_REPS", "5"))
+        reps = knobs.get_int("SPARKNET_TUNE_REPS", 5)
     if target_s is None:
-        target_s = float(os.environ.get("SPARKNET_TUNE_TARGET_S", "0.1"))
+        target_s = knobs.get_float("SPARKNET_TUNE_TARGET_S", 0.1)
     if warmup is None:
-        warmup = int(os.environ.get("SPARKNET_TUNE_WARMUP", "2"))
+        warmup = knobs.get_int("SPARKNET_TUNE_WARMUP", 2)
     return max(3, int(reps)), float(target_s), max(1, int(warmup))
 
 
@@ -760,53 +763,10 @@ def build_table(keys, *, reps=None, target_s=None, warmup=None,
 
 
 # ---------------------------------------------------------------------------
-# trace-time resolution: SPARKNET_TUNE + deprecation shims
+# trace-time resolution: SPARKNET_TUNE
 # ---------------------------------------------------------------------------
 
 _TABLE_CACHE: dict[str, tuple[float, TuningTable]] = {}
-_WARNED: set[str] = set()
-
-
-def _warn_once(tag: str, msg: str) -> None:
-    if tag not in _WARNED:
-        _WARNED.add(tag)
-        warnings.warn(msg, DeprecationWarning, stacklevel=3)
-
-
-def deprecated_lrn_cumsum_pin() -> bool | None:
-    """The one-release SPARKNET_LRN_CUMSUM shim: ``=1``/``=0`` still pin
-    the LRN window sum (exactly the retired knob's semantics) but now do
-    it by pinning the table answer, and warn once.  Any other value is
-    ignored, as before.  Remove with the knob next release."""
-    env = os.environ.get("SPARKNET_LRN_CUMSUM", "")
-    if env not in ("0", "1"):
-        return None
-    _warn_once(
-        "SPARKNET_LRN_CUMSUM",
-        "SPARKNET_LRN_CUMSUM is deprecated; it now pins the lowering "
-        "autotuner's lrn answer and will be removed next release — use "
-        "SPARKNET_TUNE=off|auto|<table> (tools/tune.py) instead.")
-    return env == "1"
-
-
-def _shim_pin(op: str) -> str | None:
-    """Deprecated env pins, mapped onto pinned table answers (checked
-    before the table in every SPARKNET_TUNE mode so legacy rigs keep
-    their exact pre-tuner behavior for one release)."""
-    if op == "lrn":
-        pin = deprecated_lrn_cumsum_pin()
-        if pin is not None:
-            return "cumsum" if pin else "reduce_window"
-    if op == "lrn_epilogue":
-        if os.environ.get("SPARKNET_FUSE_PALLAS") == "0":
-            _warn_once(
-                "SPARKNET_FUSE_PALLAS",
-                "SPARKNET_FUSE_PALLAS is deprecated; =0 now pins the "
-                "lowering autotuner's lrn_epilogue answer to the XLA "
-                "reference and will be removed next release — use "
-                "SPARKNET_TUNE=off|auto|<table> (tools/tune.py) instead.")
-            return "reference"
-    return None
 
 
 def default_table_path(backend: str | None = None,
@@ -837,7 +797,7 @@ def active_table() -> TuningTable | None:
     ``profiles/<backend>/tuning.json`` if present; anything else must be
     a readable table path — a typo here must not silently change which
     lowerings execute, so it raises."""
-    env = (os.environ.get("SPARKNET_TUNE") or "auto").strip()
+    env = (knobs.raw("SPARKNET_TUNE") or "auto").strip()
     if env in ("off", "0"):
         return None
     if env in ("auto", "1"):
@@ -864,11 +824,7 @@ def resolve_lowering(op: str, shape, dtype, *, extra: str = "") -> str | None:
     """THE trace-time seam: which lowering should ``op`` use at this
     (shape, dtype) on this backend?  Returns a candidate name, or None
     for "use the hardcoded default" (table miss, SPARKNET_TUNE=off, or
-    no committed table).  Deprecated env pins win over the table so
-    legacy rigs keep their exact behavior during the shim release."""
-    pin = _shim_pin(op)
-    if pin is not None:
-        return pin
+    no committed table)."""
     table = active_table()
     if table is None:
         return None
@@ -876,9 +832,8 @@ def resolve_lowering(op: str, shape, dtype, *, extra: str = "") -> str | None:
 
 
 def _clear_caches() -> None:
-    """Test hook: forget loaded tables and re-arm one-shot warnings."""
+    """Test hook: forget loaded tables."""
     _TABLE_CACHE.clear()
-    _WARNED.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 from typing import Sequence
 
 import jax
@@ -23,6 +22,7 @@ import numpy as np
 from jax import lax
 
 from ..proto.caffe_pb import FillerParameter, LayerParameter
+from ..utils import knobs
 from .fillers import fill
 from .registry import LayerImpl, Shape, register_layer
 
@@ -76,8 +76,7 @@ def _s2d_eligible(c_in: int, kh, kw, sh, sw, ph, pw, dh, dw, group) -> bool:
     SPARKNET_NO_S2D=1 disables it — read at TRACE time: set it before the
     net/Solver is built (jit caches the traced graph; flipping the env
     after compilation has no effect on cached executables)."""
-    import os
-    if os.environ.get("SPARKNET_NO_S2D") == "1":
+    if knobs.raw("SPARKNET_NO_S2D") == "1":
         return False
     return _s2d_geometry_ok(c_in, kh, kw, sh, sw, ph, pw, dh, dw, group)
 
@@ -409,8 +408,7 @@ class PoolingLayer(LayerImpl):
 
     @staticmethod
     def _use_pallas_bwd() -> bool:
-        import os
-        return os.environ.get("SPARKNET_PALLAS_MAXPOOL") == "1"
+        return knobs.raw("SPARKNET_PALLAS_MAXPOOL") == "1"
 
     def apply(self, lp, params, bottoms, train, rng):
         x = bottoms[0]
@@ -461,8 +459,8 @@ def lrn_geometry(lp: LayerParameter):
             str(p.get("norm_region", "ACROSS_CHANNELS")))
 
 
-# Channel-count floor for the cumsum window sum when SPARKNET_LRN_CUMSUM
-# is unset, TPU only.  The round-10 CPU probe re-run (tools/perf_probe.py
+# Channel-count floor for the cumsum window sum when no tuning-table
+# pin decides, TPU only.  The round-10 CPU probe re-run (tools/perf_probe.py
 # lrn, RESULTS.md r10 table) REVERSED the round-6 CPU verdict: on the
 # current XLA CPU build reduce_window wins every zoo LRN shape fwd+bwd
 # (cumsum at 0.64-0.95x), so auto stays OFF on CPU — measured, not
@@ -477,14 +475,10 @@ def lrn_use_cumsum(c_dim: int) -> bool:
     """Default LRN window-sum formulation when neither the tuning table
     nor a caller override decides (read at TRACE time, like the other
     vision-layer toggles): off everywhere but TPU (the CPU probe says
-    reduce_window wins there), by channel count on TPU.  The retired
-    SPARKNET_LRN_CUMSUM=1/=0 pin still works for one release through
-    the autotuner's deprecation shim
-    (graph/tuner.py deprecated_lrn_cumsum_pin), which warns once."""
-    from ..graph import tuner
-    pin = tuner.deprecated_lrn_cumsum_pin()
-    if pin is not None:
-        return pin
+    reduce_window wins there), by channel count on TPU.  To force one
+    form, pass ``use_cumsum=`` explicitly or pin the ``lrn`` op in a
+    SPARKNET_TUNE table — the pre-tuner env pin is gone (knobs.py
+    tombstones it)."""
     if jax.default_backend() != "tpu":
         return False
     return c_dim >= LRN_CUMSUM_AUTO_C
@@ -572,10 +566,8 @@ def lrn_chain_epilogue(x, size: int, alpha: float, beta: float, k: float,
     epilogue kernel (one VMEM trip instead of the 555 GB/s
     reduce_window chain); elsewhere the XLA reference above (same
     custom VJP, same residuals).  The tuning table
-    (graph/tuner.py, op "lrn_epilogue") can pick per shape; the retired
-    SPARKNET_FUSE_PALLAS=0 pin still forces the XLA form for one
-    release through the tuner's deprecation shim.  All read at trace
-    time, the A/B knobs a profile capture flips."""
+    (graph/tuner.py, op "lrn_epilogue") can pick per shape — read at
+    trace time, the A/B knob a profile capture flips."""
     from ..graph import tuner
     choice = tuner.resolve_lowering(
         "lrn_epilogue", x.shape, x.dtype,
@@ -609,7 +601,7 @@ class LRNLayer(LayerImpl):
     surrounding relu/pool elementwise work XLA would have fused into the
     LRN costs more than the kernel saves.
 
-    SPARKNET_LRN_CUMSUM reformulates the ACROSS_CHANNELS window sum
+    The cumsum formulation rewrites the ACROSS_CHANNELS window sum
     algebraically: instead of ``reduce_window`` touching each x² value
     ``local_size`` times (the 555 GB/s chain in the GoogLeNet per-layer
     table — 17% of its step), a single channel-axis ``cumsum`` followed
@@ -622,12 +614,13 @@ class LRNLayer(LayerImpl):
     round-10 probe re-run reversed round 6's CPU verdict, reduce_window
     now wins every zoo shape there (RESULTS.md r10 table) — and
     channel-count-gated on TPU, where the capture remains the final
-    decider.  ``=1``/``=0`` still force it, and tools/perf_probe.py
-    ``lrn`` is the harness (its ``auto`` variant audits the default)."""
+    decider.  A SPARKNET_TUNE table pin (op "lrn") forces either form,
+    and tools/perf_probe.py ``lrn`` is the harness (its ``auto``
+    variant audits the default)."""
 
     @staticmethod
     def _use_pallas() -> bool:
-        return os.environ.get("SPARKNET_PALLAS_LRN") == "1"
+        return knobs.raw("SPARKNET_PALLAS_LRN") == "1"
 
     def apply(self, lp, params, bottoms, train, rng):
         size, alpha, beta, k, region = lrn_geometry(lp)

@@ -126,6 +126,8 @@ class Autoscaler:
         self._idle_since: dict[str, float] = {}
         self._last_action_at: dict[str, float] = {}
         self.evaluations = 0
+        self.sample_errors = 0
+        self.last_sample_error: str | None = None
         reg = telemetry.get_registry()
         self._m_dec = reg.counter(
             "autoscale_decisions_total", "autoscaler decisions by action")
@@ -263,8 +265,11 @@ class Autoscaler:
         while not self._stop.wait(self.cfg.sample_every_s):
             try:
                 self.evaluate()
-            except Exception:
-                pass   # a broken scrape must not kill the sampler
+            except Exception as e:
+                # a broken scrape must not kill the sampler — park it
+                # for summary() instead of swallowing
+                self.sample_errors += 1
+                self.last_sample_error = f"{type(e).__name__}: {e}"
 
 
 def fleet_stats_fn(fleet) -> Callable[[], dict[str, list]]:

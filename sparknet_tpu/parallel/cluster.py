@@ -17,9 +17,9 @@ doing process placement, but carries no tensor traffic.
 
 from __future__ import annotations
 
-import os
-
 import jax
+
+from ..utils import knobs
 
 
 def init_cluster(coordinator_address: str | None = None,
@@ -40,10 +40,9 @@ def init_cluster(coordinator_address: str | None = None,
     without jitter every rank re-dials the coordinator in lockstep — the
     textbook thundering herd."""
     from ..utils.retry import retry_call
-    attempts = int(os.environ.get("SPARKNET_CONNECT_RETRIES", "3") or 3)
-    base = float(os.environ.get("SPARKNET_CONNECT_BACKOFF", "0.5") or 0.5)
-    jitter = float(os.environ.get("SPARKNET_CONNECT_JITTER", "0.25")
-                   or 0.25)
+    attempts = int(knobs.raw("SPARKNET_CONNECT_RETRIES", "3") or 3)
+    base = float(knobs.raw("SPARKNET_CONNECT_BACKOFF", "0.5") or 0.5)
+    jitter = float(knobs.raw("SPARKNET_CONNECT_JITTER", "0.25") or 0.25)
     retry_call(
         jax.distributed.initialize,
         coordinator_address=coordinator_address,
@@ -66,10 +65,10 @@ def init_cluster_from_env() -> bool:
     set but counts missing, non-integer counts, or an out-of-range rank)
     raises a ValueError naming the offending variable instead of a bare
     KeyError deep in the launcher plumbing."""
-    addr = os.environ.get("SPARKNET_COORDINATOR")
+    addr = knobs.raw("SPARKNET_COORDINATOR")
     if not addr:
         for var in ("SPARKNET_NUM_PROCS", "SPARKNET_PROC_ID"):
-            if os.environ.get(var):
+            if knobs.raw(var):
                 raise ValueError(
                     f"{var} is set but SPARKNET_COORDINATOR is not — the "
                     f"launcher env contract requires all three of "
@@ -78,7 +77,7 @@ def init_cluster_from_env() -> bool:
         return False
     values = {}
     for var in ("SPARKNET_NUM_PROCS", "SPARKNET_PROC_ID"):
-        raw = os.environ.get(var)
+        raw = knobs.raw(var)
         if raw is None or raw == "":
             raise ValueError(
                 f"SPARKNET_COORDINATOR is set but {var} is missing — the "

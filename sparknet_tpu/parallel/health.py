@@ -36,6 +36,8 @@ import os
 import time
 from typing import Callable
 
+from ..utils import knobs
+
 HB_PREFIX = "hb_rank_"
 ENV_DIR = "SPARKNET_HEARTBEAT_DIR"
 
@@ -123,13 +125,12 @@ def maybe_beat(round_idx: int, phase: str = "round_start",
     Deliberately swallow-nothing-raise-nothing is NOT the contract — a
     beacon dir that exists but is unwritable should fail loudly (it means
     the supervisor will kill us as hung)."""
-    directory = os.environ.get(ENV_DIR)
+    directory = knobs.raw(ENV_DIR)
     if not directory:
         return
-    write_beat(directory, int(os.environ.get("SPARKNET_PROC_ID", "0") or 0),
+    write_beat(directory, knobs.get_int("SPARKNET_PROC_ID", 0),
                round_idx, phase,
-               attempt=int(os.environ.get("SPARKNET_FAULT_ATTEMPT", "0")
-                           or 0),
+               attempt=knobs.get_int("SPARKNET_FAULT_ATTEMPT", 0),
                extras=extras)
 
 

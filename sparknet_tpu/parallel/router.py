@@ -218,14 +218,14 @@ class HttpReplica:
         try:
             return bool(http_json(f"{self.url}/healthz",
                                   timeout=self.timeout_s)["alive"])
-        except Exception:
+        except (OSError, RuntimeError, ValueError, KeyError):
             return False
 
     def stats(self) -> dict[str, Any]:
         from ..classify import http_json
         try:
             return http_json(f"{self.url}/healthz", timeout=self.timeout_s)
-        except Exception as e:
+        except (OSError, RuntimeError, ValueError, KeyError) as e:
             return {"alive": False, "error": repr(e)}
 
     def describe(self) -> dict[str, Any]:
@@ -633,6 +633,8 @@ class ServingFleet:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.poll_errors = 0
+        self.last_poll_error: str | None = None
 
     # -- replica jobs -----------------------------------------------------
     def _slug(self, model: str) -> str:
@@ -747,7 +749,8 @@ class ServingFleet:
                             name, ep["url"], models=ep.get("models"),
                             pid=ep.get("pid"),
                             timeout_s=self.replica_timeout_s)
-                    except Exception:
+                    except (OSError, RuntimeError, ValueError,
+                            KeyError):
                         continue     # endpoint up but not answering yet
                     self.router.add_replica(name, client)
                     self._endpoints[name] = ep["url"]
@@ -796,10 +799,13 @@ class ServingFleet:
         while not self._stop.wait(self.tick_s):
             try:
                 self.step()
-            except Exception:
+            except Exception as e:
                 # one bad poll (torn endpoint file, slow scrape) must
-                # not kill the fleet loop
-                pass
+                # not kill the fleet loop — park it where status() and
+                # the postmortem can see it
+                with self._lock:
+                    self.poll_errors += 1
+                    self.last_poll_error = f"{type(e).__name__}: {e}"
 
     def stop(self, grace_s: float | None = None) -> None:
         self._stop.set()

@@ -86,7 +86,7 @@ from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 
 
 # ---------------------------------------------------------------------------
@@ -144,7 +144,7 @@ class OverBudget(ServingError):
 # ---------------------------------------------------------------------------
 
 def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
+    raw = knobs.raw(name, "")
     if not raw:
         return default
     try:
@@ -157,7 +157,7 @@ def _env_quotas(name: str) -> dict[str, float]:
     """``SPARKNET_SERVE_QUOTAS=acme=200,beta=50`` -> {tenant: qps} (the
     env spelling of ``--quota``, so fleet-launched replicas inherit
     tenant caps with no per-replica CLI)."""
-    raw = os.environ.get(name, "")
+    raw = knobs.raw(name, "")
     quotas: dict[str, float] = {}
     for item in raw.split(","):
         item = item.strip()
@@ -173,7 +173,7 @@ def _env_quotas(name: str) -> dict[str, float]:
 
 
 def _env_shapes(name: str, default: tuple[int, ...]) -> tuple[int, ...]:
-    raw = os.environ.get(name, "")
+    raw = knobs.raw(name, "")
     if not raw:
         return default
     try:
@@ -206,8 +206,8 @@ class ServeConfig:
     hbm_budget_mb: float = dataclasses.field(
         default_factory=lambda: _env_float("SPARKNET_SERVE_HBM_MB", 2048.0))
     dtype: str = dataclasses.field(
-        default_factory=lambda: os.environ.get("SPARKNET_SERVE_DTYPE",
-                                               "bf16"))
+        default_factory=lambda: knobs.raw("SPARKNET_SERVE_DTYPE",
+                                          "bf16"))
     # per-tenant offered-QPS caps (the fleet's tenant vocabulary; absent
     # tenant = uncapped, "*" caps every tenant without an explicit entry)
     tenant_qps: Mapping[str, float] = dataclasses.field(
@@ -459,7 +459,7 @@ class ModelHouse:
             raise UnknownModel(
                 f"model {name!r} not in the zoo (known: {sorted(zoo)})")
         if force is None:
-            force = os.environ.get("SPARKNET_SERVE_FORCE_ADMIT") == "1"
+            force = knobs.raw("SPARKNET_SERVE_FORCE_ADMIT") == "1"
         lm = LoadedModel(name, zoo[name](), self.cfg, weights=weights,
                          max_param_mb=None if force
                          else self.cfg.hbm_budget_mb)
@@ -656,6 +656,8 @@ class SLOMonitor:
         self.state = "ok"
         self.breaches = 0
         self.dumps = 0
+        self.sample_errors = 0
+        self.last_sample_error: str | None = None
         self._since: float | None = None
         reg = telemetry.get_registry()
         self._m_breach = reg.counter(
@@ -684,8 +686,12 @@ class SLOMonitor:
         while not self._stop.wait(self.cfg.slo_sample_every_s):
             try:
                 self.evaluate()
-            except Exception:
-                pass   # a broken scrape must not kill the sampler
+            except Exception as e:
+                # a broken scrape must not kill the sampler — park it
+                # where summary() carries it out instead of swallowing
+                with self._lock:
+                    self.sample_errors += 1
+                    self.last_sample_error = f"{type(e).__name__}: {e}"
 
     def _snapshot(self) -> dict:
         st = self.stats_fn()
@@ -799,7 +805,11 @@ class SLOMonitor:
     def summary(self) -> dict[str, Any]:
         """The cheap, lock-light view the health beacons carry."""
         with self._lock:
-            return {"state": self.state, "breaches": self.breaches}
+            out = {"state": self.state, "breaches": self.breaches}
+            if self.sample_errors:
+                out["sample_errors"] = self.sample_errors
+                out["last_sample_error"] = self.last_sample_error
+            return out
 
 
 # ---------------------------------------------------------------------------
@@ -878,7 +888,7 @@ class InferenceEngine:
             target=self._loop, name="serve-dispatch", daemon=True)
         self._dispatcher.start()
         self._beacon: threading.Thread | None = None
-        if os.environ.get("SPARKNET_HEARTBEAT_DIR"):
+        if knobs.is_set("SPARKNET_HEARTBEAT_DIR"):
             self._beacon = threading.Thread(
                 target=self._beat_loop, name="serve-beacon", daemon=True)
             self._beacon.start()
@@ -1212,9 +1222,9 @@ class InferenceEngine:
 
     def _beat_loop(self) -> None:
         from . import health
-        directory = os.environ.get("SPARKNET_HEARTBEAT_DIR")
-        rank = int(os.environ.get("SPARKNET_PROC_ID", "0") or 0)
-        attempt = int(os.environ.get("SPARKNET_FAULT_ATTEMPT", "0") or 0)
+        directory = knobs.raw("SPARKNET_HEARTBEAT_DIR")
+        rank = knobs.get_int("SPARKNET_PROC_ID", 0)
+        attempt = knobs.get_int("SPARKNET_FAULT_ATTEMPT", 0)
         while True:
             with self._cond:
                 self._cond.wait(self.cfg.beat_every_s)

@@ -39,6 +39,8 @@ import weakref
 import zipfile
 from typing import Any, Callable
 
+from . import knobs
+
 import time
 
 import jax
@@ -140,7 +142,7 @@ def load_checkpoint(path: str, verify: bool = True) -> Any:
 def async_checkpoints_enabled() -> bool:
     """Whether the async checkpoint tier is on (``SPARKNET_ASYNC_CKPT=0``
     is the escape hatch restoring the synchronous write path)."""
-    return os.environ.get("SPARKNET_ASYNC_CKPT", "") != "0"
+    return knobs.raw("SPARKNET_ASYNC_CKPT", "") != "0"
 
 
 _DEVICE_COPY = None

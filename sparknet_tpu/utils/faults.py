@@ -382,7 +382,8 @@ def get_injector() -> FaultInjector:
     tests that reuse an identical SPARKNET_FAULT value across cases must
     call :func:`reset_injector` to re-arm it."""
     global _CACHE
-    key = tuple(os.environ.get(k, "") for k in
+    from . import knobs
+    key = tuple(knobs.raw(k, "") for k in
                 ("SPARKNET_FAULT", "SPARKNET_FAULT_ATTEMPT",
                  "SPARKNET_PROC_ID"))
     if _CACHE is None or _CACHE[0] != key:

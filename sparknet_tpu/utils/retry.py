@@ -22,6 +22,8 @@ import sys
 import time
 from typing import Any, Callable, Iterable
 
+from . import knobs
+
 
 def backoff_delays(attempts: int, base: float, factor: float = 2.0,
                    max_delay: float = 30.0, jitter: float = 0.0,
@@ -79,7 +81,7 @@ def io_retry(fn: Callable[..., Any], *args: Any,
              describe: str | None = None, **kwargs: Any) -> Any:
     """``retry_call`` tuned from the SPARKNET_IO_* env knobs — the wrapper
     the data-plane opens (LMDB mmap, HDF5, source lists) go through."""
-    attempts = int(os.environ.get("SPARKNET_IO_RETRIES", "3") or 3)
-    base = float(os.environ.get("SPARKNET_IO_BACKOFF", "0.05") or 0.05)
+    attempts = int(knobs.raw("SPARKNET_IO_RETRIES", "3") or 3)
+    base = float(knobs.raw("SPARKNET_IO_BACKOFF", "0.05") or 0.05)
     return retry_call(fn, *args, attempts=attempts, base_delay=base,
                       retry_on=(OSError,), describe=describe, **kwargs)

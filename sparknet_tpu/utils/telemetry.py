@@ -63,6 +63,8 @@ import time
 import weakref
 from typing import Any, Callable, Iterable, Mapping
 
+from . import knobs
+
 ENV_ENABLE = "SPARKNET_TELEMETRY"
 ENV_TRACE_DIR = "SPARKNET_TRACE_DIR"
 ENV_SNAP_DIR = "SPARKNET_METRICS_SNAP"
@@ -79,7 +81,7 @@ DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
 def enabled() -> bool:
     """Whether the telemetry plane is on (``SPARKNET_TELEMETRY=0`` is
     the global off switch)."""
-    return os.environ.get(ENV_ENABLE, "") != "0"
+    return knobs.raw(ENV_ENABLE, "") != "0"
 
 
 _DERIVED_RUN: str | None = None
@@ -95,19 +97,19 @@ def correlation_ids() -> dict[str, Any]:
     cluster env contract validates the full triple) can still claim a
     distinct shard rank via SPARKNET_TELEMETRY_RANK, which wins."""
     global _DERIVED_RUN
-    run = os.environ.get(ENV_RUN_ID)
+    run = knobs.raw(ENV_RUN_ID)
     if not run:
         if _DERIVED_RUN is None:
             _DERIVED_RUN = f"run-{int(time.time()):x}-{os.getpid()}"
         run = _DERIVED_RUN
     out: dict[str, Any] = {
         "run": run,
-        "rank": int(os.environ.get("SPARKNET_TELEMETRY_RANK")
-                    or os.environ.get("SPARKNET_PROC_ID", "0") or 0),
-        "inc": int(os.environ.get("SPARKNET_INCARNATION", "0") or 0),
-        "attempt": int(os.environ.get("SPARKNET_FAULT_ATTEMPT", "0") or 0),
+        "rank": int(knobs.raw("SPARKNET_TELEMETRY_RANK")
+                    or knobs.raw("SPARKNET_PROC_ID", "0") or 0),
+        "inc": knobs.get_int("SPARKNET_INCARNATION", 0),
+        "attempt": knobs.get_int("SPARKNET_FAULT_ATTEMPT", 0),
     }
-    job = os.environ.get("SPARKNET_FLEET_JOB")
+    job = knobs.raw("SPARKNET_FLEET_JOB")
     if job:
         out["job"] = job
     return out
@@ -383,7 +385,7 @@ class MetricsRegistry:
         """Atomically write ``metrics_rank<R>.json`` (+ ``.prom`` text)
         into ``directory`` (default ``SPARKNET_METRICS_SNAP``); returns
         the json path, or None when no directory is configured."""
-        directory = directory or os.environ.get(ENV_SNAP_DIR)
+        directory = directory or knobs.raw(ENV_SNAP_DIR)
         if not directory:
             return None
         os.makedirs(directory, exist_ok=True)
@@ -407,10 +409,10 @@ class MetricsRegistry:
         ``SPARKNET_METRICS_SNAP_S`` seconds (default 2); a no-op when
         ``SPARKNET_METRICS_SNAP`` is unset.  The hot-loop-safe hook the
         trainer calls each round."""
-        if not os.environ.get(ENV_SNAP_DIR):
+        if not knobs.is_set(ENV_SNAP_DIR):
             return None
         try:
-            min_s = float(os.environ.get(ENV_SNAP_S, "") or 2.0)
+            min_s = float(knobs.raw(ENV_SNAP_S, "") or 2.0)
         except ValueError:
             min_s = 2.0
         now = time.monotonic()
@@ -573,7 +575,7 @@ class FlightRecorder:
     def __init__(self, maxlen: int | None = None):
         if maxlen is None:
             try:
-                maxlen = int(os.environ.get(ENV_FLIGHT, "") or 256)
+                maxlen = int(knobs.raw(ENV_FLIGHT, "") or 256)
             except ValueError:
                 maxlen = 256
         self._events: collections.deque = collections.deque(
@@ -599,7 +601,7 @@ class FlightRecorder:
         doc = {"reason": reason, "t": round(time.time(), 3),
                **correlation_ids(), "pid": os.getpid(),
                "events": self.tail()}
-        directory = directory or os.environ.get(ENV_TRACE_DIR)
+        directory = directory or knobs.raw(ENV_TRACE_DIR)
         if directory:
             with self._lock:
                 seq = self._dump_seq
@@ -667,7 +669,7 @@ def get_tracer() -> Tracer | None:
     with _state_lock:
         if _state["tracer"] is not None or _state["tracer_off"]:
             return _state["tracer"]
-        directory = os.environ.get(ENV_TRACE_DIR)
+        directory = knobs.raw(ENV_TRACE_DIR)
         if not directory or not enabled():
             _state["tracer_off"] = True
             return None

@@ -285,17 +285,25 @@ def test_lrn_gradient(np_rng):
     check_grads(f, (x,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2)
 
 
-def test_lrn_cumsum_reformulation_matches_default(np_rng, monkeypatch):
-    """SPARKNET_LRN_CUMSUM=1 (prefix-sum window reformulation of the
-    cross-channel sum) must match the reduce_window path to float
-    tolerance — the window total is the same set of addends, associated
-    differently — including the clipped windows at both channel edges,
-    and its gradient must check (cumsum transpose)."""
+def test_lrn_cumsum_reformulation_matches_default(np_rng, monkeypatch,
+                                                  tmp_path):
+    """A tuning-table pin of the cumsum lowering (prefix-sum window
+    reformulation of the cross-channel sum) must match the
+    reduce_window path to float tolerance — the window total is the
+    same set of addends, associated differently — including the clipped
+    windows at both channel edges, and its gradient must check (cumsum
+    transpose)."""
+    from sparknet_tpu.graph import tuner
     x = np_rng.normal(size=(2, 9, 5, 5)).astype(np.float32)
     lp = make("LRN", lrn_param={"local_size": 5, "alpha": 1e-2,
                                 "beta": 0.75})
     base = np.asarray(apply_op(lp, [x])[0])
-    monkeypatch.setenv("SPARKNET_LRN_CUMSUM", "1")
+    key = tuner.key_str("lrn", x.shape, jnp.float32, tuner.lrn_extra(5))
+    path = tmp_path / "pin.json"
+    tuner.TuningTable(tuner._backend(), [
+        {"key": key, "winner": "cumsum", "timings": {}}]).save(str(path))
+    monkeypatch.setenv("SPARKNET_TUNE", str(path))
+    tuner._clear_caches()
     fast = np.asarray(apply_op(lp, [x])[0])
     np.testing.assert_allclose(fast, base, rtol=1e-5, atol=1e-6)
     # bf16 input keeps its dtype out (f32 prefix accumulation inside)

@@ -2,15 +2,14 @@
 surface, ops/vision.py resolve_lowering seams): key grammar, the
 measure-key contract (typed skips, numerics disqualification, winner
 eligibility), the versioned table's FusionPlan-style refusal of drifted
-files, SPARKNET_TUNE resolution modes, the one-release deprecation
-shims for SPARKNET_LRN_CUMSUM / SPARKNET_FUSE_PALLAS, staleness
-detection, perf-ledger ingestion, and — against the committed CPU
+files, SPARKNET_TUNE resolution modes, table-pinned lowerings through
+the production layer paths (the pin path that replaced the retired
+PR-12 env shims), staleness detection, perf-ledger ingestion, and — against the committed CPU
 table — off-vs-tuned forward bit-parity across the zoo shapes."""
 
 import json
 import os
 import sys
-import warnings
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +43,6 @@ TINY_LRN = tuner.TuneKey("lrn", (2, 8, 6, 6), "f32", tuner.lrn_extra(5))
 @pytest.fixture(autouse=True)
 def _clean_tuner_state(monkeypatch):
     monkeypatch.delenv("SPARKNET_TUNE", raising=False)
-    monkeypatch.delenv("SPARKNET_LRN_CUMSUM", raising=False)
-    monkeypatch.delenv("SPARKNET_FUSE_PALLAS", raising=False)
     tuner._clear_caches()
     yield
     tuner.clear_extra_candidates()
@@ -257,48 +254,68 @@ def test_tune_typo_is_loud(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
-# deprecation shims (one release: PR 13 -> 14)
+# table pins (the path that replaced the PR-12 env shims)
 # ---------------------------------------------------------------------------
 
-def test_lrn_cumsum_shim_pins_and_warns_once(monkeypatch):
-    monkeypatch.setenv("SPARKNET_TUNE", "off")
-    monkeypatch.setenv("SPARKNET_LRN_CUMSUM", "1")
-    with pytest.warns(DeprecationWarning, match="SPARKNET_LRN_CUMSUM"):
-        got = tuner.resolve_lowering("lrn", (2, 8, 6, 6), jnp.float32,
-                                     extra="s5")
-    assert got == "cumsum"
-    with warnings.catch_warnings():
-        warnings.simplefilter("error")  # second consult must NOT warn
-        assert tuner.resolve_lowering("lrn", (4, 4, 4, 4), jnp.float32,
-                                      extra="s3") == "cumsum"
-    monkeypatch.setenv("SPARKNET_LRN_CUMSUM", "0")
+def _pin_table(tmp_path, pins: dict, name="pins.json") -> str:
+    """Write a minimal one-backend tuning table mapping key -> winner."""
+    path = tmp_path / name
+    tuner.TuningTable(tuner._backend(), [
+        {"key": k, "winner": w, "timings": {}} for k, w in pins.items()
+    ]).save(str(path))
+    return str(path)
+
+
+def test_table_pins_lrn_window_sum(monkeypatch, tmp_path):
+    """The exact pre-tuner pin semantics, now spelled as a table: each
+    lrn key resolves to its pinned winner; unpinned keys fall through
+    to None (the hardcoded default)."""
+    key1 = tuner.key_str("lrn", (2, 8, 6, 6), jnp.float32,
+                         tuner.lrn_extra(5))
+    key2 = tuner.key_str("lrn", (4, 4, 4, 4), jnp.float32,
+                         tuner.lrn_extra(3))
+    path = _pin_table(tmp_path, {key1: "cumsum", key2: "reduce_window"})
+    monkeypatch.setenv("SPARKNET_TUNE", path)
+    tuner._clear_caches()
     assert tuner.resolve_lowering("lrn", (2, 8, 6, 6), jnp.float32,
-                                  extra="s5") == "reduce_window"
-    # any other value is ignored, exactly the retired knob's semantics
-    monkeypatch.setenv("SPARKNET_LRN_CUMSUM", "banana")
-    assert tuner.resolve_lowering("lrn", (2, 8, 6, 6), jnp.float32,
+                                  extra="s5") == "cumsum"
+    assert tuner.resolve_lowering("lrn", (4, 4, 4, 4), jnp.float32,
+                                  extra="s3") == "reduce_window"
+    # unpinned key: hardcoded default, no shim fallback anymore
+    assert tuner.resolve_lowering("lrn", (9, 9, 9, 9), jnp.float32,
                                   extra="s5") is None
 
 
-def test_lrn_cumsum_shim_reaches_the_production_layer(monkeypatch):
-    """The retired knob must keep steering the production LRN lowering
-    for one release (the existing test_ops/test_fusion pins rely on
-    it), now via the tuner pin instead of a direct env read."""
-    from sparknet_tpu.ops import vision
-    monkeypatch.setenv("SPARKNET_LRN_CUMSUM", "1")
-    assert vision.lrn_use_cumsum(4) is True
-    monkeypatch.setenv("SPARKNET_LRN_CUMSUM", "0")
-    assert vision.lrn_use_cumsum(4096) is False
+def test_table_pin_reaches_the_production_layer(monkeypatch, tmp_path):
+    """A pinned lrn winner steers the production LRNLayer exactly like
+    the retired env pin did: both forms agree numerically and the pin
+    selects between them through resolve_lowering."""
+    lp = lrn_layer("n1", "x", "y", local_size=5, alpha=1e-4, beta=0.75)
+    impl = get_layer_impl("LRN")
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 9, 5, 5)),
+                    jnp.float32)
+    key = tuner.key_str("lrn", x.shape, x.dtype, tuner.lrn_extra(5))
+    outs = {}
+    for winner in ("cumsum", "reduce_window"):
+        path = _pin_table(tmp_path, {key: winner}, name=f"{winner}.json")
+        monkeypatch.setenv("SPARKNET_TUNE", path)
+        tuner._clear_caches()
+        outs[winner] = np.asarray(impl.apply(lp, [], [x], True, None)[0])
+    np.testing.assert_allclose(outs["cumsum"], outs["reduce_window"],
+                               rtol=2e-6, atol=2e-6)
 
 
-def test_fuse_pallas_shim_pins_epilogue_reference(monkeypatch):
+def test_table_pins_epilogue_reference(monkeypatch, tmp_path):
+    key = tuner.key_str("lrn_epilogue", (2, 8, 6, 6), jnp.float32,
+                        "s5:relu1")
+    path = _pin_table(tmp_path, {key: "reference"})
+    monkeypatch.setenv("SPARKNET_TUNE", path)
+    tuner._clear_caches()
+    assert tuner.resolve_lowering("lrn_epilogue", (2, 8, 6, 6),
+                                  jnp.float32, extra="s5:relu1") \
+        == "reference"
     monkeypatch.setenv("SPARKNET_TUNE", "off")
-    monkeypatch.setenv("SPARKNET_FUSE_PALLAS", "0")
-    with pytest.warns(DeprecationWarning, match="SPARKNET_FUSE_PALLAS"):
-        got = tuner.resolve_lowering("lrn_epilogue", (2, 8, 6, 6),
-                                     jnp.float32, extra="s5:relu1")
-    assert got == "reference"
-    monkeypatch.delenv("SPARKNET_FUSE_PALLAS")
+    tuner._clear_caches()
     assert tuner.resolve_lowering("lrn_epilogue", (2, 8, 6, 6),
                                   jnp.float32, extra="s5:relu1") is None
 

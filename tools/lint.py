@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""sparklint CLI — the project-contract static analyzer gate.
+
+Subcommands:
+  run        lint the tree (default: with the committed baseline
+             applied); non-zero exit on any new error-severity finding
+  baseline   regenerate tools/lint_baseline.json from current findings,
+             preserving reasons for entries that survive
+  knobs      --emit rewrites KNOBS.md from the registry; --check exits
+             non-zero when the committed file is stale
+
+Wired into tier-1 CI by tools/run_tier1.sh (default on; SPARKNET_LINT=0
+skips).  Pure-AST + stdlib: no JAX, no devices, ~a second.  See
+WALKTHROUGH §6.16 for the rule taxonomy and the suppression /
+baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from sparknet_tpu.analysis import engine  # noqa: E402
+from sparknet_tpu.analysis.core import Baseline  # noqa: E402
+from sparknet_tpu.utils import knobs  # noqa: E402
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    project = engine.load_project(REPO, args.paths or None)
+    findings = engine.run_rules(project, args.family or None)
+    baseline = Baseline.empty() if args.no_baseline \
+        else engine.default_baseline(REPO)
+    kept, covered = engine.apply_baseline(findings, baseline)
+    errors = [f for f in kept if f.severity == "error"]
+    warnings = [f for f in kept if f.severity != "error"]
+
+    if args.json:
+        print(json.dumps([f.__dict__ for f in kept], indent=1))
+    else:
+        for f in kept:
+            print(f.render())
+        for e in baseline.unused():
+            print(f"note: unused baseline entry {e['rule']} {e['path']} "
+                  f"[{e['symbol']}] — delete it")
+        print(f"sparklint: {len(errors)} error(s), {len(warnings)} "
+              f"warning(s), {len(covered)} baselined, "
+              f"{len(project.files)} files")
+    return 1 if errors else 0
+
+
+def cmd_baseline(args: argparse.Namespace) -> int:
+    project = engine.load_project(REPO)
+    findings = engine.run_rules(project)
+    old = engine.default_baseline(REPO)
+    reasons = {(e["rule"], e["path"], e["symbol"]): e["reason"]
+               for e in old.entries}
+    entries, seen = [], set()
+    for f in findings:
+        if f.severity != "error" or f.key() in seen:
+            continue
+        seen.add(f.key())
+        entries.append({
+            "rule": f.rule, "path": f.path, "symbol": f.symbol,
+            "reason": reasons.get(f.key(), "TODO: justify or fix")})
+    out = REPO / (args.out or engine.BASELINE_REL)
+    out.write_text(Baseline.render(entries))
+    todo = sum(1 for e in entries if e["reason"].startswith("TODO"))
+    print(f"wrote {out} with {len(entries)} entries "
+          f"({todo} still TODO — fill in reasons before committing)")
+    return 0
+
+
+def cmd_knobs(args: argparse.Namespace) -> int:
+    md = REPO / "KNOBS.md"
+    want = knobs.knobs_md()
+    if args.emit:
+        md.write_text(want)
+        print(f"wrote {md} ({len(knobs.all_knobs())} knobs)")
+        return 0
+    if md.exists() and md.read_text() == want:
+        print("KNOBS.md is in sync with the registry")
+        return 0
+    print("KNOBS.md is missing or stale — run "
+          "`python tools/lint.py knobs --emit` and commit the result")
+    return 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="lint.py", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser("run", help="lint the tree")
+    rp.add_argument("paths", nargs="*",
+                    help="repo-relative files to lint (default: full scope)")
+    rp.add_argument("--baseline", action="store_true",
+                    help="apply the committed baseline (the default)")
+    rp.add_argument("--no-baseline", action="store_true",
+                    help="strict mode: report grandfathered findings too")
+    rp.add_argument("--family", action="append",
+                    choices=sorted(engine.RULE_FAMILIES),
+                    help="run only this rule family (repeatable)")
+    rp.add_argument("--json", action="store_true")
+    rp.set_defaults(func=cmd_run)
+
+    bp = sub.add_parser("baseline",
+                        help="regenerate the baseline, keeping reasons")
+    bp.add_argument("--out", help=f"output path (default "
+                                  f"{engine.BASELINE_REL})")
+    bp.set_defaults(func=cmd_baseline)
+
+    kp = sub.add_parser("knobs", help="KNOBS.md emission / drift gate")
+    g = kp.add_mutually_exclusive_group(required=True)
+    g.add_argument("--emit", action="store_true")
+    g.add_argument("--check", action="store_true")
+    kp.set_defaults(func=cmd_knobs)
+
+    args = ap.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,8 +18,9 @@ Parts (select with argv, default all):
   hlo    — transpose/copy census of the optimized HLO for the compiled
            train step (layout-assignment cost evidence).
   lrn    — the cross-channel LRN window sum as reduce_window (default)
-           vs the SPARKNET_LRN_CUMSUM=1 prefix-sum-difference
-           reformulation (VERDICT r5 weak #2), fwd and fwd+bwd, at both
+           vs the prefix-sum-difference reformulation, pinned per
+           variant via one-entry SPARKNET_TUNE tables
+           (VERDICT r5 weak #2), fwd and fwd+bwd, at both
            LRN-bearing headline models' shapes.  PROBE_LRN_DTYPE=f32
            switches from the bf16 default.
 
@@ -493,16 +494,22 @@ def run_poolbwd() -> None:
 # ---------------------------------------------------------------------------
 
 def run_lrn() -> None:
-    """reduce_window vs prefix-sum-difference cross-channel LRN
-    (``SPARKNET_LRN_CUMSUM=1``), forward and forward+backward, at the
-    LRN shapes of both LRN-bearing headline models.  The flag is read at
-    trace time, so each variant compiles its own block; the layer code
-    under test is the production ``ops.vision.LRNLayer``."""
+    """reduce_window vs prefix-sum-difference cross-channel LRN,
+    forward and forward+backward, at the LRN shapes of both LRN-bearing
+    headline models.  Each pinned variant runs under a one-entry
+    SPARKNET_TUNE table (the sanctioned pin path since the env shim was
+    retired); tables are read at trace time, so each variant compiles
+    its own block.  The layer code under test is the production
+    ``ops.vision.LRNLayer``."""
+    import tempfile
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from sparknet_tpu.graph import tuner
     from sparknet_tpu.models.dsl import layer
+    from sparknet_tpu.utils import knobs
     from sparknet_tpu.ops.registry import get_layer_impl
 
     impl = get_layer_impl("LRN")
@@ -522,7 +529,8 @@ def run_lrn() -> None:
     if only:  # comma-separated substring filter (CPU smokes)
         shapes = {k: v for k, v in shapes.items()
                   if any(s and s in k for s in only.split(","))}
-    saved = os.environ.get("SPARKNET_LRN_CUMSUM")
+    saved = knobs.raw("SPARKNET_TUNE")
+    tmpdir = tempfile.mkdtemp(prefix="probe_lrn_tables_")
     results: dict[str, dict[str, float]] = {}
     try:
         for name, shape in shapes.items():
@@ -533,15 +541,25 @@ def run_lrn() -> None:
                 y = impl.apply(lp, [], [xx], True, None)[0]
                 return jnp.mean(y).astype(jnp.float32)
 
-            # "=0"/"=1" pin each form; unset is the shipping auto
-            # default (lrn_use_cumsum picks by channel count), measured
-            # as its own variant so the flip is auditable
-            for variant, env in (("reduce_window", "0"), ("cumsum", "1"),
-                                 ("auto", None)):
-                if env is None:
-                    os.environ.pop("SPARKNET_LRN_CUMSUM", None)
+            # a one-entry table pins each form; the shipping auto
+            # default (committed table, else lrn_use_cumsum by channel
+            # count) is measured as its own variant so the flip is
+            # auditable
+            for variant in ("reduce_window", "cumsum", "auto"):
+                if variant == "auto":
+                    if saved is None:
+                        os.environ.pop("SPARKNET_TUNE", None)
+                    else:
+                        os.environ["SPARKNET_TUNE"] = saved
                 else:
-                    os.environ["SPARKNET_LRN_CUMSUM"] = env
+                    key = tuner.key_str("lrn", shape, jnp.dtype(dtype),
+                                        tuner.lrn_extra(5))
+                    path = os.path.join(tmpdir, f"{name}_{variant}.json")
+                    tuner.TuningTable(tuner._backend(), [
+                        {"key": key, "winner": variant,
+                         "timings": {}}]).save(path)
+                    os.environ["SPARKNET_TUNE"] = path
+                tuner._clear_caches()
 
                 def fwd(s, x=x, loss=loss):
                     return loss(x + s.astype(dtype))
@@ -565,9 +583,10 @@ def run_lrn() -> None:
                         round(2 * nbytes / max(f_ms, 1e-6) / 1e6, 1)
     finally:
         if saved is None:
-            os.environ.pop("SPARKNET_LRN_CUMSUM", None)
+            os.environ.pop("SPARKNET_TUNE", None)
         else:
-            os.environ["SPARKNET_LRN_CUMSUM"] = saved
+            os.environ["SPARKNET_TUNE"] = saved
+        tuner._clear_caches()
     verdict = {
         name: {"speedup_fwdbwd": (
                    round(r["reduce_window"] / max(r["cumsum"], 1e-9), 3)

@@ -67,7 +67,8 @@ def main(argv=None) -> int:
                                       ).astype(np.float32)}
 
     def run(mode: str, ckdir: str) -> dict:
-        saved = os.environ.get("SPARKNET_ASYNC_CKPT")
+        from sparknet_tpu.utils import knobs
+        saved = knobs.raw("SPARKNET_ASYNC_CKPT")
         os.environ["SPARKNET_ASYNC_CKPT"] = "1" if mode == "async" else "0"
         try:
             cfg = TrainerConfig(

@@ -19,6 +19,17 @@ run_tier1() {
   return "$rc"
 }
 
+# ~1-second sparklint gate (tools/lint.py run) — DEFAULT ON, pure-AST +
+# stdlib (no JAX, no devices): the tree must be clean modulo the
+# committed tools/lint_baseline.json, and KNOBS.md must match the knob
+# registry.  SPARKNET_LINT=0 is the opt-out for rigs that only want the
+# pytest surface.
+maybe_lint() {
+  if [ "${SPARKNET_LINT:-1}" != "0" ]; then
+    timeout -k 10 120 python tools/lint.py run       && timeout -k 10 60 python tools/lint.py knobs --check
+  fi
+}
+
 run_chaos() {
   timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'chaos and not slow' \
@@ -171,6 +182,7 @@ maybe_perfgate() {
 
 case "${1:-}" in
   --chaos) run_chaos ;;
+  --lint)  SPARKNET_LINT=1 maybe_lint ;;
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
   --fleetsoak) SPARKNET_FLEETSOAK=1 maybe_fleetsoak ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
@@ -181,15 +193,17 @@ case "${1:-}" in
   --perfgate) SPARKNET_PERFGATE=1 maybe_perfgate ;;
   --fusebench) SPARKNET_FUSEBENCH=1 maybe_fusebench ;;
   --tunebench) SPARKNET_TUNEBENCH=1 maybe_tunebench ;;
-  --all)   run_tier1 && run_chaos && maybe_soak && maybe_fleetsoak \
+  --all)   maybe_lint && run_tier1 && run_chaos && maybe_soak \
+             && maybe_fleetsoak \
              && maybe_feedbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
              && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
              && maybe_perfgate ;;
-  "")      run_tier1 && maybe_soak && maybe_fleetsoak && maybe_feedbench \
+  "")      maybe_lint && run_tier1 && maybe_soak && maybe_fleetsoak \
+             && maybe_feedbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
              && maybe_roundbench && maybe_obssmoke \
              && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac

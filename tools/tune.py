@@ -36,10 +36,6 @@ Usage:
                              [--out FILE] [--ingest] [--allow-inexact]
     python tools/tune.py staleness [--table FILE] [--budget-s 60]
     python tools/tune.py tunebench [--json FILE]
-
-All subcommands clear the deprecated SPARKNET_LRN_CUMSUM /
-SPARKNET_FUSE_PALLAS pins first: a capture must measure candidates,
-not inherit a legacy override.
 """
 
 from __future__ import annotations
@@ -57,13 +53,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _log(msg: str) -> None:
     print(f"[tune] {msg}", file=sys.stderr, flush=True)
-
-
-def _clear_legacy_pins() -> None:
-    for knob in ("SPARKNET_LRN_CUMSUM", "SPARKNET_FUSE_PALLAS"):
-        if os.environ.pop(knob, None) is not None:
-            _log(f"ignoring deprecated {knob} for this capture "
-                 f"(candidates are measured, not pinned)")
 
 
 # ---------------------------------------------------------------------------
@@ -532,7 +521,6 @@ def main(argv=None) -> int:
     p_tb.set_defaults(fn=cmd_tunebench)
 
     args = ap.parse_args(argv)
-    _clear_legacy_pins()
     os.environ.pop("SPARKNET_TUNE", None)  # measure, don't inherit
     return args.fn(args)
 
