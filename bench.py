@@ -350,6 +350,7 @@ def run_child() -> None:
             "overlap_pct": round(max(0.0, min(100.0, overlap)), 1),
             # per-stage breakdown (s/batch, averaged over the whole leg
             # incl. warmup) + the pipeline config that produced it
+            "read_s": stages["read_s"],
             "decode_s": stages["decode_s"],
             "transform_s": stages["transform_s"],
             "device_put_s": stages["device_put_s"],
@@ -366,6 +367,119 @@ def run_child() -> None:
              f"{stages['device_put_s']:.4f}s per batch, "
              f"staged {out['staged_dtype']}, workers {out['workers']}, "
              f"depth {depth})")
+        return out
+
+    def measure_feed_records() -> dict:
+        """The decode-once leg: sustained host feed throughput from
+        pre-decoded record shards (data/records.py, warm tiered
+        ShardCache) vs the per-epoch decode path (encoded-JPEG LMDB
+        datums through the serial ``workers=0`` reference decode) — the
+        convert-once trade the reference's workers re-pay every epoch
+        (ImageNetLoader re-untars and re-decodes S3 tars per pass,
+        ImageNetLoader.scala:56-86).  Both legs run the same transform
+        and batch size; the serial leg pays JPEG decode per image per
+        epoch, the records leg pays it once at convert (reported as
+        ``convert_s``) and then streams crop-ready uint8 blocks.
+        Knobs: BENCH_RECORDS_N/_EDGE/_BATCH/_EPOCHS;
+        BENCH_FEED_RECORDS=0 skips the leg."""
+        import io as _io
+        import tempfile
+
+        from PIL import Image
+
+        from sparknet_tpu.data.db import (
+            array_to_datum, datum_to_array, db_feed, open_db,
+        )
+        from sparknet_tpu.data.lmdb_io import write_lmdb
+        from sparknet_tpu.data.pipeline import FeedStats, ShardCache
+        from sparknet_tpu.data.records import convert_to_shards, records_feed
+        from sparknet_tpu.models.dsl import layer
+        from sparknet_tpu.proto.caffe_pb import Phase
+
+        n = int(os.environ.get("BENCH_RECORDS_N", 96))
+        edge = int(os.environ.get("BENCH_RECORDS_EDGE", 64))
+        rbatch = int(os.environ.get("BENCH_RECORDS_BATCH", 32))
+        epochs = int(os.environ.get("BENCH_RECORDS_EPOCHS", 3))
+        rrng = np.random.default_rng(0)
+
+        def mk_lp(source: str, backend: str):
+            return layer("d", "Data", [], ["data", "label"],
+                         data_param={"source": source, "batch_size": rbatch,
+                                     "backend": backend},
+                         transform_param={"scale": 1.0 / 255})
+
+        n_batches = max(1, epochs * n // rbatch)
+        with tempfile.TemporaryDirectory() as tmp:
+            db_path = os.path.join(tmp, "lmdb")
+            pairs = []
+            for i in range(n):
+                img = rrng.integers(0, 256,
+                                    size=(edge, edge, 3)).astype(np.uint8)
+                buf = _io.BytesIO()
+                Image.fromarray(img).save(buf, format="JPEG", quality=90)
+                pairs.append((b"%08d" % i,
+                              array_to_datum(None, int(rrng.integers(10)),
+                                             encoded=buf.getvalue())))
+            write_lmdb(db_path, pairs)
+
+            # serial decode reference: JPEG decode per image, per epoch
+            stats_s = FeedStats()
+            feedg = db_feed(mk_lp(db_path, "LMDB"), Phase.TRAIN, seed=0,
+                            workers=0, stats=stats_s)
+            for _ in range(2):
+                next(feedg)   # warm the LMDB page cache / decoder
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                next(feedg)
+            serial_s = time.perf_counter() - t0
+            feedg.close()
+
+            # convert once: the per-record decode paid here, never again
+            shards_dir = os.path.join(tmp, "shards")
+            reader = open_db(db_path, "LMDB")
+
+            def decoded():
+                for key, val in reader.items():
+                    img, label = datum_to_array(val, key=key,
+                                                source=db_path)
+                    yield (np.clip(np.round(img), 0, 255).astype(np.uint8),
+                           label)
+
+            t0 = time.perf_counter()
+            conv = convert_to_shards(decoded(), shards_dir)
+            convert_s = time.perf_counter() - t0
+
+            # warm-records leg: epoch 1 fills the cache, then measure
+            cache = ShardCache(max_shards=max(4, len(conv["shards"])))
+            stats_r = FeedStats()
+            rfeed = records_feed(mk_lp(shards_dir, "RECORDS"), Phase.TRAIN,
+                                 seed=0, stats=stats_r, cache=cache)
+            for _ in range(max(1, n // rbatch)):
+                next(rfeed)
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                next(rfeed)
+            records_s = time.perf_counter() - t0
+            rfeed.close()
+
+        images = n_batches * rbatch
+        out = {
+            "feed_source": "records",
+            "records": n,
+            "edge": edge,
+            "batch": rbatch,
+            "epochs": epochs,
+            "images_per_sec": round(images / records_s, 1),
+            "serial_img_s": round(images / serial_s, 1),
+            "speedup_x": round(serial_s / records_s, 2),
+            "convert_s": round(convert_s, 3),
+            "read_s": stats_r.per_batch()["read_s"],
+            "serial_decode_s": stats_s.per_batch()["decode_s"],
+            "cache": cache.tier_counts(),
+        }
+        _log(f"feed_records: warm {out['images_per_sec']} img/s vs serial "
+             f"decode {out['serial_img_s']} img/s "
+             f"({out['speedup_x']}x, convert paid once: {convert_s:.2f}s)")
         return out
 
     def measure_round_overhead() -> dict:
@@ -502,6 +616,13 @@ def run_child() -> None:
         except Exception as e:  # the feed tier must not sink the bench
             _log(f"feed measurement failed: {e}")
             feed = {"error": str(e)}
+    feed_records = None
+    if os.environ.get("BENCH_FEED_RECORDS", "1") != "0":
+        try:
+            feed_records = measure_feed_records()
+        except Exception as e:  # this tier must not sink the bench either
+            _log(f"feed_records measurement failed: {e}")
+            feed_records = {"error": str(e)}
     round_overhead = None
     if os.environ.get("BENCH_ROUND", "1") != "0":
         try:
@@ -524,7 +645,9 @@ def run_child() -> None:
     fp = perfledger.fingerprint(
         model=MODEL, dtype=best, batch=BATCH, world=1,
         device=f"{dev.platform}/{dev.device_kind}", backend=dev.platform,
-        fuse_plan=b.get("fuse_plan"), tune_plan=b.get("tune_plan"))
+        fuse_plan=b.get("fuse_plan"), tune_plan=b.get("tune_plan"),
+        feed_source=("records" if feed_records
+                     and not feed_records.get("error") else "lmdb"))
     result = {
         "metric": f"{MODEL}_train_images_per_sec",
         "value": b["images_per_sec"],
@@ -550,6 +673,7 @@ def run_child() -> None:
         "windows": windows,
         "by_dtype": runs,
         "feed_in_loop": feed,
+        "feed_records": feed_records,
         "round_overhead": round_overhead,
         "serving": serving,
         "provenance": perfledger.provenance(fp),

@@ -8,6 +8,10 @@ from .pipeline import (
 from .integrity import (
     DataCorruptionError, Quarantine, QuarantineExceeded, QuarantinePolicy,
 )
+from .records import (
+    RecordShard, ShardSet, ShardWriter, convert_to_shards,
+    is_records_source, records_feed, write_shard,
+)
 from .transforms import (
     center_crop, random_crop_mirror, subtract_mean, compute_mean_image,
 )

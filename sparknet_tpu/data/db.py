@@ -268,6 +268,13 @@ def _cycle_items(reader):
             raise ValueError("empty database")
 
 
+def _is_records(source: str) -> bool:
+    """True when ``source`` names pre-decoded record shards (lazy import:
+    records.py imports pipeline/objectstore, db.py must stay cheap)."""
+    from .records import is_records_source
+    return is_records_source(source)
+
+
 def db_feed(lp, phase: Phase, tops: list[str] | None = None,
             seed: int = 0, quarantine: Quarantine | None = None,
             workers: int | None = None, stats=None, buffers: int = 0,
@@ -300,13 +307,26 @@ def db_feed(lp, phase: Phase, tops: list[str] | None = None,
     decode/transform seconds.  ``buffers``: > 0 rotates the batch output
     through that many preallocated buffers (``pipeline.BufferRing``) —
     opt-in, because a consumer that holds more than ``buffers - 1``
-    batches concurrently would see them overwritten."""
+    batches concurrently would see them overwritten.
+
+    A pre-decoded record-shard source (``backend: "RECORDS"``, a
+    ``*.rec`` path, or a directory of them — written once by
+    ``tools/convert.py``) delegates to ``records.records_feed``: same
+    batch/transform/quarantine/determinism contract, no decode stage."""
     from .. import native
     from .pipeline import BufferRing, DecodePool
     p = lp.sub("data_param")
     source = str(p.get("source"))
     batch = int(p.get("batch_size", 1))
     backend = p.get("backend", "LEVELDB")
+    if str(backend).upper() == "RECORDS" or _is_records(source):
+        from .records import records_feed
+        # yield from, not return: db_feed is a generator, and a bare
+        # return here would end the stream before the first batch
+        yield from records_feed(lp, phase, tops=tops, seed=seed,
+                                quarantine=quarantine, workers=workers,
+                                stats=stats, buffers=buffers)
+        return
     reader = open_db(source, str(backend))
     tf = DataTransformer(lp.sub("transform_param"), phase, seed)
     tops = tops or list(lp.top) or ["data", "label"]

@@ -50,42 +50,91 @@ class ObjectStore:
         """Release any pooled resources (no-op by default)."""
 
 
+class _PooledFd:
+    """One pooled descriptor: refcounted so eviction under concurrent
+    readers defers the close to the last reader out."""
+
+    __slots__ = ("fd", "refs", "evicted")
+
+    def __init__(self, fd: int):
+        self.fd = fd
+        self.refs = 0
+        self.evicted = False
+
+
 class LocalStore(ObjectStore):
     """Filesystem-backed store; keys are paths relative to ``root``.
 
-    ``open_range`` (the lazy-partition hot path — one call per record)
-    reuses a small pool of open file handles instead of open/seek/close
-    per record; the pool is lock-protected (one store is shared by every
-    LazyTarPartition of a dataset, and the prefetch thread reads it)."""
+    ``open_range`` (the lazy-partition / record-shard hot path — one
+    call per record, fanned out over the parallel ranged-read pool) is
+    fully thread-safe: reads use per-call ``os.pread`` (positioned read,
+    no shared seek cursor to race on) against a small LRU pool of raw
+    descriptors.  Only pool bookkeeping happens under the lock; the
+    actual IO runs outside it, so N pool workers genuinely read in
+    parallel.  A descriptor evicted (or ``close()``d) while readers are
+    mid-``pread`` stays open until the last of them releases it —
+    eviction can never invalidate a concurrent read."""
 
     _MAX_HANDLES = 8
 
     def __init__(self, root: str):
         self.root = root
-        self._handles: dict[str, BinaryIO] = {}
+        self._fds: "dict[str, _PooledFd]" = {}
         self._lock = threading.Lock()
 
-    def open_range(self, key: str, offset: int, length: int) -> bytes:
+    def _acquire(self, key: str) -> _PooledFd:
         with self._lock:
-            f = self._handles.get(key)
-            if f is None:
-                if len(self._handles) >= self._MAX_HANDLES:
-                    # evict least-recently-used (hits re-append below, so
-                    # dict order is LRU-first)
-                    oldest = next(iter(self._handles))
-                    self._handles.pop(oldest).close()
-                f = self.open(key)
-            else:
-                del self._handles[key]  # re-append: mark most-recent
-            self._handles[key] = f
-            f.seek(offset)
-            return f.read(length)
+            h = self._fds.get(key)
+            if h is not None:
+                # re-insert: plain dicts preserve insertion order, so
+                # pop+set keeps the dict LRU-first for eviction
+                del self._fds[key]
+                self._fds[key] = h
+                h.refs += 1
+                return h
+        # open outside the lock (disk metadata IO must not serialize the
+        # pool), then publish — racing openers of the same key keep the
+        # first published fd and retire their own
+        fd = os.open(os.path.join(self.root, key), os.O_RDONLY)
+        with self._lock:
+            h = self._fds.get(key)
+            if h is not None:
+                os.close(fd)
+                del self._fds[key]
+                self._fds[key] = h
+                h.refs += 1
+                return h
+            h = _PooledFd(fd)
+            h.refs = 1
+            self._fds[key] = h
+            while len(self._fds) > self._MAX_HANDLES:
+                oldest = next(iter(self._fds))
+                self._evict_locked(oldest)
+            return h
+
+    def _evict_locked(self, key: str) -> None:
+        h = self._fds.pop(key)
+        h.evicted = True
+        if h.refs == 0:
+            os.close(h.fd)
+
+    def _release(self, h: _PooledFd) -> None:
+        with self._lock:
+            h.refs -= 1
+            if h.evicted and h.refs == 0:
+                os.close(h.fd)
+
+    def open_range(self, key: str, offset: int, length: int) -> bytes:
+        h = self._acquire(key)
+        try:
+            return os.pread(h.fd, length, offset)
+        finally:
+            self._release(h)
 
     def close(self) -> None:
         with self._lock:
-            for f in self._handles.values():
-                f.close()
-            self._handles.clear()
+            for key in list(self._fds):
+                self._evict_locked(key)
 
     def __del__(self):  # best-effort fd release
         try:

@@ -42,6 +42,20 @@ class PartitionedDataset:
             parts[i % num_partitions].append(item)
         return cls(parts)
 
+    @classmethod
+    def from_records(cls, source: str,
+                     verify: bool = False) -> "PartitionedDataset":
+        """Open a pre-decoded record-shard source (``tools/convert.py``
+        output: a ``*.rec`` file, a directory of them, or an object-store
+        URL) as one lazy partition per shard.  Each partition is a
+        ``records.RecordShard`` — ``__getitem__`` is one crc-checked
+        ranged read, no decode — so the usual lazy-partition machinery
+        (``cached()``, ``rebalance``, ``quarantine_map``) composes
+        unchanged.  ``verify=True`` routes reads through a
+        ``VerifyingStore`` carrying every record's crc."""
+        from .records import ShardSet
+        return cls(ShardSet.open(source, verify=verify).partitions())
+
     @property
     def num_partitions(self) -> int:
         return len(self.partitions)

@@ -20,9 +20,11 @@ magnitude.  This module is that engineering, as four composable pieces:
   and ``tools/feedbench.py`` compare against.  A worker thread that DIES
   (not raises — dies) surfaces as a typed :class:`DecodeWorkerError` on
   the consumer, never a hang.
-- :class:`FeedStats` — per-stage wall-time accounting (decode / transform
-  / device_put) so the bench's ``feed_in_loop`` JSON can say WHERE feed
-  time goes instead of one opaque number.
+- :class:`FeedStats` — per-stage wall-time accounting (read / decode /
+  transform / device_put) so the bench's ``feed_in_loop`` JSON can say
+  WHERE feed time goes instead of one opaque number — ``read`` is the
+  object-store/disk IO stage the records path books its ranged reads
+  to, so a slow store is attributable separately from a slow host.
 - :class:`BufferRing` — preallocated rotating output buffers for
   batch-level transforms.  Opt-in: the caller owns the aliasing contract
   (a buffer is reused after ``size`` further batches, so the ring must be
@@ -91,15 +93,16 @@ class FeedStats:
     time — that is the point of the pool).  ``snapshot()`` returns totals;
     ``per_batch()`` divides by delivered batches for the bench JSON."""
 
-    STAGES = ("decode", "transform", "device_put")
+    STAGES = ("read", "decode", "transform", "device_put")
 
     def __init__(self):
         self._lock = threading.Lock()
         self._s = {k: 0.0 for k in self.STAGES}
         self.batches = 0
         self.records = 0
-        self.cache_hits = 0
-        self.cache_misses = 0
+        self.cache_hits = 0        # RAM-tier hits (back-compat meaning)
+        self.cache_disk_hits = 0   # served from the local-disk spill tier
+        self.cache_misses = 0      # every tier missed: origin materialize
 
     def note(self, stage: str, seconds: float, records: int = 0) -> None:
         with self._lock:
@@ -123,15 +126,22 @@ class FeedStats:
             "feed_batches_total", "batches delivered to the consumer"
         ).inc()
 
-    def note_cache(self, hit: bool) -> None:
+    def note_cache(self, hit: bool, tier: str = "ram") -> None:
+        """Record one shard-cache lookup outcome.  ``tier`` labels WHICH
+        tier served a hit (``ram`` or ``disk``); a miss means every tier
+        missed.  ``cache_hits`` keeps its pre-tier meaning (RAM hits) so
+        existing consumers and the bench JSON stay comparable."""
         with self._lock:
-            if hit:
+            if hit and tier == "disk":
+                self.cache_disk_hits += 1
+            elif hit:
                 self.cache_hits += 1
             else:
                 self.cache_misses += 1
         telemetry.get_registry().counter(
-            "feed_cache_total", "shard cache lookups by outcome"
-        ).inc(result="hit" if hit else "miss")
+            "feed_cache_total", "shard cache lookups by outcome and tier"
+        ).inc(result="hit" if hit else "miss",
+              tier=tier if hit else "none")
 
     class _Timer:
         __slots__ = ("_stats", "_stage", "_records", "_t0")
@@ -155,6 +165,7 @@ class FeedStats:
             out = {f"{k}_s": round(v, 6) for k, v in self._s.items()}
             out.update(batches=self.batches, records=self.records,
                        cache_hits=self.cache_hits,
+                       cache_disk_hits=self.cache_disk_hits,
                        cache_misses=self.cache_misses)
             return out
 
@@ -416,28 +427,139 @@ class BufferRing:
         return buf
 
 
+def cache_shards(default: int = 4) -> int:
+    """RAM-tier capacity: ``SPARKNET_CACHE_SHARDS``, else ``default``."""
+    n = _env_int("SPARKNET_CACHE_SHARDS", default)
+    if n < 1:
+        raise ValueError(f"SPARKNET_CACHE_SHARDS must be >= 1, got {n}")
+    return n
+
+
+def cache_spill_dir() -> str | None:
+    """Disk spill tier directory: ``SPARKNET_CACHE_SPILL_DIR`` (empty =
+    spill disabled, the pre-tier behavior)."""
+    return knobs.get_str("SPARKNET_CACHE_SPILL_DIR", "") or None
+
+
+def cache_spill_shards(default: int = 16) -> int:
+    """Disk-tier capacity: ``SPARKNET_CACHE_SPILL_SHARDS``."""
+    n = _env_int("SPARKNET_CACHE_SPILL_SHARDS", default)
+    if n < 1:
+        raise ValueError(
+            f"SPARKNET_CACHE_SPILL_SHARDS must be >= 1, got {n}")
+    return n
+
+
 class ShardCache:
-    """Bounded LRU of materialized (decoded) partitions.
+    """Tiered bounded cache of materialized shards: host RAM LRU, with
+    RAM evictions spilled to local-disk files instead of discarded.
 
     Multi-epoch training re-reads every shard once per epoch; for lazy
     partitions (``imagenet.LazyTarPartition`` decodes on slice access)
-    that means paying the full decode each time.  The cache keeps up to
-    ``max_shards`` fully-materialized partitions so epoch 2+ serve from
-    memory.  Thread-safe; one cache is shared across all partitions of a
-    ``PartitionedDataset.cached()`` view."""
+    that means paying the full decode each time, and for record shards
+    streamed from an object store it means re-paying the wire.  The RAM
+    tier keeps up to ``max_shards`` materialized values; when ``spill_dir``
+    is set (default: the ``SPARKNET_CACHE_SPILL_DIR`` knob), up to
+    ``max_spill`` RAM-evicted shards land as pickle files on local disk,
+    so the fallback on a RAM miss is a local read, not the origin store.
+    Lookup order: RAM → disk (hit promotes back to RAM) → materialize.
+
+    Values may be any picklable materialization — decoded record lists
+    (``CachedPartition``) or whole-shard ``bytes`` blobs
+    (``records.RecordShard.attach_cache``); the cache stores whatever
+    ``materialize()`` returns, uncoerced.
+
+    Per-tier outcomes land in ``FeedStats`` (``cache_hits`` = RAM,
+    ``cache_disk_hits``, ``cache_misses``) and the ``feed_cache_total``
+    counter's ``tier`` label, so perfwatch can attribute a feed breach
+    to the tier that missed.  Thread-safe; one cache is shared across
+    all partitions of a ``PartitionedDataset.cached()`` view."""
 
     def __init__(self, max_shards: int = 4,
-                 stats: FeedStats | None = None):
+                 stats: FeedStats | None = None,
+                 spill_dir: str | None = None,
+                 max_spill: int | None = None):
         if max_shards < 1:
             raise ValueError(f"max_shards must be >= 1, got {max_shards}")
         self.max_shards = max_shards
         self._lock = threading.Lock()
-        self._cache: "OrderedDict[Any, list]" = OrderedDict()
+        self._cache: "OrderedDict[Any, Any]" = OrderedDict()
         self._stats = stats
+        self.spill_dir = cache_spill_dir() if spill_dir is None else (
+            spill_dir or None)
+        self.max_spill = (cache_spill_shards() if max_spill is None
+                          else int(max_spill))
+        self._spilled: "OrderedDict[Any, str]" = OrderedDict()  # key->path
         self.hits = 0
+        self.disk_hits = 0
         self.misses = 0
+        self.spills = 0
 
-    def get(self, key: Any, materialize: Callable[[], Sequence]) -> Sequence:
+    # -- disk tier --------------------------------------------------------
+    def _spill_path(self, key: Any) -> str:
+        import zlib
+        tag = zlib.crc32(repr(key).encode()) & 0xFFFFFFFF
+        return os.path.join(self.spill_dir, f"shard-{tag:08x}.pkl")
+
+    def _spill(self, key: Any, value: Any) -> None:
+        """Write one RAM-evicted shard to the disk tier (atomic tmp +
+        rename; a torn spill file can never be loaded).  Called under
+        the lock — spills are rare (one per RAM eviction) and keeping
+        them ordered keeps the disk-tier LRU exact."""
+        import pickle
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = self._spill_path(key)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump((key, value), f, protocol=4)
+            os.replace(tmp, path)
+        except OSError:
+            # a full/unwritable spill disk degrades to no-spill, it
+            # must not kill the feed
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return
+        self._spilled.pop(key, None)
+        self._spilled[key] = path
+        self.spills += 1
+        while len(self._spilled) > self.max_spill:
+            _, old = self._spilled.popitem(last=False)
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+    def _load_spilled(self, key: Any) -> Any | None:
+        """Try the disk tier; verifies the stored key (crc32 tags can
+        collide) and treats any unreadable file as a miss."""
+        import pickle
+        path = self._spilled.get(key)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                stored_key, value = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError, ValueError):
+            self._spilled.pop(key, None)
+            return None
+        if stored_key != key:
+            return None
+        return value
+
+    def _insert(self, key: Any, value: Any) -> None:
+        """RAM-tier insert + LRU eviction (under the lock); evictees go
+        to the disk tier when one is configured."""
+        self._cache[key] = value
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.max_shards:
+            old_key, old_value = self._cache.popitem(last=False)
+            if self.spill_dir:
+                self._spill(old_key, old_value)
+
+    def get(self, key: Any, materialize: Callable[[], Any]) -> Any:
         with self._lock:
             if key in self._cache:
                 self._cache.move_to_end(key)
@@ -445,18 +567,30 @@ class ShardCache:
                 if self._stats is not None:
                     self._stats.note_cache(True)
                 return self._cache[key]
+            if self.spill_dir:
+                value = self._load_spilled(key)
+                if value is not None:
+                    self.disk_hits += 1
+                    if self._stats is not None:
+                        self._stats.note_cache(True, tier="disk")
+                    self._insert(key, value)   # promote back to RAM
+                    return value
         # materialize OUTSIDE the lock: decode of shard A must not block
         # a cache hit on shard B
-        value = list(materialize())
+        value = materialize()
         with self._lock:
             self.misses += 1
             if self._stats is not None:
                 self._stats.note_cache(False)
-            self._cache[key] = value
-            self._cache.move_to_end(key)
-            while len(self._cache) > self.max_shards:
-                self._cache.popitem(last=False)
+            self._insert(key, value)
             return value
+
+    def tier_counts(self) -> dict[str, int]:
+        with self._lock:
+            return {"ram_hits": self.hits, "disk_hits": self.disk_hits,
+                    "misses": self.misses, "spills": self.spills,
+                    "ram_shards": len(self._cache),
+                    "disk_shards": len(self._spilled)}
 
     def __len__(self) -> int:
         with self._lock:

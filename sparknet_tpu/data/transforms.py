@@ -104,3 +104,35 @@ def scale(batch: np.ndarray, factor: float,
     dest = _take(out, x.shape)
     np.multiply(x, factor, out=dest)
     return dest
+
+
+def augment_batch_host(imgs: np.ndarray, key, spec) -> np.ndarray:
+    """Numpy implementation of ``ops.augment.AugmentSpec`` — the host
+    half of the device-augmentation bit-parity contract.
+
+    Randomness comes from the SAME traced-key draws as the device path
+    (``ops.augment.draw_offsets``, jax threefry — counter-based, so CPU
+    and TPU produce identical offsets), and every op here (u8→f32 cast,
+    f32 subtract, slice, flip, f32 multiply) is IEEE-exact in both numpy
+    and XLA, so ``Solver.set_augment(spec, device=False)`` training is
+    bit-identical to ``device=True`` at the same seed.  Order matches
+    ``db.DataTransformer``: cast → full-size mean subtract → crop →
+    mirror → scale."""
+    from ..ops.augment import draw_offsets
+    n, c, h, w = imgs.shape
+    ys, xs, flips = (np.asarray(a) for a in
+                     draw_offsets(key, n, h, w, spec))
+    x = np.asarray(imgs).astype(np.float32)
+    if spec.mean is not None:
+        x = x - np.asarray(spec.mean, np.float32)
+    if spec.crop:
+        cropped = np.empty((n, c, spec.crop, spec.crop), np.float32)
+        for i in range(n):
+            cropped[i] = x[i, :, ys[i]:ys[i] + spec.crop,
+                           xs[i]:xs[i] + spec.crop]
+        x = cropped
+    if spec.mirror and spec.train:
+        x[flips == 1] = x[flips == 1, :, :, ::-1]
+    if spec.scale != 1.0:
+        x = x * np.float32(spec.scale)
+    return x

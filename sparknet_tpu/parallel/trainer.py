@@ -556,7 +556,8 @@ class DistributedTrainer:
 
     def input_feed(self, rounds: Iterator[Mapping[str, Any]],
                    depth: int | None = None, stats=None,
-                   stall_timeout: float | None = None, restarts: int = 1):
+                   stall_timeout: float | None = None, restarts: int = 1,
+                   device_cast: Mapping[str, Any] | None = None):
         """Stage a host round stream for this trainer through the
         parallel feed pipeline (``data.prefetch.device_feed``) with the
         trainer's ``input_sharding`` — decode/transform/transfer overlap
@@ -566,7 +567,10 @@ class DistributedTrainer:
         in HBM, so the deep default that suits per-step feeds is opt-in
         here — but a pipelined loop (``harvest_lag`` K > 0) keeps K
         compiled rounds in flight and needs that many staged feeds to
-        never be the bottleneck.  Close the returned feed (context
+        never be the bottleneck.  ``device_cast`` (blob -> dtype) stages
+        the host's array as-is and casts AFTER transfer — the raw-uint8
+        feed path (records + device-side augmentation) ships 1/4 the
+        PCIe bytes of an f32 round.  Close the returned feed (context
         manager) after the loop."""
         from ..data.pipeline import FeedStats, feed_depth
         from ..data.prefetch import device_feed
@@ -577,7 +581,8 @@ class DistributedTrainer:
         self.feed_stats = stats
         return device_feed(rounds, depth=depth,
                            sharding=self.input_sharding, stats=stats,
-                           stall_timeout=stall_timeout, restarts=restarts)
+                           stall_timeout=stall_timeout, restarts=restarts,
+                           device_cast=device_cast)
 
     def train_round(self, batches: Mapping[str, Any]) -> float:
         """Run one round (τ steps, each accumulating iter_size

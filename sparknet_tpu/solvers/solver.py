@@ -106,6 +106,9 @@ class Solver:
             Callable[[], Iterator[Mapping[str, Any]]] | None] = \
             [None] * len(self.test_nets)
 
+        self._jit = jit                 # set_augment rebuilds self._step
+        self._augment_spec = None       # ops.augment.AugmentSpec when set
+        self._augment_device = False
         step = self.make_train_step()
         self._step = jax.jit(step, donate_argnums=(0, 1)) if jit else step
         self._test_fwds = [
@@ -129,6 +132,63 @@ class Solver:
     #    reference: src/main/scala/libs/Net.scala:79-92) ------------------
     def set_train_data(self, it: Iterator[Mapping[str, Any]]) -> None:
         self._train_iter = it
+
+    def set_augment(self, spec, device: bool | None = None,
+                    blob: str = "data") -> None:
+        """Fold crop/mirror/mean-subtract/scale into the train step so
+        the feed ships raw uint8 (``records_feed(raw=True)``) and the
+        host transform stage disappears.
+
+        ``device=True`` (default: the ``SPARKNET_AUG_DEVICE`` knob)
+        recompiles ``self._step`` with ``ops.augment.augment_batch``
+        traced in front of the update — the augmentation RNG splits off
+        the step's traced key, so replay stays exact.  ``device=False``
+        runs the SAME spec through the numpy reference
+        (``transforms.augment_batch_host``) on the host, consuming the
+        identical key split — both paths produce bit-identical train
+        losses at the same seed (the exactness-audit contract; every op
+        involved is IEEE-exact in numpy and XLA).  Call with
+        ``spec=None`` to remove augmentation again."""
+        from ..ops.augment import augment_batch
+        from ..utils import knobs
+        if device is None:
+            device = knobs.get_bool("SPARKNET_AUG_DEVICE", True)
+        self._augment_spec = spec
+        self._augment_device = bool(device) and spec is not None
+        self._augment_blob = blob
+        base = self.make_train_step()
+        if self._augment_device:
+            spec_ = spec
+
+            def step(params, state, it, batches, rng):
+                aug_rng, rng = jax.random.split(rng)
+                data = batches[blob]
+                i, n = data.shape[0], data.shape[1]
+                flat = data.reshape((i * n,) + data.shape[2:])
+                out = augment_batch(flat, aug_rng, spec_)
+                batches = dict(batches)
+                batches[blob] = out.reshape((i, n) + out.shape[1:])
+                return base(params, state, it, batches, rng)
+        else:
+            step = base
+        self._step = (jax.jit(step, donate_argnums=(0, 1))
+                      if self._jit else step)
+
+    def _host_augment(self, stacked, rng):
+        """The ``device=False`` half of :meth:`set_augment`: numpy
+        augmentation on the already-stacked [iter, n, ...] feed, drawing
+        from the same key split the device path traces.  Returns
+        (stacked, remaining_rng)."""
+        from ..data.transforms import augment_batch_host
+        aug_rng, rng = jax.random.split(rng)
+        data = np.asarray(stacked[self._augment_blob])
+        i, n = data.shape[0], data.shape[1]
+        flat = data.reshape((i * n,) + data.shape[2:])
+        out = augment_batch_host(flat, aug_rng, self._augment_spec)
+        stacked = dict(stacked)
+        stacked[self._augment_blob] = jnp.asarray(
+            out.reshape((i, n) + out.shape[1:]))
+        return stacked, rng
 
     def set_test_data(self, factory: Callable[[], Iterator[Mapping[str, Any]]],
                       net_id: int = 0) -> None:
@@ -170,6 +230,10 @@ class Solver:
         for _ in range(n):
             stacked = self._next_batches()
             self._rng, rng = jax.random.split(self._rng)
+            if self._augment_spec is not None and not self._augment_device:
+                # host-side half of the augment parity contract: consume
+                # the same key split the device path traces
+                stacked, rng = self._host_augment(stacked, rng)
             debug = self.sp.debug_info and (
                 not self.sp.display or (self.iter + 1) % self.sp.display == 0)
             # copy: the jitted step donates param buffers

@@ -301,6 +301,30 @@ _register(
     Knob("SPARKNET_FEED_STALL_S", "float", "",
          "Feeder stall detector timeout in seconds; unset disables.",
          "sparknet_tpu/data/prefetch.py"),
+    Knob("SPARKNET_RECORD_READERS", "int", "",
+         "Ranged-read pool width for record-shard feeds; 0 = serial "
+         "reference path; unset = SPARKNET_FEED_WORKERS.",
+         "sparknet_tpu/data/records.py"),
+    Knob("SPARKNET_RECORD_SHARD_MB", "int", "64",
+         "Shard roll size in MiB for the record-shard converter.",
+         "sparknet_tpu/data/records.py"),
+    Knob("SPARKNET_CACHE_SHARDS", "int", "4",
+         "RAM tier of the ShardCache: resident shard count before LRU "
+         "eviction (evictees spill to disk when spill is enabled).",
+         "sparknet_tpu/data/pipeline.py"),
+    Knob("SPARKNET_CACHE_SPILL_DIR", "path", "",
+         "Disk spill tier directory for ShardCache evictees; unset "
+         "disables the spill tier (evict = drop).",
+         "sparknet_tpu/data/pipeline.py"),
+    Knob("SPARKNET_CACHE_SPILL_SHARDS", "int", "16",
+         "Max shards held in the ShardCache disk spill tier (oldest "
+         "spill files deleted beyond it).",
+         "sparknet_tpu/data/pipeline.py"),
+    Knob("SPARKNET_AUG_DEVICE", "bool", "1",
+         "Run crop/mirror/mean/scale augmentation inside the compiled "
+         "train step (host ships raw uint8); 0 = host-side numpy path "
+         "(bit-identical at the same seed).",
+         "sparknet_tpu/solvers/solver.py"),
     # --- serving engine ---
     Knob("SPARKNET_SERVE_SHAPES", "spec", "1,4,16,64",
          "Padded batch shapes the engine pre-compiles "
@@ -389,6 +413,10 @@ _register(
          "tools/run_tier1.sh"),
     Knob("SPARKNET_FEEDBENCH", "bool", "",
          "Set to 1 to run the input-pipeline bench gate in run_tier1.sh.",
+         "tools/run_tier1.sh"),
+    Knob("SPARKNET_RECORDBENCH", "bool", "",
+         "Set to 1 to run the record-shard parity gate (feedbench "
+         "--records-leg, clean + corrupt) in run_tier1.sh.",
          "tools/run_tier1.sh"),
     Knob("SPARKNET_ROUNDBENCH", "bool", "",
          "Set to 1 to run the round-overhead bench gate in run_tier1.sh.",

@@ -49,7 +49,8 @@ LEDGER_RELPATH = os.path.join("perf", "LEDGER.jsonl")
 
 # fingerprint fields, in canonical key order
 FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
-                      "backend", "fuse_plan", "replicas", "tune_plan")
+                      "backend", "fuse_plan", "replicas", "tune_plan",
+                      "feed_source")
 
 # entries written before the vertical fusion pass existed carry no
 # fuse_plan field; they were structurally unfused, so they pool with
@@ -60,8 +61,11 @@ FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
 # band separately.  And entries before the lowering autotuner ran every
 # lowering at its hardcoded default, exactly what SPARKNET_TUNE=off runs
 # today — they read as tune_plan="off" so r01-r11 bands keep gating.
+# Entries before the record-shard feed existed were all LMDB-decode
+# captures: they read as feed_source="lmdb" so the committed feed
+# history keeps gating, while records captures band separately.
 _FINGERPRINT_DEFAULTS = {"fuse_plan": "off", "replicas": 1,
-                         "tune_plan": "off"}
+                         "tune_plan": "off", "feed_source": "lmdb"}
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -98,7 +102,8 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
                 backend: str | None = None,
                 fuse_plan: str | None = None,
                 replicas: int | None = None,
-                tune_plan: str | None = None) -> dict[str, Any]:
+                tune_plan: str | None = None,
+                feed_source: str | None = None) -> dict[str, Any]:
     """Canonical config fingerprint.  ``backend`` defaults to the
     platform half of ``device`` (``"tpu/TPU v5 lite"`` -> ``"tpu"``) —
     the field the baseline isolation hinges on.  ``fuse_plan`` is the
@@ -109,7 +114,10 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
     capture are different deployments with different qps bands.
     ``tune_plan`` is the lowering-autotuner table id
     (``Net.tune_plan_id()``): tuned lowerings are a different program
-    than the hardcoded defaults ("off"), same isolation argument."""
+    than the hardcoded defaults ("off"), same isolation argument.
+    ``feed_source`` is the input-pipeline source family ("lmdb" decode
+    path vs pre-decoded "records" shards): feed throughput bands are
+    incomparable across them, so they must not pool."""
     if backend is None and device:
         backend = str(device).split("/", 1)[0]
     return {"model": model or "unknown", "dtype": dtype or "unknown",
@@ -119,7 +127,8 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
             "backend": backend or "unknown",
             "fuse_plan": fuse_plan or "off",
             "replicas": int(replicas) if replicas is not None else 1,
-            "tune_plan": tune_plan or "off"}
+            "tune_plan": tune_plan or "off",
+            "feed_source": feed_source or "lmdb"}
 
 
 def fp_key(fp: Mapping[str, Any]) -> str:
@@ -468,9 +477,29 @@ def entries_from_bench(doc: Mapping[str, Any], path: str | None = None, *,
             "overlap_pct": feed.get("overlap_pct"),
             # PR-4 per-stage breakdown (absent in pre-PR-4 captures) —
             # the fields regress-attribution names a stage from
+            "feed_read_s": feed.get("read_s"),
             "feed_decode_s": feed.get("decode_s"),
             "feed_transform_s": feed.get("transform_s"),
             "feed_device_put_s": feed.get("device_put_s"),
+        }
+        out.append(make_entry("bench_feed", path, fp,
+                              {k: v for k, v in metrics.items()
+                               if v is not None},
+                              round_tag=round_tag, t=t, **prov))
+
+    rec = doc.get("feed_records") or {}
+    if rec and not rec.get("error"):
+        # the records leg stages uint8 and bands under its own
+        # feed_source so it never pools with decode-path feed captures
+        fp = fingerprint(model=model, dtype="uint8",
+                         batch=rec.get("batch"), world=1, device=device,
+                         feed_source=rec.get("feed_source") or "records")
+        metrics = {
+            "feed_img_s": rec.get("images_per_sec"),
+            "feed_serial_img_s": rec.get("serial_img_s"),
+            "feed_records_speedup_x": rec.get("speedup_x"),
+            "feed_convert_s": rec.get("convert_s"),
+            "feed_read_s": rec.get("read_s"),
         }
         out.append(make_entry("bench_feed", path, fp,
                               {k: v for k, v in metrics.items()

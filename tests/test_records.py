@@ -1,0 +1,436 @@
+"""Record-shard format + feed tests: the pre-decoded shard format's
+write/read round trip and typed corruption, the converter, streaming
+ingestion through a VerifyingStore, the tiered ShardCache (RAM + disk
+spill), records_feed bit-parity against the serial LMDB decode path
+(clean AND under corrupt_record faults), thread-safe LocalStore ranged
+reads under a concurrent pool, and device-vs-host augmentation
+bit-identity at a shared RNG seed."""
+
+import itertools
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import PartitionedDataset
+from sparknet_tpu.data.db import array_to_datum, db_feed
+from sparknet_tpu.data.integrity import (
+    DataCorruptionError, Quarantine, QuarantinePolicy,
+)
+from sparknet_tpu.data.lmdb_io import write_lmdb
+from sparknet_tpu.data.objectstore import LocalStore, VerifyingStore
+from sparknet_tpu.data.pipeline import FeedStats, ShardCache
+from sparknet_tpu.data.records import (
+    RecordShard, ShardSet, ShardWriter, convert_to_shards,
+    is_records_source, records_feed, write_shard,
+)
+from sparknet_tpu.models.dsl import layer
+from sparknet_tpu.proto.caffe_pb import Phase
+from sparknet_tpu.utils import faults
+
+
+def _records(n, c=3, h=8, w=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, 256, size=(c, h, w)).astype(np.uint8),
+             int(rng.integers(0, 10))) for i in range(n)]
+
+
+def _write_lmdb_of(path, recs):
+    write_lmdb(path, [(b"%08d" % i, array_to_datum(img, label))
+                      for i, (img, label) in enumerate(recs)])
+
+
+def _data_layer(source, batch, backend):
+    return layer("d", "Data", [], ["data", "label"],
+                 data_param={"source": source, "batch_size": batch,
+                             "backend": backend},
+                 transform_param={"scale": 0.5, "mean_value": [16.0]})
+
+
+# ---------------------------------------------------------------------------
+# Shard format round trip + typed corruption
+# ---------------------------------------------------------------------------
+
+def test_shard_roundtrip_bit_exact(tmp_path):
+    recs = _records(7)
+    path = str(tmp_path / "a.rec")
+    assert write_shard(path, recs) == 7
+    shard = RecordShard.open(path)
+    assert shard.count == 7 and len(shard) == 7
+    assert (shard.c, shard.h, shard.w) == (3, 8, 8)
+    for i, (img, label) in enumerate(recs):
+        got, glabel = shard.read(i)
+        assert got.dtype == np.uint8
+        assert np.array_equal(img, got)
+        assert label == glabel
+    # the lazy-partition surface: slicing and iteration
+    assert len(shard[2:5]) == 3
+    assert np.array_equal(shard[3][0], recs[3][0])
+    assert sum(1 for _ in shard) == 7
+
+
+def test_shard_flipped_byte_is_typed_corruption_with_attribution(tmp_path):
+    recs = _records(5)
+    path = str(tmp_path / "a.rec")
+    write_shard(path, recs)
+    shard = RecordShard.open(path)
+    pos = shard.offset(3) + 5
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        orig = f.read(1)[0]
+        f.seek(pos)
+        f.write(bytes([orig ^ 0xFF]))
+    shard = RecordShard.open(path)
+    with pytest.raises(DataCorruptionError) as ei:
+        shard.read(3)
+    assert ei.value.key == 3
+    assert ei.value.offset == shard.offset(3)
+    # neighbours still read clean — corruption is per-record, not per-shard
+    assert np.array_equal(shard.read(2)[0], recs[2][0])
+
+
+def test_shard_writer_rejects_non_uint8(tmp_path):
+    w = ShardWriter(str(tmp_path / "a.rec"), 1, 2, 2)
+    with pytest.raises(DataCorruptionError):
+        w.add(np.full((1, 2, 2), 0.5, np.float32), 0)
+    w.add(np.zeros((1, 2, 2), np.uint8), 1)
+    assert w.close() == 1
+
+
+def test_garbage_file_is_typed_corruption(tmp_path):
+    path = str(tmp_path / "junk.rec")
+    with open(path, "wb") as f:
+        f.write(b"not a shard at all, far too short?" * 3)
+    with pytest.raises(DataCorruptionError):
+        RecordShard.open(path)
+
+
+# ---------------------------------------------------------------------------
+# Converter + ShardSet
+# ---------------------------------------------------------------------------
+
+def test_convert_rolls_shards_and_shardset_replays_in_order(tmp_path):
+    recs = _records(10, c=2, h=4, w=4)
+    stride = 2 * 4 * 4 + 8
+    out = convert_to_shards(iter(recs), str(tmp_path / "s"),
+                            shard_bytes=3 * stride)
+    assert out["records"] == 10 and len(out["shards"]) > 1
+    assert out["geometry"] == (2, 4, 4)
+    ss = ShardSet.open(str(tmp_path / "s"))
+    assert ss.count == 10
+    for i, (img, label) in enumerate(recs):
+        shard, j = ss.locate(i)
+        got, glabel = shard.read(j)
+        assert np.array_equal(img, got) and label == glabel
+    assert is_records_source(str(tmp_path / "s"))
+    assert not is_records_source(str(tmp_path))
+    ss.close()
+
+
+def test_convert_quarantines_bad_records(tmp_path):
+    def stream():
+        yield np.zeros((1, 2, 2), np.uint8), 0
+        yield np.full((1, 2, 2), 0.5, np.float32), 1   # not representable
+        yield np.ones((1, 2, 2), np.uint8), 2
+
+    q = Quarantine(QuarantinePolicy(max_fraction=0.5), epoch_size=3)
+    out = convert_to_shards(stream(), str(tmp_path / "s"), quarantine=q)
+    assert out["records"] == 2
+    assert q.report()["total_bad"] == 1
+
+
+def test_shardset_verifying_store_reads_bit_exact(tmp_path):
+    recs = _records(9)
+    convert_to_shards(iter(recs), str(tmp_path / "s"),
+                      shard_bytes=4 * (3 * 8 * 8 + 8))
+    ss = ShardSet.open(str(tmp_path / "s"), verify=True)
+    assert all(isinstance(s.store, VerifyingStore) for s in ss.shards)
+    for i, (img, label) in enumerate(recs):
+        shard, j = ss.locate(i)
+        got, glabel = shard.read(j)
+        assert np.array_equal(img, got) and label == glabel
+    ss.close()
+
+
+def test_partitioned_dataset_from_records(tmp_path):
+    recs = _records(8)
+    convert_to_shards(iter(recs), str(tmp_path / "s"),
+                      shard_bytes=3 * (3 * 8 * 8 + 8))
+    ds = PartitionedDataset.from_records(str(tmp_path / "s"))
+    assert sum(len(p) for p in ds.partitions) == 8
+    flat = [r for part in ds.partitions for r in part]
+    for (img, label), (gimg, glabel) in zip(recs, flat):
+        assert np.array_equal(img, gimg) and label == glabel
+
+
+# ---------------------------------------------------------------------------
+# LocalStore under a concurrent ranged-read pool
+# ---------------------------------------------------------------------------
+
+def test_local_store_concurrent_ranged_reads():
+    import tempfile
+    with tempfile.TemporaryDirectory() as root:
+        blobs = {}
+        for k in range(3):
+            payload = bytes((k * 17 + i) % 256 for i in range(4096))
+            with open(os.path.join(root, f"b{k}"), "wb") as f:
+                f.write(payload)
+            blobs[f"b{k}"] = payload
+        store = LocalStore(root)
+        errs = []
+
+        def reader(tid):
+            rng = np.random.default_rng(tid)
+            try:
+                for _ in range(300):
+                    key = f"b{int(rng.integers(3))}"
+                    off = int(rng.integers(0, 4000))
+                    ln = int(rng.integers(1, 96))
+                    got = store.open_range(key, off, ln)
+                    if got != blobs[key][off:off + ln]:
+                        errs.append((tid, key, off, ln))
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errs.append((tid, repr(e)))
+
+        threads = [threading.Thread(target=reader, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Tiered ShardCache
+# ---------------------------------------------------------------------------
+
+def test_shard_cache_tiers_spill_and_promote(tmp_path):
+    stats = FeedStats()
+    cache = ShardCache(max_shards=2, stats=stats,
+                       spill_dir=str(tmp_path / "spill"), max_spill=8)
+    payloads = {k: bytes([k]) * 64 for k in range(4)}
+    for k in range(4):   # k=2,3 evict k=0,1 to disk
+        assert cache.get(k, lambda k=k: payloads[k]) == payloads[k]
+    tiers = cache.tier_counts()
+    assert tiers["ram_shards"] == 2 and tiers["disk_shards"] == 2
+    # RAM hit
+    assert cache.get(3, lambda: b"wrong") == payloads[3]
+    # disk hit promotes back to RAM (and evicts another to disk)
+    assert cache.get(0, lambda: b"wrong") == payloads[0]
+    snap = stats.snapshot()
+    assert snap["cache_hits"] == 1
+    assert snap["cache_disk_hits"] == 1
+    assert snap["cache_misses"] == 4
+    assert cache.tier_counts()["spills"] >= 3
+
+
+def test_shard_cache_spill_bound_deletes_oldest(tmp_path):
+    cache = ShardCache(max_shards=1, spill_dir=str(tmp_path / "spill"),
+                       max_spill=2)
+    for k in range(5):
+        cache.get(k, lambda k=k: bytes([k]))
+    assert cache.tier_counts()["disk_shards"] <= 2
+    spilled = os.listdir(str(tmp_path / "spill"))
+    assert len(spilled) <= 2
+
+
+def test_shard_cache_without_spill_dir_just_evicts():
+    cache = ShardCache(max_shards=1, spill_dir="")
+    cache.get("a", lambda: b"a")
+    cache.get("b", lambda: b"b")
+    assert cache.tier_counts()["disk_shards"] == 0
+    # "a" was dropped, not spilled: re-materializes
+    assert cache.get("a", lambda: b"a2") == b"a2"
+
+
+# ---------------------------------------------------------------------------
+# records_feed bit-parity vs the serial LMDB decode reference
+# ---------------------------------------------------------------------------
+
+def _pull_batches(feed, n):
+    out = []
+    for _ in range(n):
+        b = next(feed)
+        out.append({k: np.array(v) for k, v in b.items()})
+    feed.close()
+    return out
+
+
+def _norm_quarantine(rep):
+    rep = dict(rep)
+    rep.pop("examples", None)
+    rep.pop("by_source", None)   # source names differ across backends
+    return rep
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_records_feed_bit_identical_to_serial_lmdb(tmp_path, monkeypatch,
+                                                   corrupt):
+    if corrupt:
+        monkeypatch.setenv("SPARKNET_FAULT", "corrupt_record:0.1")
+        monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    n, batch, batches = 48, 8, 13   # 13*8 > 2 epochs: epoch rolls covered
+    recs = _records(n, seed=7)
+    db = str(tmp_path / "lmdb")
+    _write_lmdb_of(db, recs)
+    shards = str(tmp_path / "shards")
+    convert_to_shards(iter(recs), shards,
+                      shard_bytes=20 * (3 * 8 * 8 + 8))
+
+    faults.reset_injector()
+    qa = Quarantine(QuarantinePolicy(max_fraction=0.5), epoch_size=n)
+    ref = _pull_batches(db_feed(_data_layer(db, batch, "LMDB"),
+                                Phase.TRAIN, seed=0, quarantine=qa,
+                                workers=0), batches)
+
+    faults.reset_injector()
+    qb = Quarantine(QuarantinePolicy(max_fraction=0.5), epoch_size=n)
+    stats = FeedStats()
+    got = _pull_batches(records_feed(_data_layer(shards, batch, "RECORDS"),
+                                     Phase.TRAIN, seed=0, quarantine=qb,
+                                     workers=4, stats=stats), batches)
+
+    for a, b in zip(ref, got):
+        assert np.array_equal(a["data"], b["data"])
+        assert np.array_equal(a["label"], b["label"])
+    assert _norm_quarantine(qa.report()) == _norm_quarantine(qb.report())
+    if corrupt:
+        assert qb.report()["total_bad"] > 0
+        assert any(shards in s for s in qb.report()["by_source"])
+    snap = stats.snapshot()
+    assert snap["read_s"] > 0     # the IO stage books under "read"
+    assert snap["decode_s"] >= 0 and snap["batches"] == batches
+
+
+def test_db_feed_dispatches_records_backend(tmp_path):
+    """A Data layer whose source holds ``*.rec`` flows through db_feed
+    unchanged — the dispatch point every prototxt already uses."""
+    recs = _records(16, seed=2)
+    shards = str(tmp_path / "s")
+    convert_to_shards(iter(recs), shards)
+    faults.reset_injector()
+    feed = db_feed(_data_layer(shards, 4, "RECORDS"), Phase.TRAIN, seed=0)
+    a = _pull_batches(feed, 2)
+    faults.reset_injector()
+    b = _pull_batches(records_feed(_data_layer(shards, 4, "RECORDS"),
+                                   Phase.TRAIN, seed=0), 2)
+    for x, y in zip(a, b):
+        assert np.array_equal(x["data"], y["data"])
+
+
+def test_records_feed_from_verifying_store_with_tiered_cache(tmp_path):
+    recs = _records(24, seed=5)
+    shards = str(tmp_path / "s")
+    convert_to_shards(iter(recs), shards, shard_bytes=8 * (3 * 8 * 8 + 8))
+    faults.reset_injector()
+    ref = _pull_batches(records_feed(_data_layer(shards, 8, "RECORDS"),
+                                     Phase.TRAIN, seed=0, workers=0), 6)
+    stats = FeedStats()
+    cache = ShardCache(max_shards=1, stats=stats,
+                       spill_dir=str(tmp_path / "spill"), max_spill=8)
+    faults.reset_injector()
+    got = _pull_batches(records_feed(_data_layer(shards, 8, "RECORDS"),
+                                     Phase.TRAIN, seed=0, workers=2,
+                                     verify=True, cache=cache), 6)
+    for a, b in zip(ref, got):
+        assert np.array_equal(a["data"], b["data"])
+        assert np.array_equal(a["label"], b["label"])
+    snap = stats.snapshot()
+    assert snap["cache_misses"] >= 3          # one cold miss per shard
+    assert snap["cache_hits"] > 0             # within-shard locality
+    assert snap["cache_disk_hits"] > 0        # epoch 2 rereads spilled
+
+
+# ---------------------------------------------------------------------------
+# Device-side augmentation bit-parity
+# ---------------------------------------------------------------------------
+
+def test_device_and_host_augment_arrays_bit_identical():
+    import jax
+
+    from sparknet_tpu.ops.augment import AugmentSpec, augment_batch
+    from sparknet_tpu.data.transforms import augment_batch_host
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(6, 3, 12, 12)).astype(np.uint8)
+    spec = AugmentSpec(crop=8, mirror=True, mean=16.0, scale=0.25,
+                       train=True)
+    key = jax.random.PRNGKey(123)
+    dev = np.asarray(augment_batch(imgs, key, spec))
+    host = augment_batch_host(imgs, key, spec)
+    assert dev.shape == (6, 3, 8, 8)
+    assert np.array_equal(dev, host)          # bit-identical, not close
+    # test phase: deterministic center crop, no mirror
+    tspec = spec._replace(train=False)
+    dev_t = np.asarray(augment_batch(imgs, key, tspec))
+    host_t = augment_batch_host(imgs, key, tspec)
+    assert np.array_equal(dev_t, host_t)
+
+
+def test_solver_device_augment_losses_bit_identical():
+    """set_augment(device=True) — augmentation traced into the jitted
+    step — must reproduce the host-numpy path's losses bit for bit at
+    the same seed (tame LR so losses stay finite and comparable)."""
+    import itertools as it
+
+    from sparknet_tpu.models import lenet
+    from sparknet_tpu.ops.augment import AugmentSpec
+    from sparknet_tpu.proto import load_solver_prototxt_with_net
+    from sparknet_tpu.solvers import Solver
+
+    txt = ("base_lr: 0.0005\nmomentum: 0.9\nweight_decay: 0.004\n"
+           "lr_policy: \"fixed\"\n")
+    spec = AugmentSpec(crop=28, mirror=True, mean=[16.0], scale=1.0 / 255,
+                       train=True)
+    rng = np.random.default_rng(0)
+    host = [{"data": rng.integers(0, 256, size=(8, 1, 32, 32)
+                                  ).astype(np.uint8),
+             "label": rng.integers(0, 10, size=8).astype(np.float32)}
+            for _ in range(4)]
+
+    def run(device):
+        sp = load_solver_prototxt_with_net(txt, lenet(16, 16))
+        solver = Solver(sp, seed=0)
+        solver.set_augment(spec, device=device)
+        solver.set_train_data(it.cycle(host))
+        return [float(solver.step(1)) for _ in range(5)]
+
+    a, b = run(True), run(False)
+    assert all(np.isfinite(a)), a
+    assert a == b                              # bit-identical losses
+
+
+def test_augment_spec_from_transform_param():
+    from sparknet_tpu.ops.augment import AugmentSpec, out_shape
+    spec = AugmentSpec.from_transform_param(
+        {"crop_size": 24, "mirror": True, "mean_value": [10.0, 20.0, 30.0],
+         "scale": 0.5}, Phase.TRAIN)
+    assert spec.crop == 24 and spec.mirror and spec.train
+    assert spec.scale == 0.5
+    assert np.asarray(spec.mean).shape == (3, 1, 1)
+    assert out_shape((4, 3, 32, 32), spec) == (4, 3, 24, 24)
+
+
+# ---------------------------------------------------------------------------
+# Converter CLI
+# ---------------------------------------------------------------------------
+
+def test_convert_cli_lmdb_roundtrip(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import convert as convert_cli
+    recs = _records(12, seed=11)
+    db = str(tmp_path / "lmdb")
+    _write_lmdb_of(db, recs)
+    out_dir = str(tmp_path / "shards")
+    assert convert_cli.main(["--source", db, "--out", out_dir]) == 0
+    ss = ShardSet.open(out_dir)
+    assert ss.count == 12
+    for i, (img, label) in enumerate(recs):
+        shard, j = ss.locate(i)
+        got, glabel = shard.read(j)
+        assert np.array_equal(img, got) and label == glabel
+    ss.close()

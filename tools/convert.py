@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Convert LMDB / LevelDB / HDF5 / imagenet-tar sources into pre-decoded
+record shards (``sparknet_tpu.data.records`` format v1).
+
+This is the convert-once half of the feed-at-device-speed path: decode
+every record ONE time here (Caffe's convert_imageset lesson, arXiv
+1408.5093), write fixed-stride uint8 blocks with per-record crc32s, and
+every later epoch is ranged reads — no decode, no re-parse.  Records
+that fail to decode or are not uint8-representable route through the
+quarantine path (bounded budget from the ``SPARKNET_QUARANTINE_*``
+knobs; the default zero-tolerance policy makes any corruption a loud
+typed failure, ``--max-bad-fraction`` budgets it).
+
+Shard roll size comes from ``SPARKNET_RECORD_SHARD_MB`` (default 64).
+Prints ONE JSON summary line (shards, records, quarantine report).
+
+Usage:
+  python tools/convert.py --source /data/train_lmdb --backend lmdb \
+      --out /data/train_shards
+  python tools/convert.py --source /data/train.h5 --backend hdf5 \
+      --out shards [--data-key data --label-key label]
+  python tools/convert.py --source /data/tars --backend tar \
+      --labels labels.txt --resize 256 --out shards
+
+Backends: lmdb, leveldb, hdf5, tar, auto (default: sniff the source).
+The output directory feeds straight back in: a ``Data`` layer with
+``backend: "RECORDS"`` (or any ``source`` holding ``*.rec``) streams it
+through ``records_feed``, and ``tools/feedbench.py --records-leg``
+proves the round trip bit-identical to the serial decode path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _to_uint8(img: np.ndarray, *, source: str, key=None,
+              quantize: bool = False) -> np.ndarray:
+    """uint8 view of a decoded record.  Exact-valued floats (the datum
+    decode path yields 0..255 integers as f32) cast losslessly; with
+    ``quantize`` (the JPEG-resize path, whose interpolation is
+    fractional by nature) values are round-clipped — a deliberate,
+    one-time quantization at convert time.  Anything else is typed
+    corruption for the quarantine."""
+    from sparknet_tpu.data.integrity import DataCorruptionError
+    img = np.asarray(img)
+    if img.dtype == np.uint8:
+        return img
+    if quantize:
+        return np.clip(np.round(img), 0, 255).astype(np.uint8)
+    as_u8 = img.astype(np.uint8)
+    if np.array_equal(as_u8.astype(img.dtype), img):
+        return as_u8
+    raise DataCorruptionError(
+        "record is not uint8-representable (float pixels outside exact "
+        "0..255); pass --quantize to round-clip at convert time",
+        source=source, key=key)
+
+
+def iter_db(source: str, backend: str, quantize: bool = False):
+    """(img_u8, label) stream off an LMDB/LevelDB cursor, in cursor
+    order (the order ``db_feed`` replays — bit-identity depends on it)."""
+    from sparknet_tpu.data.db import datum_to_array, open_db
+    reader = open_db(source, backend)
+    for key, val in reader.items():
+        img, label = datum_to_array(val, key=key, source=source)
+        yield _to_uint8(img, source=source, key=key,
+                        quantize=quantize), label
+
+
+def iter_hdf5(source: str, data_key: str, label_key: str,
+              quantize: bool = False):
+    from sparknet_tpu.data.hdf5 import load_hdf5_blobs
+    blobs = load_hdf5_blobs(source, [data_key, label_key])
+    data, labels = blobs[data_key], blobs[label_key]
+    if data.ndim != 4:
+        raise ValueError(
+            f"{source}:{data_key} must be [n, c, h, w], got {data.shape}")
+    for i in range(data.shape[0]):
+        yield _to_uint8(data[i], source=source, key=i,
+                        quantize=quantize), int(labels[i])
+
+
+def iter_tars(source: str, label_file: str, resize: int):
+    """Decoded (img_u8, label) stream over every tar under ``source`` —
+    the ImageNetLoader path (stream-untar → JPEG decode → force-resize),
+    paid once here instead of per epoch.  Resize interpolation is
+    fractional, so this path always quantizes."""
+    from sparknet_tpu.data.imagenet import (
+        decode_and_resize, list_tars, read_label_map, stream_tar_images)
+    labels = read_label_map(label_file)
+    for tar in list_tars(source):
+        pairs = stream_tar_images(tar, labels)
+        for img, label in decode_and_resize(pairs, resize):
+            yield _to_uint8(img, source=tar, quantize=True), label
+
+
+def sniff_backend(source: str) -> str:
+    from sparknet_tpu.data.hdf5 import is_hdf5_file
+    if os.path.isfile(source):
+        return "hdf5" if is_hdf5_file(source) else "lmdb"
+    if os.path.isdir(source):
+        names = os.listdir(source)
+        if any(n.endswith(".tar") for n in names):
+            return "tar"
+        if any(n.endswith(".mdb") for n in names):
+            return "lmdb"
+        if any(n.endswith((".ldb", ".sst", ".log")) for n in names):
+            return "leveldb"
+    raise ValueError(
+        f"cannot sniff a backend for {source!r}; pass --backend")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--source", required=True,
+                    help="LMDB/LevelDB dir, .h5 file, or tar root")
+    ap.add_argument("--out", required=True,
+                    help="output shard directory (created)")
+    ap.add_argument("--backend", default="auto",
+                    choices=["auto", "lmdb", "leveldb", "hdf5", "tar"])
+    ap.add_argument("--labels", default=None,
+                    help="label map file (tar backend)")
+    ap.add_argument("--resize", type=int, default=256,
+                    help="force-resize edge for the tar backend")
+    ap.add_argument("--data-key", default="data")
+    ap.add_argument("--label-key", default="label")
+    ap.add_argument("--quantize", action="store_true",
+                    help="round-clip non-integer float pixels to uint8 "
+                         "instead of quarantining them")
+    ap.add_argument("--max-bad-fraction", type=float, default=None,
+                    help="quarantine budget override (default: the "
+                         "SPARKNET_QUARANTINE_* knobs)")
+    ap.add_argument("--shard-mb", type=int, default=None,
+                    help="shard roll size override "
+                         "(default SPARKNET_RECORD_SHARD_MB)")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.data.integrity import Quarantine, QuarantinePolicy
+    from sparknet_tpu.data.records import convert_to_shards
+
+    backend = args.backend
+    if backend == "auto":
+        backend = sniff_backend(args.source)
+    if backend in ("lmdb", "leveldb"):
+        records = iter_db(args.source, backend.upper(),
+                          quantize=args.quantize)
+    elif backend == "hdf5":
+        records = iter_hdf5(args.source, args.data_key, args.label_key,
+                            quantize=args.quantize)
+    else:
+        if not args.labels:
+            ap.error("--backend tar requires --labels")
+        records = iter_tars(args.source, args.labels, args.resize)
+
+    policy = (QuarantinePolicy(max_fraction=args.max_bad_fraction)
+              if args.max_bad_fraction is not None
+              else QuarantinePolicy.from_env())
+    quarantine = Quarantine(policy, source=args.source)
+    summary = convert_to_shards(
+        records, args.out, quarantine=quarantine,
+        shard_bytes=args.shard_mb * (1 << 20) if args.shard_mb else None)
+    summary["source"] = args.source
+    summary["backend"] = backend
+    print(json.dumps(summary, default=str), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

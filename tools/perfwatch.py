@@ -166,6 +166,7 @@ def cmd_ingest(args) -> int:
 # stage-metric -> human attribution label (the PR-8 telemetry names the
 # operator would grep for)
 _STAGE_LABELS = {
+    "feed_read_s": "feed.read (feed_stage_seconds{stage=read})",
     "feed_decode_s": "feed.decode (feed_stage_seconds{stage=decode})",
     "feed_transform_s":
         "feed.transform (feed_stage_seconds{stage=transform})",

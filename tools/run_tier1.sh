@@ -63,6 +63,26 @@ maybe_feedbench() {
   fi
 }
 
+# ~3-second record-shard parity gate (tools/feedbench.py --records-leg)
+# — opt-in via SPARKNET_RECORDBENCH=1.  Converts a tiny synthetic LMDB
+# to pre-decoded record shards and replays the SAME batches from local
+# shards, from a VerifyingStore through the tiered ShardCache (RAM +
+# disk spill), and warm — all must be bit-identical to the serial
+# decode reference (pixels, labels, quarantine admissions), clean and
+# under corrupt_record injection, with cold/warm cache-tier hits
+# asserted and a planted corrupt shard block quarantined with source
+# attribution.
+maybe_recordbench() {
+  if [ "${SPARKNET_RECORDBENCH:-}" = "1" ]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python tools/feedbench.py --seconds 2 --records-leg \
+      --out /tmp/_recordbench.json \
+      && timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python tools/feedbench.py --seconds 2 --records-leg --corrupt \
+          --out /tmp/_recordbench_corrupt.json
+  fi
+}
+
 # ~60-second two-job fleet chaos smoke (tools/soak.py --fleet 2) — opt-in
 # via SPARKNET_FLEETSOAK=1.  Two concurrent jobs under one FleetScheduler
 # with pinned crash + preempt schedules, plus a late whole-budget
@@ -186,6 +206,7 @@ case "${1:-}" in
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
   --fleetsoak) SPARKNET_FLEETSOAK=1 maybe_fleetsoak ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
+  --recordbench) SPARKNET_RECORDBENCH=1 maybe_recordbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
   --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
   --fleetservesmoke) SPARKNET_FLEETSERVESMOKE=1 maybe_fleetservesmoke ;;
@@ -195,15 +216,15 @@ case "${1:-}" in
   --tunebench) SPARKNET_TUNEBENCH=1 maybe_tunebench ;;
   --all)   maybe_lint && run_tier1 && run_chaos && maybe_soak \
              && maybe_fleetsoak \
-             && maybe_feedbench && maybe_servesmoke \
+             && maybe_feedbench && maybe_recordbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
              && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
              && maybe_perfgate ;;
   "")      maybe_lint && run_tier1 && maybe_soak && maybe_fleetsoak \
-             && maybe_feedbench \
+             && maybe_feedbench && maybe_recordbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
              && maybe_roundbench && maybe_obssmoke \
              && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--feedbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac
