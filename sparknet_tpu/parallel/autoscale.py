@@ -165,6 +165,19 @@ class Autoscaler:
 
         cooling = (now - self._last_action_at.get(model, -1e18)
                    < self.cfg.cooldown_s)
+        if n < self.cfg.min_replicas and not cooling:
+            # below the floor: replicas died faster than the fleet could
+            # requeue them (bulk host loss).  Backfill onto surviving
+            # hosts within budget — this is availability repair, so it
+            # outranks the pressure/idle policy.
+            ok = bool(self.scale_up(model))
+            self._last_action_at[model] = now
+            return {"action": "up" if ok else "up_blocked",
+                    "reason": f"{n} < min_replicas "
+                              f"{self.cfg.min_replicas} — backfill"
+                              + ("" if ok else " blocked: device budget "
+                                               "has no free gang"),
+                    "replicas": n}
         if pressure and n < self.cfg.max_replicas and not cooling:
             ok = bool(self.scale_up(model))
             self._last_action_at[model] = now

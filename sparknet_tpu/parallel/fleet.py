@@ -208,28 +208,217 @@ class JobSpec:
         return cls(**d)
 
 
+# host lifecycle states (journaled verbatim, host-control channel too)
+HOST_LIVE = "live"
+HOST_DRAINING = "draining"   # spot notice: evict gracefully, stop placing
+HOST_LOST = "lost"           # dead: its gangs are already gone
+HOST_STATES = (HOST_LIVE, HOST_DRAINING, HOST_LOST)
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSpec:
+    """One machine in the pod: a name, its device budget, and how to
+    reach it.  ``addr`` in ``launch.LOCAL_ADDRS`` (the default) means
+    "spawn here" — an inventory of all-local hosts is the simulated
+    N-host rig that runs every cross-host path on one CPU box."""
+
+    name: str
+    devices: int
+    addr: str = "local"
+
+    def __post_init__(self):
+        if not self.name or any(c in self.name for c in "/\\ \t\n,=@"):
+            raise ValueError(f"bad host name {self.name!r}")
+        if self.devices < 1:
+            raise ValueError(f"host {self.name}: devices must be >= 1")
+
+
+class HostPool:
+    """The fleet's machine inventory + liveness state.  Hosts are
+    ``live`` (placeable), ``draining`` (spot/preemption notice: existing
+    gangs get the SNAPSHOT_STOP eviction, nothing new lands), or
+    ``lost`` (dead — slots unplaceable until marked live again).
+
+    Inventory sources: ``HostPool.parse("a=4,b=4@10.0.0.2")`` (inline,
+    ``name=devices[@addr]``), a JSON file (``[{"name", "devices",
+    "addr"}]``), or ``from_env()`` reading SPARKNET_FLEET_HOSTS (a path
+    to such a file, or the inline form)."""
+
+    def __init__(self, hosts: Iterable[HostSpec]):
+        self._specs: dict[str, HostSpec] = {}
+        for h in hosts:
+            if h.name in self._specs:
+                raise ValueError(f"duplicate host {h.name!r}")
+            self._specs[h.name] = h
+        if not self._specs:
+            raise ValueError("empty host inventory")
+        self.state: dict[str, str] = {n: HOST_LIVE for n in self._specs}
+
+    # -- inventory --------------------------------------------------------
+    def specs(self) -> list[HostSpec]:
+        return list(self._specs.values())
+
+    def spec(self, name: str) -> HostSpec:
+        try:
+            return self._specs[name]
+        except KeyError:
+            raise FleetError(f"unknown host {name!r} (inventory: "
+                             f"{sorted(self._specs)})") from None
+
+    @property
+    def total_devices(self) -> int:
+        return sum(h.devices for h in self._specs.values())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._specs
+
+    def __len__(self) -> int:
+        return len(self._specs)
+
+    # -- liveness ---------------------------------------------------------
+    def mark(self, name: str, state: str) -> None:
+        if state not in HOST_STATES:
+            raise FleetError(f"bad host state {state!r} "
+                             f"(one of {HOST_STATES})")
+        self.spec(name)   # loud on unknown hosts
+        self.state[name] = state
+
+    def placeable(self, name: str) -> bool:
+        return self.state.get(name) == HOST_LIVE
+
+    def lost(self) -> list[str]:
+        return sorted(n for n, s in self.state.items() if s == HOST_LOST)
+
+    # -- serialization (journaled in the "fleet" record) ------------------
+    def to_json(self) -> list[dict]:
+        return [{"name": h.name, "devices": h.devices, "addr": h.addr}
+                for h in self._specs.values()]
+
+    @classmethod
+    def from_json(cls, rows: Iterable[Mapping]) -> "HostPool":
+        return cls(HostSpec(name=str(r["name"]), devices=int(r["devices"]),
+                            addr=str(r.get("addr", "local")))
+                   for r in rows)
+
+    @classmethod
+    def parse(cls, text: str) -> "HostPool":
+        """Inline inventory: ``name=devices[@addr]`` comma-separated."""
+        specs = []
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"bad host entry {part!r} "
+                                 f"(want name=devices[@addr])")
+            name, rest = part.split("=", 1)
+            addr = "local"
+            if "@" in rest:
+                rest, addr = rest.split("@", 1)
+            specs.append(HostSpec(name=name.strip(),
+                                  devices=int(rest), addr=addr.strip()))
+        return cls(specs)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "HostPool":
+        """A path to a JSON inventory file, else the inline form."""
+        if os.path.exists(spec):
+            with open(spec) as f:
+                return cls.from_json(json.load(f))
+        return cls.parse(spec)
+
+    @classmethod
+    def from_env(cls) -> "HostPool | None":
+        from ..utils import knobs
+        spec = knobs.get_str("SPARKNET_FLEET_HOSTS", "")
+        return cls.from_spec(spec) if spec else None
+
+
 class GangAllocator:
     """All-or-nothing slice allocation out of a fixed device budget.
     Slots are fungible integers — on the local rig they are virtual CPU
-    devices, on a pod they would be chip indices of a slice."""
+    devices, on a pod they are chip indices of a slice.  With a
+    ``pool``, slots map onto hosts (consecutive ranges in inventory
+    order), only slots on LIVE hosts are offerable (draining/lost hosts
+    take no new gangs), and allocation packs the fewest hosts that fit —
+    a gang never spans more machines than it must.  Freeing is
+    state-blind: a lost host's slots come back to the free set but stay
+    unplaceable until the host is marked live again."""
 
-    def __init__(self, total: int):
-        if total < 1:
+    def __init__(self, total: int | None = None, *,
+                 pool: HostPool | None = None):
+        self.pool = pool
+        self.slot_host: dict[int, str] = {}
+        if pool is not None:
+            i = 0
+            for h in pool.specs():
+                for _ in range(h.devices):
+                    self.slot_host[i] = h.name
+                    i += 1
+            if total is not None and total != i:
+                raise ValueError(f"total={total} contradicts the pool's "
+                                 f"{i} devices")
+            total = i
+        if total is None or total < 1:
             raise ValueError(f"total devices must be >= 1, got {total}")
         self.total = total
         self._free = set(range(total))
 
+    def _offerable(self) -> set[int]:
+        if self.pool is None:
+            return self._free
+        return {s for s in self._free
+                if self.pool.placeable(self.slot_host[s])}
+
     @property
     def free_count(self) -> int:
-        return len(self._free)
+        return len(self._offerable())
 
-    def allocate(self, n: int) -> tuple[int, ...] | None:
-        """The gang, or None when it does not fit — never a partial."""
-        if n > len(self._free):
+    def allocate(self, n: int,
+                 avoid: Iterable[str] = ()) -> tuple[int, ...] | None:
+        """The gang, or None when it does not fit — never a partial.
+
+        ``avoid`` is SOFT anti-affinity: the named hosts sort last, so a
+        serving replica prefers a host its siblings are not already on
+        (one host loss then kills some replicas, never the whole tier).
+        It never blocks placement — when only avoided hosts have room,
+        the gang still lands there."""
+        free = self._offerable()
+        if n > len(free):
             return None
-        slots = tuple(sorted(self._free)[:n])
+        if self.pool is None:
+            slots = tuple(sorted(free)[:n])
+        else:
+            shun = set(avoid)
+            by_host: dict[str, list[int]] = {}
+            for s in free:
+                by_host.setdefault(self.slot_host[s], []).append(s)
+            chosen: list[int] = []
+            for host in sorted(by_host,
+                               key=lambda h: (h in shun,
+                                              -len(by_host[h]), h)):
+                take = by_host[host][:n - len(chosen)]
+                chosen.extend(take)
+                if len(chosen) == n:
+                    break
+            slots = tuple(sorted(chosen))
         self._free.difference_update(slots)
         return slots
+
+    def hosts_of(self, slots: Iterable[int]) -> tuple[str, ...]:
+        """The (ordered, de-duplicated) hosts a gang spans; empty
+        without a pool."""
+        out: list[str] = []
+        for s in slots:
+            h = self.slot_host.get(s)
+            if h is not None and h not in out:
+                out.append(h)
+        return tuple(out)
+
+    def host_vector(self, slots: Iterable[int]) -> list[str]:
+        """Per-slot host labels in slot order (the launcher's
+        ``host_map`` shape); empty without a pool."""
+        return [self.slot_host[s] for s in slots] if self.slot_host else []
 
     def free(self, slots: Iterable[int]) -> None:
         for s in slots:
@@ -295,6 +484,7 @@ class FleetJob:
         self.submitted_at = submitted_at
         self.state = QUEUED
         self.slots: tuple[int, ...] = ()
+        self.hosts: tuple[str, ...] = ()   # the machines this gang spans
         self.episodes = 0            # launch episodes (fresh runner each)
         self.restarts_used = 0       # cumulative attempts across episodes
         self.preempt_count = 0
@@ -402,7 +592,8 @@ class FleetScheduler:
     inside its ResilientRunner).  ``run()`` loops ``step`` until every
     job is terminal; tests drive ``step()`` directly for determinism."""
 
-    def __init__(self, workdir: str, total_devices: int, *,
+    def __init__(self, workdir: str, total_devices: int | None = None, *,
+                 hosts: HostPool | None = None,
                  tenants: Mapping[str, int] | None = None,
                  aging_rate: float = 1.0 / 60.0,
                  preempt: bool = True,
@@ -417,7 +608,14 @@ class FleetScheduler:
                  _journal: bool = True):
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
-        self.allocator = GangAllocator(total_devices)
+        self.pool = hosts
+        self.allocator = GangAllocator(total_devices, pool=hosts)
+        # operator channel for host state changes from OUTSIDE this
+        # process (tools/fleet.py mark-host, chaos harnesses): appended
+        # JSONL, polled at every step
+        self._host_control_path = os.path.join(self.workdir,
+                                               "host_control.jsonl")
+        self._host_control_pos = 0
         self.tenants = dict(tenants or {})   # tenant -> slot quota
         for t, q in self.tenants.items():
             if q < 1:
@@ -444,8 +642,9 @@ class FleetScheduler:
         self.journal = FleetJournal(
             os.path.join(self.workdir, "fleet_journal.jsonl")) \
             if _journal else None
-        self._journal_ev("fleet", devices=total_devices,
-                         tenants=self.tenants)
+        self._journal_ev("fleet", devices=self.allocator.total,
+                         tenants=self.tenants,
+                         hosts=hosts.to_json() if hosts else None)
 
     # -- journal ----------------------------------------------------------
     def _journal_ev(self, ev: str, **fields) -> None:
@@ -459,7 +658,8 @@ class FleetScheduler:
             f"fleet_{ev}",
             **{k: v for k, v in fields.items()
                if k in ("job", "rc", "reason", "by", "episode",
-                        "preempts", "recovered", "ok", "slots")})
+                        "preempts", "recovered", "ok", "slots",
+                        "host", "state")})
         telemetry.get_registry().counter(
             "fleet_events_total", "fleet scheduler events by kind"
         ).inc(ev=ev)
@@ -526,6 +726,17 @@ class FleetScheduler:
     # -- launch -----------------------------------------------------------
     def _default_runner(self, job: FleetJob, cmd: list[str],
                         env: dict) -> ResilientRunner:
+        # with a pool, the runner knows its placement (one supervised
+        # process per gang on the simulated rig → a 1-entry host_map on
+        # the gang's primary host) and can ask the pool whether a host
+        # is down — the authoritative channel for host-granular budget
+        # accounting (one host death = one budget unit, see resilience)
+        host_kw: dict = {}
+        if job.hosts and self.pool is not None:
+            pool = self.pool
+            host_kw = dict(
+                host_map=[job.hosts[0]],
+                host_down_probe=lambda h: pool.state.get(h) == HOST_LOST)
         return ResilientRunner(
             cmd, nprocs=1, platform=self.platform,
             timeout=job.spec.timeout_s,
@@ -535,7 +746,8 @@ class FleetScheduler:
             workdir=os.path.join(job.job_dir, "runner",
                                  f"ep_{job.episodes:03d}"),
             extra_env=env,
-            on_spawn=lambda procs: self._on_spawn(job, procs))
+            on_spawn=lambda procs: self._on_spawn(job, procs),
+            **host_kw)
 
     def _on_spawn(self, job: FleetJob, procs: list) -> None:
         """Runs on the supervisor thread at every (re)launch: record the
@@ -553,6 +765,7 @@ class FleetScheduler:
 
     def _launch(self, job: FleetJob, slots: tuple[int, ...]) -> None:
         job.slots = slots
+        job.hosts = self.allocator.hosts_of(slots)
         job.state = RUNNING
         job.started_at = self._clock()
         job.preempt_requested = False
@@ -566,6 +779,13 @@ class FleetScheduler:
         env = dict(self.extra_env)
         env.update(job.spec.env)
         env[ENV_JOB_TAG] = job.name
+        if job.hosts:
+            # placement facts ride the env: the gang's primary host tag
+            # plus the full per-slot host vector (informational on the
+            # simulated rig; a real pod launcher consumes the vector)
+            env.setdefault("SPARKNET_FLEET_HOST", job.hosts[0])
+            env.setdefault("SPARKNET_FLEET_HOSTVEC",
+                           ",".join(self.allocator.host_vector(slots)))
         # telemetry: workers snapshot their metrics registry into the
         # job dir (throttled, atomic) so status views can fold them in
         # without a live channel; spec/env overrides win
@@ -575,7 +795,7 @@ class FleetScheduler:
             env["SPARKNET_FAULT"] = job.spec.fault
         job.runner = self.runner_factory(job, cmd, env)
         self._journal_ev("launch", job=job.name, episode=job.episodes,
-                         slots=list(slots), cmd=cmd)
+                         slots=list(slots), hosts=list(job.hosts), cmd=cmd)
         job.thread = threading.Thread(
             target=self._supervise, args=(job, job.runner),
             name=f"fleet-{job.name}", daemon=True)
@@ -673,6 +893,76 @@ class FleetScheduler:
         print(f"fleet: releasing {job.name!r} (drain, then stop)",
               file=sys.stderr, flush=True)
 
+    # -- host lifecycle ---------------------------------------------------
+    def jobs_on_host(self, host: str) -> list[FleetJob]:
+        """Non-terminal jobs whose gang touches ``host``."""
+        return [j for j in self.jobs.values()
+                if host in j.hosts and j.state in (RUNNING, PREEMPTING)]
+
+    def mark_host(self, host: str, state: str, *, by: str = "") -> None:
+        """Change a host's liveness and act on its gangs.  ``draining``
+        (a spot/preemption notice) evicts each gang gracefully — drain
+        fence, SIGTERM→SNAPSHOT_STOP, requeue — while placement stops
+        offering the host's slots.  ``lost`` (the machine is gone) is
+        the abrupt path: every touching gang is killed outright and
+        requeued onto surviving hosts, checkpoint-resumed bit-identical.
+        ``live`` readmits the host's slots to placement."""
+        if self.pool is None:
+            raise FleetError("mark_host needs a HostPool "
+                             "(scheduler built with total_devices only)")
+        self.pool.mark(host, state)   # loud on unknown host / bad state
+        self._journal_ev("host", host=host, state=state, by=by)
+        print(f"fleet: host {host!r} -> {state}"
+              + (f" (by {by})" if by else ""), file=sys.stderr, flush=True)
+        if state == HOST_DRAINING:
+            for job in self.jobs_on_host(host):
+                self.preempt_job(job, by=f"drain:{host}")
+        elif state == HOST_LOST:
+            for job in self.jobs_on_host(host):
+                self._host_lost_stop(job, host)
+
+    def _host_lost_stop(self, job: FleetJob, host: str) -> None:
+        """A machine under ``job`` died.  No drain fence, no SIGTERM
+        grace — a dead host cannot drain, and the launcher's fail-fast
+        would tear the surviving ranks off a dead collective anyway.
+        Kill the whole gang now (on the simulated rig this IS the host
+        kill), requeue at harvest, resume from checkpoint."""
+        if job.state not in (RUNNING, PREEMPTING):
+            return
+        job.preempt_requested = True
+        job.state = PREEMPTING
+        if job.runner is not None:
+            job.runner.cancel()
+        job.drain_deadline = None
+        job.preempt_deadline = self._clock()   # escalation owes no grace
+        self._signal_job(job, signal.SIGKILL, only_new=False)
+        self._journal_ev("host_kill", job=job.name, host=host)
+
+    def _poll_host_control(self) -> None:
+        """Apply host state changes appended to ``host_control.jsonl``
+        by OTHER processes (tools/fleet.py mark-host, chaos harnesses).
+        Torn trailing lines are retried next step, bad records are loud
+        but not fatal."""
+        if self.pool is None:
+            return
+        try:
+            with open(self._host_control_path, "rb") as f:
+                f.seek(self._host_control_pos)
+                chunk = f.read()
+        except OSError:
+            return
+        for line in chunk.splitlines(keepends=True):
+            if not line.endswith(b"\n"):
+                break   # torn append: re-read once the writer finishes
+            self._host_control_pos += len(line)
+            try:
+                rec = json.loads(line)
+                self.mark_host(str(rec["host"]), str(rec["state"]),
+                               by=str(rec.get("by", "control")))
+            except (ValueError, KeyError, FleetError) as e:
+                print(f"fleet: bad host-control record {line!r}: {e}",
+                      file=sys.stderr, flush=True)
+
     def _escalate_preemptions(self) -> None:
         now = self._clock()
         for job in self.jobs.values():
@@ -745,6 +1035,7 @@ class FleetScheduler:
             if job.slots:
                 self.allocator.free(job.slots)
                 job.slots = ()
+            job.hosts = ()
             job.procs = []
             self._journal_ev("exit", job=job.name, rc=rc,
                              episode=job.episodes)
@@ -828,15 +1119,31 @@ class FleetScheduler:
         for job in queued:
             if not self._quota_ok(job):
                 continue
-            slots = self.allocator.allocate(job.spec.world)
+            slots = self.allocator.allocate(
+                job.spec.world, avoid=self._replica_hosts(job))
             if slots is None:
                 continue   # backfill: smaller jobs behind may still fit
             self._launch(job, slots)
 
+    def _replica_hosts(self, job: FleetJob) -> set[str]:
+        """Hosts already carrying a live replica of the same served
+        model — serve gangs prefer a fresh host (soft anti-affinity in
+        :meth:`GangAllocator.allocate`) so one host loss never takes
+        every replica of a model at once."""
+        if self.pool is None or job.spec.kind != "serve":
+            return set()
+        return {h for j in self.jobs.values()
+                if j is not job and j.spec.kind == "serve"
+                and j.spec.model == job.spec.model
+                and j.state not in TERMINAL
+                for h in j.hosts}
+
     # -- the loop ---------------------------------------------------------
     def step(self) -> None:
-        """One scheduling pass: harvest exits, escalate overdue
-        preemptions, decide at most one new preemption, place."""
+        """One scheduling pass: apply external host state changes,
+        harvest exits, escalate overdue preemptions, decide at most one
+        new preemption, place."""
+        self._poll_host_control()
         self._harvest()
         self._escalate_preemptions()
         self._maybe_preempt()
@@ -945,6 +1252,7 @@ class FleetScheduler:
                 "round": (job.spec.rounds if job.state == COMPLETED
                           else round_done),
                 "rounds_target": job.spec.rounds,
+                "hosts": list(job.hosts),
                 "heartbeats": self._heartbeats(job),
                 "metrics": metrics,
                 "metrics_note": metrics_note(metrics),
@@ -956,6 +1264,8 @@ class FleetScheduler:
         out = {"devices": {"total": self.allocator.total,
                            "free": self.allocator.free_count},
                "tenants": by_tenant, "jobs": jobs}
+        if self.pool is not None:
+            out["hosts"] = hosts_view(self.pool, jobs)
         serving = serving_status(self.workdir, jobs)
         if serving:
             out["serving"] = serving
@@ -978,6 +1288,8 @@ class FleetScheduler:
             raise FleetError(f"no journal to resume at {path}")
         devices = None
         tenants: dict[str, int] = {}
+        pool: HostPool | None = None
+        host_states: dict[str, str] = {}
         specs: dict[str, JobSpec] = {}
         terminal: dict[str, str] = {}
         pids: dict[str, set[int]] = {}
@@ -988,6 +1300,10 @@ class FleetScheduler:
             if kind == "fleet":
                 devices = ev.get("devices", devices)
                 tenants = dict(ev.get("tenants") or {})
+                if ev.get("hosts"):
+                    pool = HostPool.from_json(ev["hosts"])
+            elif kind == "host":
+                host_states[ev.get("host")] = ev.get("state")
             elif kind == "submit":
                 specs[name] = JobSpec.from_json(ev["spec"])
                 counters.setdefault(name, {"episodes": 0, "preempts": 0,
@@ -1012,7 +1328,23 @@ class FleetScheduler:
         if devices is None:
             raise FleetError(f"journal at {path} has no fleet record")
         kwargs.setdefault("tenants", tenants)
-        sched = cls(workdir, devices, **kwargs)
+        if pool is not None and "hosts" not in kwargs:
+            # re-apply the journaled host states so a host that was
+            # draining/lost when the scheduler died stays unplaceable
+            for host, st in host_states.items():
+                if host in pool and st in HOST_STATES:
+                    pool.mark(host, st)
+            kwargs["hosts"] = pool
+        sched = cls(workdir, devices if kwargs.get("hosts") is None
+                    else None, **kwargs)
+        try:
+            # host-control records from before the death are already
+            # reflected in the journaled host states replayed above —
+            # re-applying them would re-fire their side effects
+            sched._host_control_pos = os.path.getsize(
+                sched._host_control_path)
+        except OSError:
+            pass
         for name, spec in specs.items():
             # reap survivors of the dead scheduler FIRST: resuming the
             # job while its old gang still trains is the double-launch
@@ -1064,6 +1396,51 @@ class FleetScheduler:
                     os.kill(p, signal.SIGKILL)
                 except OSError:
                     pass
+
+
+def hosts_view(pool: HostPool, jobs: list[dict]) -> dict[str, dict]:
+    """The hosts section of a status view: per-host liveness state,
+    device budget/usage, and which gangs sit on it — computed the same
+    way live and offline (slot→host is deterministic: consecutive
+    ranges in inventory order)."""
+    slot_host: dict[int, str] = {}
+    i = 0
+    for h in pool.specs():
+        for _ in range(h.devices):
+            slot_host[i] = h.name
+            i += 1
+    out: dict[str, dict] = {}
+    for h in pool.specs():
+        out[h.name] = {"state": pool.state.get(h.name, HOST_LIVE),
+                       "addr": h.addr, "devices": h.devices,
+                       "used": 0, "gangs": []}
+    for j in jobs:
+        for s in j.get("slots") or []:
+            host = slot_host.get(s)
+            if host is not None:
+                out[host]["used"] += 1
+        for host in j.get("hosts") or []:
+            if host in out and j["job"] not in out[host]["gangs"]:
+                out[host]["gangs"].append(j["job"])
+    return out
+
+
+def request_mark_host(workdir: str, host: str, state: str,
+                      by: str = "") -> None:
+    """Ask the (possibly remote, possibly separate-process) scheduler
+    owning ``workdir`` to mark ``host`` — appended to the host-control
+    channel it polls every step.  Validation of the host NAME happens at
+    apply time (the scheduler owns the inventory); the state is checked
+    here so a typo fails at the operator's prompt, not in the log."""
+    if state not in HOST_STATES:
+        raise FleetError(f"bad host state {state!r} (one of {HOST_STATES})")
+    path = os.path.join(os.path.abspath(workdir), "host_control.jsonl")
+    rec = {"host": host, "state": state, "by": by,
+           "t": round(time.time(), 3)}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def job_metrics(job_dir: str) -> dict[str, Any]:
@@ -1122,12 +1499,13 @@ def offline_status(workdir: str) -> dict[str, Any]:
         raise FleetError(f"no journal to read at {path}")
     devices = 0
     tenants: dict[str, int] = {}
+    pool: HostPool | None = None
     order: list[str] = []
     specs: dict[str, JobSpec] = {}
     state: dict[str, str] = {}
     slots: dict[str, list[int]] = {}
+    job_hosts: dict[str, list[str]] = {}
     counters: dict[str, dict[str, int]] = {}
-    runner_dirs: dict[str, str] = {}
     for ev in events:
         kind = ev.get("ev")
         name = ev.get("job")
@@ -1136,6 +1514,12 @@ def offline_status(workdir: str) -> dict[str, Any]:
         if kind == "fleet":
             devices = ev.get("devices", devices)
             tenants = dict(ev.get("tenants") or {})
+            if ev.get("hosts"):
+                pool = HostPool.from_json(ev["hosts"])
+        elif kind == "host":
+            if pool is not None and ev.get("host") in pool \
+                    and ev.get("state") in HOST_STATES:
+                pool.mark(ev["host"], ev["state"])
         elif kind == "submit":
             specs[name] = JobSpec.from_json(ev["spec"])
             order.append(name)
@@ -1143,6 +1527,7 @@ def offline_status(workdir: str) -> dict[str, Any]:
         elif kind == "launch":
             state[name] = RUNNING
             slots[name] = list(ev.get("slots", []))
+            job_hosts[name] = list(ev.get("hosts") or [])
             c["episodes"] = ev.get("episode", c["episodes"] + 1)
         elif kind == "pids":
             c["attempts"] += 1
@@ -1156,17 +1541,21 @@ def offline_status(workdir: str) -> dict[str, Any]:
         elif kind == "requeue":
             state[name] = QUEUED
             slots.pop(name, None)
+            job_hosts.pop(name, None)
             c["preempts"] = ev.get("preempts", c["preempts"] + 1)
         elif kind == "exit":
             if state.get(name) not in TERMINAL:
                 state[name] = "EXITED"
             slots.pop(name, None)
+            job_hosts.pop(name, None)
         elif kind == "complete":
             state[name] = COMPLETED
             slots.pop(name, None)
+            job_hosts.pop(name, None)
         elif kind == "quarantine":
             state[name] = QUARANTINED
             slots.pop(name, None)
+            job_hosts.pop(name, None)
         elif kind == "recover":
             state[name] = QUEUED
     jobs = []
@@ -1181,6 +1570,8 @@ def offline_status(workdir: str) -> dict[str, Any]:
             st = COMPLETED   # finished after the journal's last word
         job_slots = slots.get(name, []) if st in (RUNNING,
                                                   PREEMPTING) else []
+        host_list = (job_hosts.get(name, []) if st in (RUNNING, PREEMPTING)
+                     else [])
         if job_slots:
             free -= len(job_slots)
             used_by_tenant[spec.tenant] = (
@@ -1210,6 +1601,7 @@ def offline_status(workdir: str) -> dict[str, Any]:
             "round": (spec.rounds if st == COMPLETED
                       else probe.newest_round()),
             "rounds_target": spec.rounds,
+            "hosts": host_list,
             "heartbeats": beats,
             "metrics": metrics,
             "metrics_note": metrics_note(metrics),
@@ -1220,6 +1612,8 @@ def offline_status(workdir: str) -> dict[str, Any]:
                                  set(tenants))}
     out = {"devices": {"total": devices, "free": max(free, 0)},
            "tenants": by_tenant, "jobs": jobs}
+    if pool is not None:
+        out["hosts"] = hosts_view(pool, jobs)
     serving = serving_status(os.path.abspath(workdir), jobs)
     if serving:
         out["serving"] = serving
@@ -1295,6 +1689,11 @@ def format_status(status: Mapping[str, Any]) -> str:
             f"{j['priority']:>5} {j['eff_priority']:>6.1f} "
             f"{j['world']:>4} {rnd:>3}/{j['rounds_target']:<3} "
             f"{j['episodes']:>3} {j['preempts']:>3}  {hb}")
+    for hname, h in (status.get("hosts") or {}).items():
+        gangs = ",".join(h.get("gangs") or []) or "-"
+        lines.append(f"host:    {hname:<16} {h.get('state', '?'):<9} "
+                     f"{h.get('used', 0)}/{h.get('devices', 0)} devices "
+                     f"@{h.get('addr', '?')} gangs={gangs}")
     serving = status.get("serving") or {}
     auto = (serving.get("autoscale") or {}).get("models") or {}
     for model, m in sorted((serving.get("models") or {}).items()):

@@ -26,6 +26,15 @@ Env contract (set by the launcher, consumed by ``maybe_beat``):
   SPARKNET_HEARTBEAT_DIR — where to publish; absent = beacons off.
   SPARKNET_PROC_ID       — the rank stamped into the beat.
   SPARKNET_FAULT_ATTEMPT — the job attempt stamped into the beat.
+
+Multi-host layout: a gang placed across hosts beats into per-host
+subdirectories ``host_<name>/`` of the shared beacon root (the launcher
+points each rank's SPARKNET_HEARTBEAT_DIR at its host's subdir — see
+``tools.launch`` ``host_map``).  ``read_all`` folds the per-host dirs
+back into one rank view (ranks are globally numbered, so there are no
+collisions), ``read_hosts`` keeps the host grouping, and
+``rollup_hosts`` reduces it to the per-host liveness summary the fleet
+status views render.
 """
 
 from __future__ import annotations
@@ -39,6 +48,7 @@ from typing import Callable
 from ..utils import knobs
 
 HB_PREFIX = "hb_rank_"
+HOST_DIR_PREFIX = "host_"
 ENV_DIR = "SPARKNET_HEARTBEAT_DIR"
 
 
@@ -100,7 +110,7 @@ def read_beat(directory: str, rank: int) -> Heartbeat | None:
         return None
 
 
-def read_all(directory: str) -> dict[int, Heartbeat]:
+def _read_flat(directory: str) -> dict[int, Heartbeat]:
     beats: dict[int, Heartbeat] = {}
     try:
         names = os.listdir(directory)
@@ -117,6 +127,78 @@ def read_all(directory: str) -> dict[int, Heartbeat]:
         if beat is not None:
             beats[rank] = beat
     return beats
+
+
+def host_dir(root: str, host: str) -> str:
+    """The per-host beacon subdirectory for ``host`` under ``root``."""
+    return os.path.join(root, f"{HOST_DIR_PREFIX}{host}")
+
+
+def read_all(directory: str) -> dict[int, Heartbeat]:
+    """Every rank's newest beat under ``directory`` — flat beats plus any
+    ``host_<name>/`` subdirectories a multi-host launch created.  Ranks
+    are globally numbered across hosts, so folding is collision-free."""
+    beats = _read_flat(directory)
+    for hdir in host_beat_dirs(directory).values():
+        beats.update(_read_flat(hdir))
+    return beats
+
+
+def host_beat_dirs(root: str) -> dict[str, str]:
+    """host name -> its beacon subdirectory (only dirs that exist)."""
+    out: dict[str, str] = {}
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not name.startswith(HOST_DIR_PREFIX):
+            continue
+        path = os.path.join(root, name)
+        if os.path.isdir(path):
+            out[name[len(HOST_DIR_PREFIX):]] = path
+    return out
+
+
+def read_hosts(root: str) -> dict[str | None, dict[int, Heartbeat]]:
+    """Beats grouped by host (the ``host_<name>/`` layout).  Flat beats —
+    a single-host launch, or pre-pod attempts — land under the ``None``
+    key; callers render that as the local/unplaced group."""
+    out: dict[str | None, dict[int, Heartbeat]] = {}
+    flat = _read_flat(root)
+    if flat:
+        out[None] = flat
+    for host, hdir in host_beat_dirs(root).items():
+        beats = _read_flat(hdir)
+        if beats:
+            out[host] = beats
+    return out
+
+
+def rollup_hosts(root: str, *, deadline_s: float | None = None,
+                 now: float | None = None) -> dict[str, dict]:
+    """Per-host liveness summary over the beacon tree: rank count, the
+    newest/oldest beat ages, the round span, and — when ``deadline_s``
+    is given — a ``silent`` verdict (every rank's beat is older than the
+    deadline).  The fleet status views fold this per attempt; a host
+    with no beats simply has no row (absence of evidence is not a
+    verdict here — the HostPool's marked state is the authority)."""
+    now = time.time() if now is None else now
+    out: dict[str, dict] = {}
+    for host, beats in read_hosts(root).items():
+        ages = [b.age(now) for b in beats.values()]
+        rounds = [b.round for b in beats.values()]
+        entry: dict = {
+            "ranks": sorted(beats),
+            "newest_age_s": round(min(ages), 2),
+            "oldest_age_s": round(max(ages), 2),
+            "round_min": min(rounds),
+            "round_max": max(rounds),
+        }
+        if deadline_s is not None:
+            entry["silent"] = min(ages) > deadline_s
+        out["local" if host is None else host] = entry
+    return out
 
 
 def maybe_beat(round_idx: int, phase: str = "round_start",

@@ -34,6 +34,19 @@ mode renumbers ranks 0..n-1 after a drop — ranks are fungible slots; in
 ssh mode the *host* is what is dropped, which is the real-world
 semantics.
 
+**Host-granular attribution** — on a pod, the failure unit is the
+*host*: all R ranks placed on a preempted machine expire together, and
+charging R separate budget units (or R successive one-rank re-forms) for
+one event would exhaust the budget on a single host loss.  With a
+``host_map`` (one host label per rank — the fleet's placement channel)
+the runner attributes whole-host death two ways: a ``host_down_probe``
+callback (the HostPool's marked state — authoritative, costs ONE failed
+attempt) or, absent a probe, two distinct failed ranks on the same
+multi-rank host within one incarnation (exit codes alone can't tell a
+host-killed rank from a launcher-killed survivor — both die -9).  A
+host event drops ALL the host's ranks in one re-form and the whole host
+rejoins in bulk when its probe recovers.
+
 Every (re)launch is stamped with SPARKNET_FAULT_ATTEMPT /
 SPARKNET_RESTART_COUNT (global attempt counter, so one-shot injected
 faults stay one-shot across re-forms) plus SPARKNET_INCARNATION in the
@@ -169,6 +182,8 @@ class ResilientRunner:
                  extra_env: dict | None = None,
                  sleep: Callable[[float], None] = time.sleep,
                  jitter_rng: random.Random | None = None,
+                 host_map: list | None = None,
+                 host_down_probe: Callable[[str], bool] | None = None,
                  on_spawn: Callable[[list], None] | None = None):
         if (nprocs is None) == (hosts is None):
             raise ValueError("exactly one of nprocs / hosts is required")
@@ -192,7 +207,17 @@ class ResilientRunner:
         self.canceled = False
         self.incarnation = 0
         self.dropped: list[int | str] = []   # host names (ssh) / slots
+        self.dropped_hosts: list[str] = []   # whole hosts out of the world
         self._drop_counts: dict[int | str, int] = {}
+        self._host_members: dict[str, dict] = {}   # for bulk rejoin
+        self._pending_host_drop: str | None = None
+        self.host_map = [str(h) for h in host_map] if host_map else None
+        self.host_down_probe = host_down_probe
+        if self.host_map is not None and len(self.host_map) != \
+                self.world_size():
+            raise ValueError(
+                f"host_map has {len(self.host_map)} entries for a world "
+                f"of {self.world_size()}")
         self.failure: ResilienceError | None = None
         if self.elastic.min_workers < 1:
             raise ValueError(
@@ -209,15 +234,48 @@ class ResilientRunner:
         else:
             self.nprocs -= 1
             slot = self.nprocs          # local slots are fungible
+        if self.host_map is not None:
+            self.host_map.pop(culprit_rank)
         self.dropped.append(slot)
         self._drop_counts[slot] = self._drop_counts.get(slot, 0) + 1
         return slot
 
+    def _drop_host(self, host: str) -> int:
+        """Remove EVERY rank placed on ``host`` in one re-form; returns
+        how many ranks left the world.  One host death is one membership
+        event: it costs one drop-count strike (for the rejoin guard), not
+        one per rank."""
+        idxs = [i for i, h in enumerate(self.host_map) if h == host]
+        members: dict = {"n": len(idxs)}
+        if self.hosts is not None:
+            members["addrs"] = [self.hosts[i] for i in idxs]
+        for i in reversed(idxs):
+            if self.hosts is not None:
+                self.hosts.pop(i)
+            self.host_map.pop(i)
+        if self.hosts is None:
+            self.nprocs -= len(idxs)
+        self._host_members[host] = members
+        self.dropped_hosts.append(host)
+        self._drop_counts[host] = self._drop_counts.get(host, 0) + 1
+        return len(idxs)
+
+    def _rejoin_one(self, slot) -> bool:
+        """Probe ``slot`` (a rank slot or a host label); True = readmit."""
+        try:
+            return bool(self.rejoin_probe(slot))
+        except Exception as e:   # a probe that dies means "not yet"
+            print(f"resilience: rejoin probe for {slot!r} failed: {e}",
+                  file=sys.stderr, flush=True)
+            return False
+
     def _maybe_rejoin(self) -> None:
-        """Re-admit dropped slots whose probe passes — the relaunch
+        """Re-admit dropped slots/hosts whose probe passes — the relaunch
         boundary is the only membership boundary an SPMD job has, so a
-        recovered host rejoins here, at the next incarnation."""
-        if self.rejoin_probe is None or not self.dropped:
+        recovered host rejoins here, at the next incarnation.  A host
+        dropped whole (``_drop_host``) rejoins whole: all its ranks come
+        back in one membership change."""
+        if self.rejoin_probe is None:
             return
         still_out = []
         for slot in self.dropped:
@@ -227,22 +285,37 @@ class ResilientRunner:
                 # host must not livelock the drop/rejoin cycle
                 still_out.append(slot)
                 continue
-            ok = False
-            try:
-                ok = bool(self.rejoin_probe(slot))
-            except Exception as e:   # a probe that dies means "not yet"
-                print(f"resilience: rejoin probe for {slot!r} failed: {e}",
-                      file=sys.stderr, flush=True)
-            if ok:
+            if self._rejoin_one(slot):
                 print(f"resilience: {slot!r} rejoins the job",
                       file=sys.stderr, flush=True)
                 if self.hosts is not None:
                     self.hosts.append(str(slot))
                 else:
                     self.nprocs += 1
+                if self.host_map is not None:
+                    self.host_map.append(str(slot))
             else:
                 still_out.append(slot)
         self.dropped = still_out
+        still_out_hosts = []
+        for host in self.dropped_hosts:
+            if self._drop_counts.get(host, 0) >= 2:
+                still_out_hosts.append(host)
+                continue
+            if self._rejoin_one(host):
+                members = self._host_members.get(host, {"n": 1})
+                if self.hosts is not None:
+                    addrs = members.get("addrs") or [host]
+                    self.hosts.extend(addrs)
+                    self.host_map.extend([host] * len(addrs))
+                else:
+                    self.nprocs += members["n"]
+                    self.host_map.extend([host] * members["n"])
+                print(f"resilience: host {host!r} rejoins with "
+                      f"{members['n']} rank(s)", file=sys.stderr, flush=True)
+            else:
+                still_out_hosts.append(host)
+        self.dropped_hosts = still_out_hosts
 
     # -- one attempt ------------------------------------------------------
     def _attempt_dir(self, attempt: int) -> str:
@@ -261,11 +334,14 @@ class ResilientRunner:
             round_deadline=self.round_deadline,
             log_dir=os.path.join(adir, "logs"),
             report=report,
+            host_map=list(self.host_map) if self.host_map else None,
             on_spawn=self.on_spawn)
         if self.hosts is not None:
             return launch_ssh(self.cmd, self.hosts,
                               coordinator_port=free_port(),
                               cwd=self.cwd, timeout=self.timeout,
+                              platform=self.platform,
+                              devices_per_proc=self.devices_per_proc,
                               extra_env=env, **health_kw)
         return launch_local(self.cmd, self.nprocs, platform=self.platform,
                             devices_per_proc=self.devices_per_proc,
@@ -321,6 +397,39 @@ class ResilientRunner:
             return None
         return collections.Counter(ranks).most_common(1)[0][0]
 
+    def _down_host(self, report: dict) -> str | None:
+        """Host attribution for the attempt that just failed.  Primary
+        channel: ``host_down_probe`` confirms the first-failing rank's
+        host is down (the HostPool's marked state — authoritative after a
+        single failed attempt).  Secondary, probe-less heuristic: two
+        DISTINCT failed ranks in this incarnation on the same multi-rank
+        host — exit codes can't separate a host-killed rank from a
+        launcher-killed survivor (both -9), but two different first
+        deaths on one host can't be a single bad rank."""
+        if self.host_map is None:
+            return None
+        ff = report.get("first_failure")
+        if (ff is not None and ff < len(self.host_map)
+                and self.host_down_probe is not None):
+            host = self.host_map[ff]
+            try:
+                if self.host_down_probe(host):
+                    return host
+            except Exception as e:   # a dead probe means "no verdict"
+                print(f"resilience: host_down_probe({host!r}) failed: {e}",
+                      file=sys.stderr, flush=True)
+        ranks = {a.first_failure for a in self.attempts
+                 if a.incarnation == self.incarnation
+                 and a.first_failure is not None}
+        if len(ranks) >= 2:
+            hosts = {self.host_map[r] for r in ranks
+                     if r < len(self.host_map)}
+            if len(hosts) == 1:
+                host = hosts.pop()
+                if sum(1 for h in self.host_map if h == host) >= 2:
+                    return host
+        return None
+
     # -- cancellation (fleet preemption) ----------------------------------
     def cancel(self) -> None:
         """Stop supervising: no further restarts or re-forms after the
@@ -367,6 +476,18 @@ class ResilientRunner:
                 return 0
             if self.canceled:
                 return rc
+            host = self._down_host(report)
+            if host is not None:
+                # the whole host died — burning the rest of this
+                # incarnation's budget re-dialing a dead machine is waste
+                # (and charging R ranks R units for one event is the
+                # budget bug this guards): hand straight to run() for one
+                # host-granular re-form
+                self._pending_host_drop = host
+                print(f"resilience: host {host!r} is down (attempt "
+                      f"{attempt + 1}); skipping remaining restarts for a "
+                      f"host-granular re-form", file=sys.stderr, flush=True)
+                return rc
             if rc == EXIT_STRAGGLER:
                 print(f"resilience: rank "
                       f"{report.get('first_failure', '?')} missed the "
@@ -394,6 +515,23 @@ class ResilientRunner:
                 # preempted, not failed: no post-mortem, no re-form — the
                 # canceling supervisor decides what happens to the job
                 return rc
+            host = self._pending_host_drop
+            self._pending_host_drop = None
+            if host is not None and self.elastic.enabled:
+                n = sum(1 for h in (self.host_map or []) if h == host)
+                if n and self.world_size() - n >= self.elastic.min_workers:
+                    self._drop_host(host)
+                    self.incarnation += 1
+                    telemetry.get_recorder().record(
+                        "reform", dropped=host, host=True, ranks=n,
+                        world=self.world_size(),
+                        incarnation=self.incarnation)
+                    print(f"resilience: dropping host {host!r} ({n} "
+                          f"rank(s)) in ONE re-form; continuing with "
+                          f"{self.world_size()} survivors (incarnation "
+                          f"{self.incarnation})", file=sys.stderr,
+                          flush=True)
+                    continue
             culprit = self._culprit()
             survivors = self.world_size() - 1
             if (self.elastic.enabled and culprit is not None

@@ -734,7 +734,8 @@ class ServingFleet:
         RUNNING replicas join (or re-join at a fresh URL after a
         restart), jobs that left RUNNING are pruned, and the state
         snapshots for offline status views are refreshed."""
-        from .fleet import PREEMPTING, RUNNING
+        from .fleet import HOST_LOST, PREEMPTING, RUNNING
+        pool = getattr(self.sched, "pool", None)
         for name in list(self._model_of):
             job = self.sched.jobs.get(name)
             if job is None:
@@ -755,7 +756,16 @@ class ServingFleet:
                     self.router.add_replica(name, client)
                     self._endpoints[name] = ep["url"]
             elif job.state == PREEMPTING:
-                pass                 # drain hook owns the fence
+                # drain hook owns the fence — EXCEPT when the replica's
+                # machine is LOST: a dead host cannot drain, so this is
+                # bulk replica death.  Unroute it NOW; in-flight work
+                # takes the typed bounded failover to survivors and the
+                # scheduler requeues the replica onto a live host.
+                if registered and pool is not None and any(
+                        pool.state.get(h) == HOST_LOST
+                        for h in getattr(job, "hosts", ())):
+                    self.router.mark_dead(name, "host lost")
+                    self._endpoints.pop(name, None)
             elif registered:
                 # the job died / finished out from under the router
                 self.router.mark_dead(name, f"job state {job.state}")

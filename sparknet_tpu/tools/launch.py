@@ -48,6 +48,10 @@ import time
 
 EXIT_STRAGGLER = 125   # a rank was killed for missing the round deadline
 
+# ssh-mode addresses that mean "spawn here, not over ssh" — the
+# simulated N-host pod rig runs every 'host' on one CPU box with these
+LOCAL_ADDRS = ("local", "localhost", "127.0.0.1")
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -168,6 +172,25 @@ def _make_monitor(heartbeat_dir: str | None, round_deadline: float | None):
     return StragglerMonitor(heartbeat_dir, round_deadline)
 
 
+def _rank_hb_dir(heartbeat_dir: str | None,
+                 host_map: list | None, rank: int) -> str | None:
+    """Rank ``rank``'s beacon dir: the per-host ``host_<name>/`` subdir
+    when a host placement is given (so supervisors can roll liveness up
+    per host — health.read_hosts), else the flat root."""
+    if not heartbeat_dir:
+        return None
+    if not host_map:
+        return heartbeat_dir
+    from ..parallel.health import host_dir
+    return host_dir(heartbeat_dir, str(host_map[rank]))
+
+
+def _check_host_map(host_map: list | None, n: int) -> None:
+    if host_map is not None and len(host_map) != n:
+        raise ValueError(f"host_map has {len(host_map)} entries for "
+                         f"{n} ranks — one host label per rank required")
+
+
 def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
                  devices_per_proc: int | None = None,
                  coordinator: str | None = None,
@@ -177,6 +200,7 @@ def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
                  round_deadline: float | None = None,
                  log_dir: str | None = None,
                  report: dict | None = None,
+                 host_map: list | None = None,
                  on_spawn=None) -> int:
     """Spawn ``nprocs`` copies of ``cmd`` locally; returns the first
     non-zero exit code, else 0.  Output is streamed with [p<i>] prefixes.
@@ -184,10 +208,14 @@ def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
     (see ``_wait_all``).  ``extra_env`` adds per-job vars to every child
     (the ResilientRunner's attempt-stamping channel); ``heartbeat_dir`` /
     ``round_deadline`` / ``log_dir`` / ``report`` are the health plane
-    (module docstring).  ``on_spawn`` (if given) receives the list of
+    (module docstring).  ``host_map`` (one host label per rank) stamps
+    SPARKNET_FLEET_HOST on each child and routes its beacons into the
+    per-host ``host_<name>/`` subdir — the simulated-pod rig's placement
+    channel.  ``on_spawn`` (if given) receives the list of
     ``subprocess.Popen`` handles once the full gang is up — an external
     supervisor's only safe channel to the worker pids (for preemption
     signals and orphan accounting; see ``parallel.fleet``)."""
+    _check_host_map(host_map, nprocs)
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
     monitor = _make_monitor(heartbeat_dir, round_deadline)
     if log_dir:
@@ -197,8 +225,11 @@ def launch_local(cmd: list[str], nprocs: int, *, platform: str | None = None,
     for pid in range(nprocs):
         env = _proc_env(os.environ, coordinator, nprocs, pid, platform,
                         devices_per_proc, extra_env)
-        if heartbeat_dir:
-            env["SPARKNET_HEARTBEAT_DIR"] = heartbeat_dir
+        hb = _rank_hb_dir(heartbeat_dir, host_map, pid)
+        if hb:
+            env["SPARKNET_HEARTBEAT_DIR"] = hb
+        if host_map:
+            env["SPARKNET_FLEET_HOST"] = str(host_map[pid])
         p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
         log = os.path.join(log_dir, f"rank_{pid}.log") if log_dir else None
@@ -224,15 +255,30 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
                round_deadline: float | None = None,
                log_dir: str | None = None,
                report: dict | None = None,
+               platform: str | None = None,
+               devices_per_proc: int | None = None,
+               host_map: list | None = None,
                on_spawn=None) -> int:
     """Run ``cmd`` on every host via ssh; host 0 doubles as coordinator.
     The health plane (``heartbeat_dir``/``round_deadline``) requires the
     dir to be on a filesystem shared with the supervisor — the same
-    assumption the checkpoint dir makes.  ``on_spawn`` receives the local
-    ssh ``Popen`` handles (signalling one ends its remote command via the
-    ssh session, so preemption still works, host by host)."""
-    port = coordinator_port or 9876
-    coordinator = f"{hosts[0]}:{port}"
+    assumption the checkpoint dir makes.  Addresses in ``LOCAL_ADDRS``
+    are spawned directly (no ssh wrapping) with the same env contract —
+    that is the simulated N-host pod rig: a HostPool whose entries all
+    say ``local`` exercises every cross-host path on one CPU box.
+    ``platform``/``devices_per_proc`` apply to those local spawns (remote
+    hosts see their chips natively).  ``host_map`` gives each rank its
+    host *label* (defaults to its address) for beacon routing and the
+    SPARKNET_FLEET_HOST tag.  ``on_spawn`` receives the local ``Popen``
+    handles (signalling an ssh one ends its remote command via the ssh
+    session, so preemption still works, host by host)."""
+    _check_host_map(host_map, len(hosts))
+    if host_map is None:
+        host_map = [str(h) for h in hosts]
+    all_local = all(h in LOCAL_ADDRS for h in hosts)
+    port = coordinator_port or (free_port() if all_local else 9876)
+    addr0 = "127.0.0.1" if hosts[0] in LOCAL_ADDRS else hosts[0]
+    coordinator = f"{addr0}:{port}"
     cwd = cwd or os.getcwd()
     monitor = _make_monitor(heartbeat_dir, round_deadline)
     if log_dir:
@@ -240,22 +286,36 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
     procs = []
     threads = []
     for pid, host in enumerate(hosts):
-        pairs = [
-            ("SPARKNET_COORDINATOR", coordinator),
-            ("SPARKNET_NUM_PROCS", str(len(hosts))),
-            ("SPARKNET_PROC_ID", str(pid)),
-        ]
-        if heartbeat_dir:
-            pairs.append(("SPARKNET_HEARTBEAT_DIR", heartbeat_dir))
-        if extra_env:
-            pairs.extend((k, str(v)) for k, v in extra_env.items())
-        envs = " ".join(f"{k}={v!r}" for k, v in pairs)
-        remote = f"cd {cwd} && env {envs} " + " ".join(cmd)
-        p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host, remote],
-                             stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT)
+        hb = _rank_hb_dir(heartbeat_dir, host_map, pid)
+        if host in LOCAL_ADDRS:
+            env = _proc_env(os.environ, coordinator, len(hosts), pid,
+                            platform, devices_per_proc, extra_env)
+            if hb:
+                os.makedirs(hb, exist_ok=True)
+                env["SPARKNET_HEARTBEAT_DIR"] = hb
+            env["SPARKNET_FLEET_HOST"] = str(host_map[pid])
+            p = subprocess.Popen(cmd, env=env, cwd=cwd,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+        else:
+            pairs = [
+                ("SPARKNET_COORDINATOR", coordinator),
+                ("SPARKNET_NUM_PROCS", str(len(hosts))),
+                ("SPARKNET_PROC_ID", str(pid)),
+                ("SPARKNET_FLEET_HOST", str(host_map[pid])),
+            ]
+            if hb:
+                pairs.append(("SPARKNET_HEARTBEAT_DIR", hb))
+            if extra_env:
+                pairs.extend((k, str(v)) for k, v in extra_env.items())
+            envs = " ".join(f"{k}={v!r}" for k, v in pairs)
+            remote = f"cd {cwd} && env {envs} " + " ".join(cmd)
+            p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host, remote],
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
         log = os.path.join(log_dir, f"rank_{pid}.log") if log_dir else None
-        t = threading.Thread(target=_stream, args=(host, p.stdout, log),
+        tag = host_map[pid] if host in LOCAL_ADDRS else host
+        t = threading.Thread(target=_stream, args=(tag, p.stdout, log),
                              daemon=True)
         t.start()
         procs.append(p)

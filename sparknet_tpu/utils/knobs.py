@@ -281,6 +281,20 @@ _register(
          "Fleet job tag exported to tenant processes; joins their "
          "telemetry to the scheduler's story.",
          "sparknet_tpu/parallel/fleet.py"),
+    Knob("SPARKNET_FLEET_HOSTS", "spec", "",
+         "Host inventory for multi-host placement: "
+         "'name=devices[@addr],...' inline or a path to a JSON list of "
+         "{name, devices, addr}; unset = single-host device budget.",
+         "sparknet_tpu/parallel/fleet.py"),
+    Knob("SPARKNET_FLEET_HOST", "str", "",
+         "Host label the launcher stamps on each worker (the gang's "
+         "primary host for fleet tenants); joins per-host telemetry "
+         "and heartbeats to the placement story.",
+         "sparknet_tpu/tools/launch.py"),
+    Knob("SPARKNET_FLEET_HOSTVEC", "str", "",
+         "Comma-separated per-slot host labels of the gang's placement, "
+         "exported to fleet tenant processes.",
+         "sparknet_tpu/parallel/fleet.py"),
     # --- data plane ---
     Knob("SPARKNET_QUARANTINE_FRACTION", "float", "0",
          "Max fraction of an epoch the decode quarantine may swallow.",
@@ -411,6 +425,19 @@ _register(
     Knob("SPARKNET_FLEETSOAK", "bool", "",
          "Set to 1 to run the 2-job fleet soak smoke in run_tier1.sh.",
          "tools/run_tier1.sh"),
+    Knob("SPARKNET_PODSOAK", "bool", "",
+         "Set to 1 to run the simulated 3-host pod burn-in slice in "
+         "run_tier1.sh.",
+         "tools/run_tier1.sh"),
+    Knob("SPARKNET_SOAK_QPS", "float", "4.0",
+         "Pod burn-in base offered QPS (the diurnal curve's mean).",
+         "tools/soak.py"),
+    Knob("SPARKNET_SOAK_FLASH_X", "float", "2.5",
+         "Pod burn-in flash-crowd multiplier over the base QPS.",
+         "tools/soak.py"),
+    Knob("SPARKNET_SOAK_LEG_S", "float", "4.0",
+         "Pod burn-in seconds per traffic leg.",
+         "tools/soak.py"),
     Knob("SPARKNET_FEEDBENCH", "bool", "",
          "Set to 1 to run the input-pipeline bench gate in run_tier1.sh.",
          "tools/run_tier1.sh"),

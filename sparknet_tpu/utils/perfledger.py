@@ -602,6 +602,41 @@ def entries_from_serving_fleet(doc: Mapping[str, Any],
                        round_tag=round_tag, t=t, **prov)]
 
 
+def entries_from_podsoak(doc: Mapping[str, Any],
+                         path: str | None = None, *,
+                         round_tag: str | None = None,
+                         t: float | None = None,
+                         device_hint: str | None = None) -> list[dict]:
+    """tools/soak.py ``--pod`` verdicts (SOAK_pod_*): the simulated
+    multi-host burn-in.  Folds every episode's serving legs into the
+    worst case (min achieved qps, max p99) plus the mean episode wall
+    time — the numbers a pod regression would move first.  ``world`` is
+    the whole pod's device count, so differently-sized rigs never pool."""
+    if doc.get("mode") != "pod" or not doc.get("episodes"):
+        return []
+    legs = [l for ep in doc["episodes"] for l in ep.get("legs") or []]
+    if not legs:
+        return []
+    prov = _prov_fields(doc)
+    eps = doc["episodes"]
+    fp = fingerprint(model="lenet", dtype="f32",
+                     world=int(doc.get("pod_hosts") or 0)
+                     * int(doc.get("devices_per_host") or 0),
+                     device=device_hint)
+    metrics = {
+        "podsoak_min_leg_qps": min(l.get("achieved_qps") or 0.0
+                                   for l in legs),
+        "podsoak_max_p99_ms": max(l.get("p99_ms") or 0.0 for l in legs),
+        "podsoak_errors": sum(l.get("errors") or 0 for l in legs),
+        "podsoak_episode_s": sum(ep.get("elapsed_s") or 0.0
+                                 for ep in eps) / len(eps),
+    }
+    return [make_entry("podsoak", path, fp, metrics,
+                       round_tag=round_tag, t=t,
+                       notes=None if doc.get("ok") else "burn-in FAILED",
+                       **prov)]
+
+
 def entries_from_roundbench(doc: Mapping[str, Any],
                             path: str | None = None, *,
                             round_tag: str | None = None,
@@ -765,6 +800,9 @@ def entries_from_any(doc: Mapping[str, Any], path: str | None = None, *,
     if doc.get("metric") == "serving_fleet_scaling_x":
         return entries_from_serving_fleet(doc, path, round_tag=round_tag,
                                           t=t, device_hint=device_hint)
+    if doc.get("mode") == "pod" and "episodes" in doc:
+        return entries_from_podsoak(doc, path, round_tag=round_tag, t=t,
+                                    device_hint=device_hint)
     if doc.get("kind") == "tuning_table":
         return entries_from_tuning_table(doc, path, round_tag=round_tag,
                                          t=t)

@@ -1,0 +1,508 @@
+"""Pod-scale fleet coverage (marker ``pod``): host inventory grammar,
+cross-host all-or-nothing gang placement with serve anti-affinity,
+host-granular failure attribution in the ResilientRunner (one host
+death burns ONE restart-budget unit, not one per rank), whole-host
+rejoin with the two-strike guard, the scheduler's host lifecycle
+(draining → SNAPSHOT_STOP → requeue off-host; lost → kill → requeue
+onto survivors), the cross-process host-control channel, the status
+views' hosts section, and scheduler-death journal resume on a pod
+(cross-host pid verification through the /proc identity check).
+
+The scheduler core is driven through ``step()`` with fake runners for
+determinism (same harness as test_fleet); the resume path uses a real
+subprocess stub; the full burn-in episode is exercised end to end by
+``tools/soak.py --pod`` (the SPARKNET_PODSOAK tier-1 gate) and by the
+``slow``-marked test at the bottom."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from sparknet_tpu.parallel.fleet import (
+    COMPLETED, QUEUED, RUNNING,
+    HOST_DRAINING, HOST_LIVE, HOST_LOST,
+    ENV_JOB_TAG, FleetError, FleetScheduler, GangAllocator, HostPool,
+    JobSpec, _pid_is_fleet_job, format_status,
+    offline_status, request_mark_host,
+)
+from sparknet_tpu.parallel.resilience import (
+    ElasticPolicy, ResilientRunner, RestartPolicy,
+)
+
+pytestmark = pytest.mark.pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# host inventory
+# ---------------------------------------------------------------------------
+
+def test_hostpool_inline_grammar_and_roundtrips(tmp_path):
+    pool = HostPool.parse("a=4, b=2@10.0.0.7 ,c=1")
+    assert len(pool) == 3 and pool.total_devices == 7
+    assert pool.spec("a").addr == "local"
+    assert pool.spec("b").addr == "10.0.0.7"
+    assert "c" in pool and "z" not in pool
+    # JSON round trip preserves order, budgets and addresses
+    again = HostPool.from_json(json.loads(json.dumps(pool.to_json())))
+    assert [(h.name, h.devices, h.addr) for h in again.specs()] == \
+           [(h.name, h.devices, h.addr) for h in pool.specs()]
+    # from_spec: a path to a JSON file, else the inline form
+    p = tmp_path / "hosts.json"
+    p.write_text(json.dumps(pool.to_json()))
+    assert HostPool.from_spec(str(p)).total_devices == 7
+    assert HostPool.from_spec("a=4,b=2@10.0.0.7,c=1").total_devices == 7
+
+
+@pytest.mark.parametrize("text", [
+    "",                    # empty inventory
+    "a=0",                 # devices must be >= 1
+    "a=four",              # not an int
+    "a4",                  # missing name=devices
+    "a=2,a=2",             # duplicate host
+    "bad name=2",          # whitespace in a host name
+])
+def test_hostpool_rejects_bad_inventory(text):
+    with pytest.raises(ValueError):
+        HostPool.parse(text)
+
+
+def test_hostpool_liveness_marks():
+    pool = HostPool.parse("a=2,b=2")
+    assert pool.placeable("a") and pool.lost() == []
+    pool.mark("a", HOST_DRAINING)
+    assert not pool.placeable("a") and pool.lost() == []
+    pool.mark("a", HOST_LOST)
+    assert pool.lost() == ["a"]
+    pool.mark("a", HOST_LIVE)
+    assert pool.placeable("a")
+    with pytest.raises(FleetError, match="bad host state"):
+        pool.mark("a", "zombie")
+    with pytest.raises(FleetError, match="unknown host"):
+        pool.mark("nope", HOST_LOST)
+
+
+# ---------------------------------------------------------------------------
+# cross-host gang placement
+# ---------------------------------------------------------------------------
+
+def test_pool_allocator_all_or_nothing_across_hosts():
+    pool = HostPool.parse("a=4,b=4,c=4")
+    al = GangAllocator(pool=pool)
+    g = al.allocate(6)                       # must span two hosts
+    assert g is not None and len(g) == 6
+    assert len(set(al.hosts_of(g))) == 2
+    # 6 slots remain across b+c: a 7-gang is refused WHOLE, nothing is
+    # taken; a 5-gang spans the surviving hosts
+    assert al.allocate(7) is None and al.free_count == 6
+    g2 = al.allocate(5)
+    assert g2 is not None and len(set(al.hosts_of(g2))) == 2
+    al.free(g)
+    al.free(g2)
+    assert al.free_count == 12
+    assert al.allocate(12) is not None       # the whole pod is one gang
+
+
+def test_pool_allocator_skips_unplaceable_hosts():
+    pool = HostPool.parse("a=4,b=4")
+    al = GangAllocator(pool=pool)
+    pool.mark("a", HOST_LOST)
+    g = al.allocate(4)
+    assert al.hosts_of(g) == ("b",)          # only the live host offers
+    assert al.allocate(1) is None            # b is full, a is dead
+    pool.mark("a", HOST_LIVE)
+    assert al.hosts_of(al.allocate(1)) == ("a",)
+    pool.mark("b", HOST_DRAINING)            # draining = stop placing,
+    al.free(g)                               # but its slots free cleanly
+    assert al.allocate(4) is None            # 3 left on a, b fenced off
+
+
+def test_serve_anti_affinity_spreads_then_falls_back():
+    pool = HostPool.parse("h0=4,h1=4,h2=4")
+    al = GangAllocator(pool=pool)
+    # two trainings pack the emptiest hosts first
+    t0, t1 = al.allocate(3), al.allocate(3)
+    assert al.hosts_of(t0) == ("h0",) and al.hosts_of(t1) == ("h1",)
+    # replica 0 lands on the emptiest host; replica 1 avoids it, so one
+    # host loss can never take every replica of the model at once
+    r0 = al.allocate(1)
+    assert al.hosts_of(r0) == ("h2",)
+    r1 = al.allocate(1, avoid=al.hosts_of(r0))
+    assert al.hosts_of(r1) != ("h2",)
+    # SOFT anti-affinity: when only avoided hosts have room, the gang
+    # still lands (capacity beats spread)
+    r2 = al.allocate(4, avoid=("h0", "h1", "h2"))
+    assert r2 is not None and len(r2) == 4
+
+
+# ---------------------------------------------------------------------------
+# host-granular attribution in the ResilientRunner
+# ---------------------------------------------------------------------------
+
+def _scripted_runner(monkeypatch, script, **kw):
+    """A ResilientRunner whose launches are scripted: each entry is
+    ``(rc, first_failure_rank_or_None)``."""
+    it = iter(script)
+
+    def fake_launch(self, attempt, report):
+        rc, ff = next(it)
+        if ff is not None:
+            report["first_failure"] = ff
+        return rc
+
+    monkeypatch.setattr(ResilientRunner, "_launch_once", fake_launch)
+    kw.setdefault("policy", RestartPolicy(max_restarts=3,
+                                          backoff_base=0.01, jitter=0.0))
+    return ResilientRunner(["job"], sleep=lambda s: None, **kw)
+
+
+def test_host_death_burns_one_budget_unit(monkeypatch):
+    """Both ranks of host 'a' die with the machine; the probe confirms it
+    on the FIRST failed attempt — one re-form, one budget strike, zero
+    wasted re-dials of the dead host."""
+    r = _scripted_runner(
+        monkeypatch, [(-9, 0), (0, None)],
+        nprocs=4, host_map=["a", "a", "b", "c"],
+        host_down_probe=lambda h: h == "a",
+        elastic=ElasticPolicy(enabled=True, min_workers=1))
+    assert r.run() == 0
+    assert r.dropped_hosts == ["a"]
+    assert r._drop_counts["a"] == 1          # ONE strike for 2 ranks
+    assert r.nprocs == 2 and r.host_map == ["b", "c"]
+    assert len(r.attempts) == 2              # no budget burned re-dialing
+    assert r.incarnation == 1                # exactly one re-form
+
+
+def test_host_attribution_heuristic_needs_two_distinct_ranks(monkeypatch):
+    """Without a probe, one failing rank is a rank problem (normal
+    restart); two DIFFERENT first deaths on one multi-rank host are a
+    host problem (re-form)."""
+    r = _scripted_runner(
+        monkeypatch, [(-9, 0), (-9, 1), (0, None)],
+        nprocs=4, host_map=["a", "a", "b", "c"],
+        elastic=ElasticPolicy(enabled=True, min_workers=1))
+    assert r.run() == 0
+    # attempt 1 (rank 0 only) restarted in place; attempt 2 (rank 1,
+    # same host) flipped the verdict to host-down
+    assert [a.world for a in r.attempts] == [4, 4, 2]
+    assert r.dropped_hosts == ["a"] and r.nprocs == 2
+    assert r._drop_counts["a"] == 1 and r.incarnation == 1
+
+
+def test_recovered_host_rejoins_whole(monkeypatch):
+    """A dropped host rejoins with ALL its ranks in one membership
+    change at the next relaunch boundary."""
+    r = _scripted_runner(
+        monkeypatch, [(-9, 0), (0, None)],
+        nprocs=4, host_map=["a", "a", "b", "c"],
+        host_down_probe=lambda h: h == "a",
+        rejoin_probe=lambda slot: True,      # recovered by next launch
+        elastic=ElasticPolicy(enabled=True, min_workers=1))
+    assert r.run() == 0
+    assert r.dropped_hosts == []             # readmitted
+    assert r.nprocs == 4
+    assert sorted(r.host_map) == ["a", "a", "b", "c"]
+
+
+def test_twice_failed_host_is_out_for_good(monkeypatch):
+    """Two strikes: a host that fails again after rejoining stays out —
+    an always-True probe against a broken machine must not livelock the
+    drop/rejoin cycle."""
+    r = _scripted_runner(
+        monkeypatch, [(-9, 0), (-9, 2), (0, None)],
+        nprocs=4, host_map=["a", "a", "b", "c"],
+        host_down_probe=lambda h: h == "a",
+        rejoin_probe=lambda slot: True,
+        elastic=ElasticPolicy(enabled=True, min_workers=1))
+    assert r.run() == 0
+    assert r._drop_counts["a"] == 2
+    assert r.dropped_hosts == ["a"]          # still out, probe says yes
+    assert r.nprocs == 2 and r.host_map == ["b", "c"]
+
+
+def test_host_drop_respects_min_workers(monkeypatch):
+    """A re-form that would shrink below min_workers is refused — the
+    job fails loud instead of limping on a quorum too small to trust."""
+    r = _scripted_runner(
+        monkeypatch, [(-9, 0), (-9, 0), (-9, 0), (-9, 0)],
+        nprocs=4, host_map=["a", "a", "a", "b"],
+        host_down_probe=lambda h: h == "a",
+        policy=RestartPolicy(max_restarts=3, backoff_base=0.01,
+                             jitter=0.0),
+        elastic=ElasticPolicy(enabled=True, min_workers=2))
+    assert r.run() != 0
+    assert r.dropped_hosts == []             # 4 - 3 = 1 < min_workers
+    assert r.failure is not None
+
+
+# ---------------------------------------------------------------------------
+# scheduler host lifecycle (fake runners, manual stepping)
+# ---------------------------------------------------------------------------
+
+class FakeRunner:
+    """ResilientRunner stand-in (same contract as test_fleet's): blocks
+    until released; canceled → rc 0 without the out artifact."""
+
+    def __init__(self, job, behavior):
+        self.job = job
+        self.behavior = behavior
+        self.release = threading.Event()
+        self.canceled = False
+        self.failure = None
+        self.workdir = os.path.join(job.job_dir, "runner")
+
+    def cancel(self):
+        self.canceled = True
+        self.release.set()
+
+    def run(self):
+        assert self.release.wait(timeout=30), "fake runner never released"
+        if self.behavior == "complete" and not self.canceled:
+            with open(self.job.out_path, "w") as f:
+                f.write("done")
+            return 0
+        return 0
+
+
+class PodFleet:
+    """A FleetScheduler on a simulated host pool, stepped manually."""
+
+    def __init__(self, tmp_path, hosts="a=2,b=2", **kw):
+        self.behaviors = {}
+        self.runners = {}
+
+        def factory(job, cmd, env):
+            r = FakeRunner(job, self.behaviors.get(job.name, "complete"))
+            self.runners.setdefault(job.name, []).append(r)
+            return r
+
+        self.sched = FleetScheduler(str(tmp_path / "fleet"), None,
+                                    hosts=HostPool.parse(hosts),
+                                    runner_factory=factory, **kw)
+
+    def submit(self, behavior="complete", **kw):
+        self.behaviors[kw["name"]] = behavior
+        return self.sched.submit(JobSpec(**kw))
+
+    def release(self, name):
+        self.runners[name][-1].release.set()
+
+    def settle(self, cond, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.sched.step()
+            if cond():
+                return
+            time.sleep(0.01)
+        raise AssertionError("condition never settled")
+
+
+def test_host_lost_kills_gang_and_requeues_onto_survivors(tmp_path):
+    f = PodFleet(tmp_path, hosts="a=2,b=2")
+    j = f.submit(name="t0", world=2)
+    f.sched.step()
+    assert j.state == RUNNING
+    dead = j.hosts[0]
+    other = "b" if dead == "a" else "a"
+    f.sched.mark_host(dead, HOST_LOST, by="test")
+    # abrupt path: the gang is killed and requeued, then relaunched —
+    # and never back onto the dead machine
+    f.settle(lambda: j.state == RUNNING and len(f.runners["t0"]) == 2)
+    assert j.hosts == (other,)
+    assert j.preempt_count == 1
+    f.release("t0")
+    f.settle(lambda: j.state == COMPLETED)
+    events = [e["ev"] for e in self_journal(f)]
+    assert "host" in events and "host_kill" in events
+
+
+def self_journal(f):
+    from sparknet_tpu.parallel.fleet import FleetJournal
+    return FleetJournal.read(
+        os.path.join(f.sched.workdir, "fleet_journal.jsonl"))
+
+
+def test_host_loss_strands_gang_when_no_capacity_remains(tmp_path):
+    """A gang spanning both hosts dies with either; with half the pod
+    gone it waits QUEUED (all-or-nothing) until the host returns."""
+    f = PodFleet(tmp_path, hosts="a=2,b=2")
+    j = f.submit(name="wide", world=4)
+    f.sched.step()
+    assert j.state == RUNNING and set(j.hosts) == {"a", "b"}
+    f.sched.mark_host("b", HOST_LOST, by="test")
+    f.settle(lambda: j.state == QUEUED)
+    f.sched.step()
+    f.sched.step()
+    assert j.state == QUEUED                 # 2 live slots < world 4
+    f.sched.mark_host("b", HOST_LIVE, by="test")
+    f.settle(lambda: j.state == RUNNING)
+    f.release("wide")
+    f.settle(lambda: j.state == COMPLETED)
+
+
+def test_host_draining_evicts_gracefully_and_fences_placement(tmp_path):
+    f = PodFleet(tmp_path, hosts="a=2,b=2", preempt_grace_s=30)
+    j = f.submit(name="t0", world=2)
+    f.sched.step()
+    assert j.state == RUNNING
+    victim = j.hosts[0]
+    other = "b" if victim == "a" else "a"
+    f.sched.mark_host(victim, HOST_DRAINING, by="spot-notice")
+    # graceful path: SNAPSHOT_STOP eviction (cancel, not a kill), then
+    # requeue and relaunch — never back onto the draining host
+    f.settle(lambda: j.state == RUNNING and len(f.runners["t0"]) == 2)
+    assert f.runners["t0"][0].canceled
+    assert j.preempt_count == 1
+    assert j.hosts == (other,)               # drain fence held
+    f.release("t0")
+    f.settle(lambda: j.state == COMPLETED)
+
+
+def test_host_control_channel_applies_cross_process_marks(tmp_path):
+    f = PodFleet(tmp_path, hosts="a=2,b=2")
+    # a separate process (tools/fleet.py mark-host, the chaos harness)
+    # appends to host_control.jsonl; the scheduler applies it at step()
+    request_mark_host(f.sched.workdir, "a", HOST_DRAINING, by="ops")
+    f.sched.step()
+    assert f.sched.pool.state["a"] == HOST_DRAINING
+    # malformed and unknown-host records are loud but not fatal
+    with open(os.path.join(f.sched.workdir, "host_control.jsonl"),
+              "a") as fh:
+        fh.write("not json\n")
+        fh.write(json.dumps({"host": "ghost", "state": "lost"}) + "\n")
+    request_mark_host(f.sched.workdir, "a", HOST_LIVE, by="ops")
+    f.sched.step()
+    assert f.sched.pool.state["a"] == HOST_LIVE
+    with pytest.raises(FleetError, match="bad host state"):
+        request_mark_host(f.sched.workdir, "a", "zombie")
+
+
+def test_status_views_fold_hosts_live_and_offline(tmp_path):
+    f = PodFleet(tmp_path, hosts="a=2,b=2@10.0.0.9")
+    j = f.submit(name="t0", world=2)
+    f.sched.step()
+    f.sched.mark_host("b", HOST_DRAINING, by="test") \
+        if j.hosts == ("a",) else f.sched.mark_host("a", HOST_DRAINING,
+                                                    by="test")
+    st = f.sched.status()
+    host = j.hosts[0]
+    assert st["hosts"][host]["used"] == 2
+    assert st["hosts"][host]["gangs"] == ["t0"]
+    drained = "b" if host == "a" else "a"
+    assert st["hosts"][drained]["state"] == HOST_DRAINING
+    text = format_status(st)
+    assert "host" in text and drained in text and HOST_DRAINING in text
+    # the offline reconstruction (tools/fleet.py status on a dead
+    # scheduler's workdir) folds the same hosts section from the journal
+    off = offline_status(f.sched.workdir)
+    assert off["hosts"][host]["gangs"] == ["t0"]
+    assert off["hosts"][drained]["state"] == HOST_DRAINING
+    assert off["hosts"]["b"]["addr"] == "10.0.0.9"
+    f.release("t0")
+    f.settle(lambda: j.state == COMPLETED)
+
+
+# ---------------------------------------------------------------------------
+# scheduler death on a pod: journal resume + cross-host pid verification
+# ---------------------------------------------------------------------------
+
+def _stub_path(tmp_path):
+    p = tmp_path / "stub.py"
+    p.write_text(
+        "import os, signal, sys, time\n"
+        "state, rounds, tick, out = (sys.argv[1], int(sys.argv[2]),\n"
+        "                            float(sys.argv[3]), sys.argv[4])\n"
+        "stop = []\n"
+        "signal.signal(signal.SIGTERM, lambda *a: stop.append(1))\n"
+        "r = int(open(state).read()) if os.path.exists(state) else 0\n"
+        "while r < rounds:\n"
+        "    if stop:\n"
+        "        sys.exit(0)\n"
+        "    time.sleep(tick)\n"
+        "    r += 1\n"
+        "    with open(state, 'w') as f:\n"
+        "        f.write(str(r))\n"
+        "with open(out, 'w') as f:\n"
+        "    f.write('done')\n")
+    return str(p)
+
+
+def _stub_spec(tmp_path, name, rounds=10, tick=0.02, **kw):
+    return JobSpec(
+        name=name, rounds=rounds,
+        cmd=(sys.executable, _stub_path(tmp_path),
+             "{ckpt}/state.txt", "{rounds}", str(tick), "{out}"),
+        **kw)
+
+
+def test_pod_resume_reaps_cross_host_survivor_and_requeues(tmp_path):
+    """Scheduler death on a simulated 2-host rig: the journal records
+    the gang's pids against its hosts; resume rebuilds the HostPool from
+    the fleet record, identifies the survivor through the /proc env-tag
+    check (pid recycling can't make it kill a stranger), reaps it, and
+    requeues — the relaunch resumes from the survivor's checkpoint."""
+    wd = str(tmp_path / "fleet")
+    spec = _stub_spec(tmp_path, "lone", rounds=40, tick=0.01, world=2)
+    sched = FleetScheduler(wd, None, hosts=HostPool.parse("a=2,b=2"))
+    job = sched.submit(spec)
+    os.makedirs(job.ckpt_dir, exist_ok=True)
+    proc = subprocess.Popen(
+        [c.format(out=job.out_path, ckpt=job.ckpt_dir, world="2",
+                  rounds="100000") for c in spec.cmd],
+        env={**os.environ, ENV_JOB_TAG: "lone"})
+    sched.journal.append("launch", job="lone", episode=1, slots=[0, 1],
+                         hosts=["a"])
+    sched.journal.append("pids", job="lone", pids=[proc.pid])
+    sched.journal.close()
+    del sched
+    time.sleep(0.3)
+    assert proc.poll() is None and _pid_is_fleet_job(proc.pid, "lone")
+
+    fleet = FleetScheduler.resume(wd)
+    # the pool came back from the journal's fleet record
+    assert fleet.pool is not None and fleet.pool.total_devices == 4
+    assert sorted(h.name for h in fleet.pool.specs()) == ["a", "b"]
+    # the survivor was reaped before the job could be relaunched
+    assert proc.wait(timeout=10) is not None
+    job2 = fleet.jobs["lone"]
+    assert job2.state == QUEUED
+    state = os.path.join(job2.ckpt_dir, "state.txt")
+    resumed_from = int(open(state).read()) if os.path.exists(state) else 0
+    assert fleet.run(tick_s=0.02, timeout_s=60) == 0
+    assert job2.completed_ok()
+    if resumed_from:
+        assert int(open(state).read()) >= resumed_from
+
+
+# ---------------------------------------------------------------------------
+# the whole story at once: one slice of the standing burn-in
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_pod_burn_in_slice_end_to_end(tmp_path):
+    """One seeded pod-soak slice on a simulated 3-host rig: mixed
+    training+serving tenants, a host kill mid-load, a corrupt-upload
+    burst through the quarantine plane, a flash crowd — every training
+    must finish bit-identical to the fault-free baseline, every serving
+    leg with zero errors and zero routed-answer mismatches, and the rig
+    must wind down with zero orphans."""
+    out = tmp_path / "verdict.json"
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+         "--pod", "3", "--pod-slice", "--seed", "7",
+         "--workdir", str(tmp_path / "rig"), "--out", str(out)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert rc == 0
+    verdict = json.loads(out.read_text())
+    assert verdict["ok"] and verdict["passed"] == 1
+    ep = verdict["episodes"][0]
+    assert ep["trainings"] and all(t["match"] for t in ep["trainings"])
+    assert ep["slo_ok"] and not ep["orphans"]
+    assert ep["chaos"]["host_kill"]
+    assert ep["quarantine"]["ok"] and ep["quarantine"]["typed_overflow"]

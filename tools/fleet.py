@@ -17,9 +17,22 @@ Job file: a JSON list of JobSpec objects, e.g.
 Usage:
   python tools/fleet.py --devices 8 --workdir /tmp/fleet \
       --jobs jobs.json --quota acme=4 --status-every 5
+  python tools/fleet.py --hosts 'a=4,b=4,c=4' --workdir /tmp/fleet \
+      --jobs jobs.json                          # multi-host placement
   python tools/fleet.py --workdir /tmp/fleet --resume     # after a kill
   python tools/fleet.py status --workdir /tmp/fleet          # offline view
   python tools/fleet.py status --workdir /tmp/fleet --json   # one JSON doc
+  python tools/fleet.py mark-host b lost --workdir /tmp/fleet  # host died
+
+``--hosts`` takes an inline inventory (``name=devices[@addr]``, comma
+separated) or a path to a JSON file (``[{"name", "devices", "addr"}]``);
+SPARKNET_FLEET_HOSTS supplies the same when the flag is absent.  With a
+pool, gangs place across hosts all-or-nothing (packing the fewest
+hosts), the status views grow per-host rows (state, device usage, gang
+placement), and ``mark-host <host> live|draining|lost`` appends to the
+host-control channel the running scheduler polls: ``draining`` evicts
+the host's gangs gracefully (snapshot, requeue, bit-identical resume),
+``lost`` kills and requeues them onto surviving hosts.
 
 ``status`` (or ``--status``) reads the journal + heartbeats + the
 telemetry registry snapshots the workers wrote — no scheduler process
@@ -73,10 +86,29 @@ def parse_quotas(pairs):
     return quotas
 
 
+def _mark_host(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet.py mark-host",
+        description="request a host state change (the running scheduler "
+                    "applies it at its next step)")
+    ap.add_argument("host")
+    ap.add_argument("state", choices=("live", "draining", "lost"))
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--by", default="operator")
+    args = ap.parse_args(argv)
+    from sparknet_tpu.parallel.fleet import request_mark_host
+    request_mark_host(args.workdir, args.host, args.state, by=args.by)
+    print(f"requested {args.host} -> {args.state} "
+          f"(host_control.jsonl in {args.workdir})")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "status":   # subcommand spelling of --status
         argv = ["--status"] + argv[1:]
+    if argv and argv[0] == "mark-host":
+        return _mark_host(argv[1:])
     ap = argparse.ArgumentParser(
         description="multi-tenant training fleet scheduler")
     ap.add_argument("--workdir", required=True,
@@ -93,6 +125,10 @@ def main(argv=None) -> int:
                          "--resume)")
     ap.add_argument("--devices", type=int, default=8,
                     help="total device slices in the budget")
+    ap.add_argument("--hosts", default=None,
+                    help="host inventory: 'name=devices[@addr],...' or a "
+                         "JSON file path; overrides --devices (falls "
+                         "back to SPARKNET_FLEET_HOSTS)")
     ap.add_argument("--quota", action="append", default=[],
                     metavar="TENANT=SLOTS",
                     help="per-tenant slot quota (repeatable)")
@@ -120,7 +156,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from sparknet_tpu.parallel.fleet import (
-        FleetScheduler, format_status, offline_status,
+        FleetScheduler, HostPool, format_status, offline_status,
     )
 
     if args.status:
@@ -132,6 +168,8 @@ def main(argv=None) -> int:
         return 0
 
     if args.resume:
+        # the journal carries the host inventory (+ marked states), so a
+        # resumed pod fleet needs no --hosts re-spelling
         fleet = FleetScheduler.resume(
             args.workdir, aging_rate=args.aging,
             preempt=not args.no_preempt,
@@ -139,8 +177,11 @@ def main(argv=None) -> int:
     else:
         if not args.jobs:
             ap.error("--jobs is required (or --resume)")
+        pool = (HostPool.from_spec(args.hosts) if args.hosts
+                else HostPool.from_env())
         fleet = FleetScheduler(
-            args.workdir, args.devices, tenants=parse_quotas(args.quota),
+            args.workdir, None if pool else args.devices, hosts=pool,
+            tenants=parse_quotas(args.quota),
             aging_rate=args.aging, preempt=not args.no_preempt,
             preempt_grace_s=args.preempt_grace)
         for spec in load_specs(args.jobs):

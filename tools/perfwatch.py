@@ -298,7 +298,10 @@ def cmd_regress(args) -> int:
         for line in reversed(text.strip().splitlines()):
             line = line.strip()
             if line.startswith("{"):
-                doc = json.loads(line)
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    pass   # pretty-printed JSON: an inner line matched
                 break
         if doc is None:
             doc = json.loads(text)

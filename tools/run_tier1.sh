@@ -99,6 +99,24 @@ maybe_fleetsoak() {
   fi
 }
 
+# ~60-second simulated 3-host pod burn-in slice (tools/soak.py --pod 3
+# --pod-slice) — opt-in via SPARKNET_PODSOAK=1.  Two training tenants +
+# one replicated serving tenant on a 3-host simulated pod under the
+# seeded traffic model, with one host-kill fired mid-leg through the
+# host-control channel and one flash crowd: the episode must end with
+# both trainings bit-identical to the fault-free baseline, zero
+# client-visible serving errors, the serving tier healed, the
+# corrupt-upload quarantine burst absorbed-and-typed, and zero orphaned
+# workers.  (The full acceptance run adds the host-drain and
+# serving-host-loss legs: `python tools/soak.py --pod 3`.)
+maybe_podsoak() {
+  if [ "${SPARKNET_PODSOAK:-}" = "1" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python tools/soak.py --pod 3 --pod-slice \
+      --seed "${SPARKNET_SOAK_SEED:-0}" --out /tmp/_podsoak.json
+  fi
+}
+
 # ~2-second serving smoke (tools/serveload.py --smoke) — opt-in via
 # SPARKNET_SERVESMOKE=1.  In-process engine + closed-loop clients;
 # fails the gate unless results are bit-identical to solo references,
@@ -205,6 +223,7 @@ case "${1:-}" in
   --lint)  SPARKNET_LINT=1 maybe_lint ;;
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
   --fleetsoak) SPARKNET_FLEETSOAK=1 maybe_fleetsoak ;;
+  --podsoak) SPARKNET_PODSOAK=1 maybe_podsoak ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --recordbench) SPARKNET_RECORDBENCH=1 maybe_recordbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
@@ -215,16 +234,16 @@ case "${1:-}" in
   --fusebench) SPARKNET_FUSEBENCH=1 maybe_fusebench ;;
   --tunebench) SPARKNET_TUNEBENCH=1 maybe_tunebench ;;
   --all)   maybe_lint && run_tier1 && run_chaos && maybe_soak \
-             && maybe_fleetsoak \
+             && maybe_fleetsoak && maybe_podsoak \
              && maybe_feedbench && maybe_recordbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
              && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
              && maybe_perfgate ;;
   "")      maybe_lint && run_tier1 && maybe_soak && maybe_fleetsoak \
-             && maybe_feedbench && maybe_recordbench \
+             && maybe_podsoak && maybe_feedbench && maybe_recordbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
              && maybe_roundbench && maybe_obssmoke \
              && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac

@@ -23,11 +23,29 @@ round with final params bit-identical to its fault-free baseline, the
 resumed queue to never double-launch, and ZERO orphaned worker
 processes at the end.
 
+Pod mode (``--pod N``) is the POD-SCALE burn-in: a simulated N-host rig
+(every host a local slice of the device budget — the same code path a
+real SSH inventory takes) runs mixed tenants — two training gangs plus a
+replicated serving tenant behind the request router — under a seeded
+production-shaped :class:`TrafficModel`: a diurnal paced-load curve, a
+flash crowd, corrupt-upload bursts through the data quarantine plane,
+and host-kill / host-drain chaos events injected through the
+host-control channel mid-leg.  Every episode must end with the training
+params bit-identical to the fault-free baseline, zero client-visible
+serving errors (typed rejections allowed), the serving tier healed back
+to N replicas, and ZERO orphans; any breach writes a postmortem.json +
+flight-recorder dump and fails the run.  ``--forever`` keeps scheduling
+episodes until one fails (the standing burn-in posture); ``--pod-slice``
+is the ~60 s CI shape (one host-kill + one flash crowd).
+
 Usage:
   python tools/soak.py --runs 8 --seed 0 --out soak.json
   python tools/soak.py --fleet 4 --fleet-kill --seed 0   # fleet chaos
+  python tools/soak.py --pod 3 --seed 0 --out SOAK_pod.json
+  python tools/soak.py --pod 3 --forever   # standing burn-in
   SPARKNET_SOAK=1 tools/run_tier1.sh       # the 2-run CI smoke
   SPARKNET_FLEETSOAK=1 tools/run_tier1.sh  # the 2-job fleet smoke
+  SPARKNET_PODSOAK=1 tools/run_tier1.sh    # the 3-host pod slice
 
 Exit code 0 iff every run recovered exactly; the JSON verdict names each
 run's schedule, exit code, attempt count, and whether the params matched.
@@ -281,6 +299,408 @@ def fleet_soak(args) -> int:
     return 0 if report["ok"] else 1
 
 
+# ---------------------------------------------------------------------------
+# Pod burn-in (--pod N): simulated multi-host fleet under production-shaped
+# traffic — diurnal paced load, a flash crowd, corrupt-upload bursts through
+# the quarantine plane, host-kill / host-drain chaos — every recovery
+# bit-identical, every leg error-free, zero orphans
+# ---------------------------------------------------------------------------
+
+class TrafficModel:
+    """Seeded synthesized production traffic for the pod burn-in.
+
+    One instance is one "day": ``next_qps()`` walks a diurnal sine curve
+    (seeded phase, so two runs with the same ``--seed`` replay the same
+    day), ``flash_qps()`` is the flash-crowd step over the base, and
+    ``corrupt_burst(budget)`` sizes the corrupt-upload bursts the
+    quarantine plane must absorb (one within budget) and reject (one
+    past it).  All magnitudes come from the SPARKNET_SOAK_* knobs unless
+    the CLI overrides them."""
+
+    def __init__(self, rng, *, base_qps=None, flash_x=None, leg_s=None,
+                 day_legs: int = 12):
+        from sparknet_tpu.utils import knobs
+        self.rng = rng
+        self.base_qps = (base_qps if base_qps is not None
+                         else knobs.get_float("SPARKNET_SOAK_QPS", 4.0))
+        self.flash_x = (flash_x if flash_x is not None
+                        else knobs.get_float("SPARKNET_SOAK_FLASH_X", 2.5))
+        self.leg_s = (leg_s if leg_s is not None
+                      else knobs.get_float("SPARKNET_SOAK_LEG_S", 4.0))
+        self.day_legs = day_legs
+        self.phase = float(rng.uniform(0.0, 1.0))
+        self.step = 0
+
+    def next_qps(self) -> float:
+        import math
+        f = self.step / self.day_legs + self.phase
+        self.step += 1
+        qps = self.base_qps * (0.7 + 0.3 * math.sin(2 * math.pi * f))
+        return round(max(qps, 0.5), 3)
+
+    def flash_qps(self) -> float:
+        return round(max(self.base_qps * self.flash_x, 1.0), 3)
+
+    def corrupt_burst(self, budget: int) -> tuple[int, int]:
+        """(records in the within-budget burst, records attempted in the
+        past-budget flood)."""
+        within = int(self.rng.integers(2, max(budget, 3)))
+        return min(within, budget), budget + 2
+
+
+def _corrupt_upload_burst(tm: "TrafficModel") -> dict:
+    """One corrupt-upload episode through the data quarantine plane: a
+    within-budget burst must be absorbed as typed skip accounting
+    (attributed per source), and the first record past the budget must
+    raise QuarantineExceeded carrying the report — silent swallowing or
+    an untyped crash are both red."""
+    from sparknet_tpu.data.integrity import (
+        DataCorruptionError, Quarantine, QuarantineExceeded,
+        QuarantinePolicy,
+    )
+    epoch = 200
+    q = Quarantine(QuarantinePolicy(max_fraction=0.05), epoch_size=epoch,
+                   source="pod-upload")
+    within, flood = tm.corrupt_burst(q.budget)
+    for i in range(within):
+        q.admit(DataCorruptionError(
+            "synthetic upload corruption", source="pod-upload",
+            key=f"upload/{i}", offset=int(tm.rng.integers(0, 1 << 20))))
+    absorbed = q.report()
+    typed_report = None
+    try:
+        for i in range(flood):
+            q.admit(DataCorruptionError(
+                "synthetic upload corruption", source="pod-upload-flood",
+                key=f"flood/{i}"))
+    except QuarantineExceeded as e:
+        typed_report = e.report
+    return {"budget": q.budget, "absorbed": within,
+            "typed_overflow": typed_report is not None,
+            "by_source": absorbed["by_source"],
+            "ok": bool(typed_report is not None
+                       and absorbed["epoch_bad"] == within)}
+
+
+def _wait_for(cond, timeout_s: float, tick_s: float = 0.15) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick_s)
+    return bool(cond())
+
+
+def _pod_episode(args, rng, workdir, baseline, ep: int,
+                 rounds: int) -> dict:
+    """One burn-in episode on a fresh simulated pod: schedule the mixed
+    tenants, replay the traffic model (serveload's paced closed loops),
+    fire the chaos events mid-leg through the host-control channel, and
+    return the verdict row.  ``--pod-slice`` keeps the CI shape (one
+    host-kill + one flash crowd); the full episode adds a host drain
+    mid-training and a serving-host loss."""
+    import numpy as np
+
+    from sparknet_tpu.parallel.autoscale import (
+        Autoscaler, AutoscaleConfig, fleet_stats_fn,
+    )
+    from sparknet_tpu.parallel.fleet import (
+        COMPLETED, TERMINAL, FleetScheduler, HostPool, JobSpec,
+        _pid_is_fleet_job, format_status, request_mark_host,
+    )
+    from sparknet_tpu.parallel.router import RouterConfig, ServingFleet
+    from sparknet_tpu.parallel.serving import (
+        ModelHouse, ServeConfig, solo_references,
+    )
+    from sparknet_tpu.utils.telemetry import get_recorder
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serveload
+
+    t0 = time.monotonic()
+    full = not args.pod_slice
+    model, replicas, world = "lenet", 2, 3
+    tm = TrafficModel(rng, base_qps=args.pod_qps,
+                      flash_x=args.pod_flash_x, leg_s=args.pod_leg_s)
+    rec = get_recorder()
+    fleet_dir = os.path.join(workdir, f"ep{ep}")
+    pool = HostPool.parse(",".join(f"h{i}={args.pod_devices}"
+                                   for i in range(args.pod)))
+
+    cfg = ServeConfig(batch_shapes=(1, 4, 8), seed=0)
+    serve_env = {
+        "SPARKNET_SERVE_SHAPES": ",".join(str(s)
+                                          for s in cfg.batch_shapes),
+        "SPARKNET_SERVE_MAX_DELAY_MS": str(cfg.max_delay_ms),
+        "SPARKNET_SERVE_QUEUE": str(cfg.max_queue),
+        "SPARKNET_SERVE_DTYPE": cfg.dtype,
+    }
+    sched = FleetScheduler(fleet_dir, None, hosts=pool,
+                           preempt_grace_s=15.0)
+    fleet = ServingFleet(fleet_dir, pool.total_devices, scheduler=sched,
+                         serve_env=serve_env,
+                         router_cfg=RouterConfig(spill_depth=8),
+                         replica_timeout_s=20.0)
+    scaler = Autoscaler(
+        fleet_stats_fn(fleet), fleet.scale_up, fleet.scale_down,
+        cfg=AutoscaleConfig(min_replicas=replicas,
+                            max_replicas=replicas + 1, up_queue=64.0,
+                            cooldown_s=2.0, down_idle_s=3600.0,
+                            sample_every_s=0.25),
+        state_path=os.path.join(fleet_dir, "autoscale.json"))
+
+    trains = [JobSpec(name=f"train{i}", tenant=("acme", "beta")[i],
+                      world=world, rounds=rounds, global_batch=4 * world,
+                      max_restarts=3, timeout_s=300.0)
+              for i in range(2)]
+    if not full:
+        # slice: trainings warm up alongside the replicas so the single
+        # host-kill lands mid-round within the ~60s budget
+        for spec in trains:
+            sched.submit(spec)
+
+    report: dict = {"episode": ep, "hosts": pool.to_json(),
+                    "base_qps": tm.base_qps, "leg_s": tm.leg_s,
+                    "slice": not full}
+    legs: list[dict] = []
+    chaos: dict = {}
+
+    def mark(host, state):
+        rec.record("pod_soak_chaos", host=host, state=state, episode=ep)
+        request_mark_host(fleet_dir, host, state, by=f"pod-soak-ep{ep}")
+        return {"host": host, "state": state}
+
+    def serve_hosts():
+        return {h for j in sched.jobs.values()
+                if j.spec.kind == "serve" and j.state not in TERMINAL
+                for h in j.hosts}
+
+    def leg(name, qps, midpoint=None, clients=4):
+        rep, mid = serveload._paced_with_midpoint(
+            fleet.router, model, inputs, refs, clients=clients, window=1,
+            seconds=tm.leg_s, qps=qps, midpoint=midpoint or (lambda: None),
+            tenant="podsoak")
+        row = {"leg": name, "offered_qps": qps,
+               "achieved_qps": rep.get("achieved_qps"),
+               "errors": rep.get("errors"),
+               "mismatches": rep.get("exact_mismatches"),
+               "rejected": rep.get("rejected"),
+               "p99_ms": rep.get("p99_ms")}
+        if mid.get("error"):
+            row["chaos_error"] = mid["error"]
+        elif mid.get("value") is not None:
+            row["chaos"] = mid["value"]
+        legs.append(row)
+        print(f"pod-soak: ep{ep} leg {name}: offered {qps} qps -> "
+              f"{row['achieved_qps']} qps, errors {row['errors']}, "
+              f"mismatches {row['mismatches']}"
+              + (f", chaos {row.get('chaos')}" if midpoint else ""),
+              flush=True)
+        return row
+
+    healed = drained = True
+    try:
+        # in-process references: replicas share config + seed, so the
+        # pod must answer bit-identically to this solo house
+        lm = ModelHouse(cfg).load(model)
+        inputs = [rng.normal(size=lm.in_shape).astype(np.float32)
+                  for _ in range(12)]
+        refs = solo_references(lm, inputs)
+
+        fleet.ensure(model, replicas)
+        fleet.attach_autoscaler(scaler)
+        fleet.run_background()
+        fleet.wait_ready(model, replicas, timeout_s=240.0)
+        if full:
+            # full episode: the trainings start only now, so the drain
+            # leg below still catches a gang mid-round
+            for spec in trains:
+                sched.submit(spec)
+        if not _wait_for(lambda: all(sched.jobs[s.name].hosts
+                                     for s in trains), 60.0):
+            raise RuntimeError("training gangs never placed: "
+                               + format_status(sched.status()))
+
+        # -- chaos 1: kill a training host mid-leg ---------------------
+        sh = serve_hosts()
+        kill_victim = next(
+            (h for s in trains for h in sched.jobs[s.name].hosts
+             if h not in sh),
+            sched.jobs[trains[0].name].hosts[0])
+        chaos["host_kill"] = kill_victim
+        leg("diurnal_kill", tm.next_qps(),
+            midpoint=lambda: mark(kill_victim, "lost"))
+
+        # -- corrupt-upload burst through the quarantine plane ---------
+        report["quarantine"] = _corrupt_upload_burst(tm)
+
+        # -- flash crowd; the lost host recovers mid-crowd -------------
+        leg("flash_crowd", tm.flash_qps(), clients=6,
+            midpoint=lambda: mark(kill_victim, "live"))
+
+        if full:
+            # -- chaos 2: drain a host carrying a live training gang ---
+            sh = serve_hosts()
+            cands = [h for s in trains
+                     if sched.jobs[s.name].state not in TERMINAL
+                     for h in sched.jobs[s.name].hosts]
+            cands = [h for h in cands if h not in sh or len(sh) > 1]
+            if cands:
+                drain_victim = cands[0]
+                chaos["host_drain"] = drain_victim
+                leg("diurnal_drain", tm.next_qps(),
+                    midpoint=lambda: mark(drain_victim, "draining"))
+                drained = _wait_for(
+                    lambda: not sched.jobs_on_host(drain_victim), 120.0)
+                mark(drain_victim, "live")
+            else:
+                # the full acceptance must exercise the drain path; a
+                # missed window (trainings already done) is red
+                chaos["host_drain"] = None
+                drained = False
+
+        # -- trainings must finish (kills/drains notwithstanding) ------
+        if not _wait_for(lambda: all(sched.jobs[s.name].state in TERMINAL
+                                     for s in trains), args.pod_timeout):
+            raise RuntimeError("trainings not terminal within "
+                               f"{args.pod_timeout}s: "
+                               + format_status(sched.status()))
+
+        if full:
+            # -- chaos 3: serving host loss = bulk replica death -------
+            sh = sorted(serve_hosts())
+            if len(sh) >= 2:
+                victim2 = sh[0]
+                chaos["serve_host_loss"] = victim2
+                leg("diurnal_serve_loss", tm.next_qps(),
+                    midpoint=lambda: mark(victim2, "lost"))
+                try:
+                    fleet.wait_ready(model, replicas, timeout_s=180.0)
+                except TimeoutError:
+                    healed = False
+                mark(victim2, "live")
+            else:
+                chaos["serve_host_loss"] = None
+                healed = False   # replicas were never spread: red
+
+        # -- final heal check ------------------------------------------
+        try:
+            fleet.wait_ready(model, replicas, timeout_s=120.0)
+        except TimeoutError:
+            healed = False
+    finally:
+        fleet.stop(grace_s=5.0)
+
+    # -- verdict ---------------------------------------------------------
+    tverd = []
+    for s in trains:
+        job = sched.jobs[s.name]
+        v = {"job": s.name, "state": job.state, "episodes": job.episodes,
+             "preempts": job.preempt_count}
+        if job.state == COMPLETED:
+            m, bad = _params_match(baseline, job.out_path)
+            v.update(match=m, **({"diverged_at": bad} if not m else {}))
+        else:
+            v["match"] = False
+        v["ok"] = job.state == COMPLETED and v["match"]
+        tverd.append(v)
+
+    orphans = {name: sorted(p for p in pids
+                            if _pid_is_fleet_job(p, name))
+               for name, pids in _journal_pids(fleet_dir).items()}
+    orphans = {k: v for k, v in orphans.items() if v}
+    slo_ok = all(l["errors"] == 0 and l["mismatches"] == 0 for l in legs)
+    perf_ok = all((l["achieved_qps"] or 0) > 0 for l in legs)
+    chaos_errs = [l["chaos_error"] for l in legs if "chaos_error" in l]
+
+    report.update(
+        chaos=chaos, legs=legs, trainings=tverd, healed=healed,
+        drained=drained, slo_ok=slo_ok, perf_band_ok=perf_ok,
+        orphans=orphans, elapsed_s=round(time.monotonic() - t0, 1),
+        ok=(all(v["ok"] for v in tverd) and slo_ok and perf_ok
+            and healed and drained and not orphans and not chaos_errs
+            and report.get("quarantine", {}).get("ok", False)))
+    if chaos_errs:
+        report["chaos_errors"] = chaos_errs
+
+    if not report["ok"]:
+        # artifact-producing failure: black box + postmortem in the
+        # episode dir (which pod_soak then keeps)
+        rec.dump(f"pod-soak-ep{ep}", directory=fleet_dir)
+        try:
+            with open(os.path.join(fleet_dir, "postmortem.json"),
+                      "w") as f:
+                json.dump({"report": report,
+                           "status": sched.status()}, f, indent=1,
+                          default=str)
+        except OSError:
+            pass
+    return report
+
+
+def pod_soak(args) -> int:
+    import numpy as np
+
+    _clean_env()
+    rng = np.random.default_rng(args.seed)
+    own_tmp = args.workdir is None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sparknet_pod_")
+    os.makedirs(workdir, exist_ok=True)
+    t0 = time.monotonic()
+
+    # one fault-free baseline for the training shape all tenants share
+    # (world=3 gangs; batch 12 keeps the shard math exact; the full
+    # episode trains longer so the drain leg catches a gang mid-round)
+    rounds = 4 if args.pod_slice else 12
+    base = os.path.join(workdir, "base.npz")
+    rc, _ = _run_driver(base, None, ["--global-batch", "12"],
+                        local_devices=3, rounds=rounds)
+    if rc != 0:
+        raise RuntimeError(f"fault-free baseline failed rc={rc}")
+
+    episodes = []
+    ok = True
+    try:
+        ep = 0
+        while True:
+            episodes.append(_pod_episode(args, rng, workdir, base, ep,
+                                         rounds))
+            ok = episodes[-1]["ok"]
+            print(f"pod-soak: episode {ep} -> "
+                  f"{'OK' if ok else 'FAIL'} "
+                  f"({episodes[-1]['elapsed_s']}s)", flush=True)
+            ep += 1
+            if not ok or not args.forever:
+                break
+    except KeyboardInterrupt:
+        print("pod-soak: interrupted — closing out the verdict",
+              file=sys.stderr, flush=True)
+
+    passed = sum(1 for e in episodes if e["ok"])
+    report = {"mode": "pod", "seed": args.seed, "pod_hosts": args.pod,
+              "devices_per_host": args.pod_devices,
+              "slice": bool(args.pod_slice), "episodes": episodes,
+              "passed": passed, "failed": len(episodes) - passed,
+              "elapsed_s": round(time.monotonic() - t0, 1),
+              "ok": bool(episodes) and passed == len(episodes)}
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"pod-soak: verdict written to {args.out} "
+              f"({passed}/{len(episodes)} episode(s) passed)")
+    else:
+        print(text)
+    if own_tmp and report["ok"]:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not report["ok"]:
+        print(f"pod-soak: scratch kept at {workdir} for post-mortem "
+              "(postmortem.json + flight dump in the failing episode "
+              "dir)", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="chaos soak runner")
     ap.add_argument("--runs", type=int, default=8)
@@ -302,8 +722,33 @@ def main(argv=None) -> int:
                     help="delay before the high-priority preemptor "
                          "arrives")
     ap.add_argument("--fleet-timeout", type=float, default=420.0)
+    ap.add_argument("--pod", type=int, default=0, metavar="N",
+                    help="pod mode: burn in a simulated N-host fleet "
+                         "(mixed training + serving tenants) under the "
+                         "seeded traffic model")
+    ap.add_argument("--pod-devices", type=int, default=4,
+                    help="device slices per simulated host")
+    ap.add_argument("--pod-slice", action="store_true",
+                    help="the ~60s CI shape: one host-kill + one flash "
+                         "crowd (skips the drain and serving-host-loss "
+                         "legs)")
+    ap.add_argument("--forever", action="store_true",
+                    help="standing burn-in: keep scheduling episodes "
+                         "until one fails (or Ctrl-C)")
+    ap.add_argument("--pod-timeout", type=float, default=420.0,
+                    help="bound on the training tenants of one episode")
+    ap.add_argument("--pod-qps", type=float, default=None,
+                    help="base offered QPS (default SPARKNET_SOAK_QPS)")
+    ap.add_argument("--pod-flash-x", type=float, default=None,
+                    help="flash-crowd multiplier "
+                         "(default SPARKNET_SOAK_FLASH_X)")
+    ap.add_argument("--pod-leg-s", type=float, default=None,
+                    help="seconds per traffic leg "
+                         "(default SPARKNET_SOAK_LEG_S)")
     args = ap.parse_args(argv)
 
+    if args.pod:
+        return pod_soak(args)
     if args.fleet:
         return fleet_soak(args)
 
