@@ -210,9 +210,12 @@ class JobSpec:
 
 # host lifecycle states (journaled verbatim, host-control channel too)
 HOST_LIVE = "live"
+HOST_SUSPECT = "suspect"     # link silent, machine maybe alive: gangs keep
+                             # running SUSPENDED (partition != death); the
+                             # host just stops taking new placements
 HOST_DRAINING = "draining"   # spot notice: evict gracefully, stop placing
 HOST_LOST = "lost"           # dead: its gangs are already gone
-HOST_STATES = (HOST_LIVE, HOST_DRAINING, HOST_LOST)
+HOST_STATES = (HOST_LIVE, HOST_SUSPECT, HOST_DRAINING, HOST_LOST)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -499,6 +502,9 @@ class FleetJob:
         self.signaled_pids: set[int] = set()
         self.all_pids: set[int] = set()
         self.error: str | None = None
+        # remote placements checkpoint into a host-local dir (set per
+        # launch); None = the shared default ``ckpt/``
+        self.active_ckpt_dir: str | None = None
 
     @property
     def name(self) -> str:
@@ -511,6 +517,20 @@ class FleetJob:
     @property
     def ckpt_dir(self) -> str:
         return os.path.join(self.job_dir, "ckpt")
+
+    def host_ckpt_dir(self, host: str) -> str:
+        """Where a gang placed on ``host`` (over a remote transport)
+        keeps its checkpoints — host-local state, NOT assumed shared.
+        A requeue onto a different host must SHIP the newest valid
+        checkpoint here before launch (see FleetScheduler._launch)."""
+        return os.path.join(self.job_dir, f"ckpt_host_{host}")
+
+    def ckpt_dirs(self) -> list[str]:
+        """Every checkpoint dir this job has ever written (shared
+        default + any per-host dirs), existing ones only."""
+        out = [d for d in glob.glob(os.path.join(self.job_dir, "ckpt*"))
+               if os.path.isdir(d)]
+        return sorted(out)
 
     @property
     def endpoint_path(self) -> str:
@@ -529,9 +549,10 @@ class FleetJob:
 
     def build_cmd(self) -> list[str]:
         spec = self.spec
-        os.makedirs(self.ckpt_dir, exist_ok=True)
+        ckpt = self.active_ckpt_dir or self.ckpt_dir
+        os.makedirs(ckpt, exist_ok=True)
         if spec.cmd is not None:
-            sub = {"out": self.out_path, "ckpt": self.ckpt_dir,
+            sub = {"out": self.out_path, "ckpt": ckpt,
                    "world": str(spec.world), "rounds": str(spec.rounds),
                    "endpoint": self.endpoint_path}
             return [c.format(**sub) for c in spec.cmd]
@@ -548,7 +569,7 @@ class FleetJob:
             return [sys.executable, SERVE_TOOL, "--models", spec.model,
                     "--port", "0", "--endpoint-file", self.endpoint_path]
         cmd = [sys.executable, DRIVER, "--strategy", spec.strategy,
-               "--out", self.out_path, "--ckpt-dir", self.ckpt_dir,
+               "--out", self.out_path, "--ckpt-dir", ckpt,
                "--rounds", str(spec.rounds),
                "--global-batch", str(spec.global_batch),
                "--local-devices", str(spec.world),
@@ -560,10 +581,11 @@ class FleetJob:
         return cmd
 
     def newest_round(self) -> int | None:
-        """Round progress from the newest checkpoint manifest (None
-        before the first checkpoint)."""
+        """Round progress from the newest checkpoint manifest across
+        every checkpoint dir (None before the first checkpoint)."""
         best = None
-        for m in glob.glob(os.path.join(self.ckpt_dir, "manifest_*.json")):
+        for m in glob.glob(os.path.join(self.job_dir, "ckpt*",
+                                        "manifest_*.json")):
             stem = os.path.basename(m)
             try:
                 r = int(stem[len("manifest_"):-len(".json")])
@@ -637,6 +659,10 @@ class FleetScheduler:
         self.runner_factory = runner_factory or self._default_runner
         self._clock = clock
         self.jobs: dict[str, FleetJob] = {}
+        # host -> transport kind of the most recent launch that placed
+        # a gang there ("local"/"ssh"/"chaos+..."): the status view's
+        # transport column, reconstructed offline from launch events
+        self._host_transports: dict[str, str] = {}
         self._results: "queue.Queue" = queue.Queue()
         self._submit_seq = 0
         self.journal = FleetJournal(
@@ -724,21 +750,46 @@ class FleetScheduler:
         return (self._clock() - job.submitted_at) >= job.spec.not_before_s
 
     # -- launch -----------------------------------------------------------
+    def _job_transport(self, job: FleetJob):
+        """The host transport for ``job``'s placement, or None when the
+        gang is purely local (the direct-spawn path, unchanged).  Remote
+        means SPARKNET_SSH_CMD is set (the fake-ssh CI rig included) or
+        any placed host has a non-local address; network fault specs
+        chaos-wrap it."""
+        if not job.hosts or self.pool is None:
+            return None
+        from .transport import default_transport
+        tp = default_transport(
+            [self.pool.spec(h).addr for h in job.hosts])
+        return None if tp.local else tp
+
     def _default_runner(self, job: FleetJob, cmd: list[str],
                         env: dict) -> ResilientRunner:
         # with a pool, the runner knows its placement (one supervised
         # process per gang on the simulated rig → a 1-entry host_map on
         # the gang's primary host) and can ask the pool whether a host
         # is down — the authoritative channel for host-granular budget
-        # accounting (one host death = one budget unit, see resilience)
+        # accounting (one host death = one budget unit, see resilience).
+        # A suspect mark is the OTHER answer: the monitor suspends the
+        # host's ranks instead of killing them (partition != death).
         host_kw: dict = {}
+        place_kw: dict = dict(nprocs=1)
         if job.hosts and self.pool is not None:
             pool = self.pool
             host_kw = dict(
                 host_map=[job.hosts[0]],
-                host_down_probe=lambda h: pool.state.get(h) == HOST_LOST)
+                host_down_probe=lambda h: pool.state.get(h) == HOST_LOST,
+                host_suspect_probe=(
+                    lambda h: pool.state.get(h) == HOST_SUSPECT))
+            transport = self._job_transport(job)
+            if transport is not None:
+                # gang rides the transport: ssh wire format, staged
+                # beats + lease discipline, host-local checkpoints
+                place_kw = dict(
+                    hosts=[pool.spec(job.hosts[0]).addr],
+                    transport=transport)
         return ResilientRunner(
-            cmd, nprocs=1, platform=self.platform,
+            cmd, platform=self.platform,
             timeout=job.spec.timeout_s,
             policy=RestartPolicy(max_restarts=job.spec.max_restarts,
                                  backoff_base=self.backoff_base),
@@ -747,7 +798,7 @@ class FleetScheduler:
                                  f"ep_{job.episodes:03d}"),
             extra_env=env,
             on_spawn=lambda procs: self._on_spawn(job, procs),
-            **host_kw)
+            **place_kw, **host_kw)
 
     def _on_spawn(self, job: FleetJob, procs: list) -> None:
         """Runs on the supervisor thread at every (re)launch: record the
@@ -763,6 +814,44 @@ class FleetScheduler:
         if job.preempt_requested:
             self._signal_job(job, signal.SIGTERM)
 
+    def _ship_checkpoints(self, job: FleetJob, transport) -> None:
+        """Pre-launch checkpoint locality: a gang placed (over a remote
+        transport) on a host whose local checkpoint dir lacks the newest
+        valid round pulls it from wherever the job last checkpointed —
+        crc-verified resumable chunks, sha256-checked against the
+        manifest at the destination, manifest shipped last.  A ship that
+        ultimately fails is loud but not fatal: the gang launches from
+        whatever state its host has (an older round resumes correctly,
+        just further back; round 0 launches cold)."""
+        from .transport import (TransportError, newest_valid_round,
+                                ship_latest_checkpoint)
+        dst = job.active_ckpt_dir
+        best_dir, best_r = None, None
+        for d in job.ckpt_dirs():
+            if os.path.realpath(d) == os.path.realpath(dst):
+                continue
+            r = newest_valid_round(d)
+            if r is not None and (best_r is None or r > best_r):
+                best_dir, best_r = d, r
+        if best_dir is None:
+            return
+        try:
+            rec = ship_latest_checkpoint(transport, job.hosts[0],
+                                         best_dir, dst)
+        except (TransportError, OSError) as e:
+            print(f"fleet: checkpoint ship to {job.hosts[0]!r} failed "
+                  f"({e}); launching from local state", file=sys.stderr,
+                  flush=True)
+            self._journal_ev("ship_fail", job=job.name,
+                             host=job.hosts[0], error=str(e))
+            return
+        if rec and not rec.get("skipped"):
+            print(f"fleet: shipped round {rec['round']} checkpoint "
+                  f"({rec['bytes']} B) to {job.hosts[0]!r} for "
+                  f"{job.name!r}", file=sys.stderr, flush=True)
+            self._journal_ev("ship", job=job.name, host=job.hosts[0],
+                             **rec)
+
     def _launch(self, job: FleetJob, slots: tuple[int, ...]) -> None:
         job.slots = slots
         job.hosts = self.allocator.hosts_of(slots)
@@ -775,10 +864,19 @@ class FleetScheduler:
         job.signaled_pids = set()
         job.procs = []
         job.episodes += 1
+        transport = self._job_transport(job)
+        job.active_ckpt_dir = (job.host_ckpt_dir(job.hosts[0])
+                               if transport is not None and job.hosts
+                               else None)
+        if job.active_ckpt_dir is not None:
+            self._ship_checkpoints(job, transport)
         cmd = job.build_cmd()
         env = dict(self.extra_env)
         env.update(job.spec.env)
         env[ENV_JOB_TAG] = job.name
+        # fence base: each launch episode fences off every earlier one
+        # (the runner adds its attempt number — see resilience)
+        env["SPARKNET_FENCE_BASE"] = str(job.episodes * 100000)
         if job.hosts:
             # placement facts ride the env: the gang's primary host tag
             # plus the full per-slot host vector (informational on the
@@ -794,8 +892,12 @@ class FleetScheduler:
         if job.spec.fault:
             env["SPARKNET_FAULT"] = job.spec.fault
         job.runner = self.runner_factory(job, cmd, env)
+        tkind = transport.kind if transport is not None else "local"
+        for h in job.hosts:
+            self._host_transports[h] = tkind
         self._journal_ev("launch", job=job.name, episode=job.episodes,
-                         slots=list(slots), hosts=list(job.hosts), cmd=cmd)
+                         slots=list(slots), hosts=list(job.hosts), cmd=cmd,
+                         transport=tkind)
         job.thread = threading.Thread(
             target=self._supervise, args=(job, job.runner),
             name=f"fleet-{job.name}", daemon=True)
@@ -906,7 +1008,13 @@ class FleetScheduler:
         offering the host's slots.  ``lost`` (the machine is gone) is
         the abrupt path: every touching gang is killed outright and
         requeued onto surviving hosts, checkpoint-resumed bit-identical.
-        ``live`` readmits the host's slots to placement."""
+        ``suspect`` (the LINK is silent but the machine may be alive —
+        a lease expiry, not a death certificate) deliberately touches
+        no gang: placement stops, the per-job health monitor suspends
+        straggler discipline for the host's ranks, and nothing is
+        killed or requeued until a down-probe confirms death or an
+        operator marks it lost.  ``live`` readmits the host's slots to
+        placement (for a suspect host, that is the heal)."""
         if self.pool is None:
             raise FleetError("mark_host needs a HostPool "
                              "(scheduler built with total_devices only)")
@@ -1265,7 +1373,10 @@ class FleetScheduler:
                            "free": self.allocator.free_count},
                "tenants": by_tenant, "jobs": jobs}
         if self.pool is not None:
-            out["hosts"] = hosts_view(self.pool, jobs)
+            out["hosts"] = hosts_view(
+                self.pool, jobs,
+                beat_ages=host_beat_ages(self.workdir, jobs),
+                transports=self._host_transports)
         serving = serving_status(self.workdir, jobs)
         if serving:
             out["serving"] = serving
@@ -1398,11 +1509,56 @@ class FleetScheduler:
                     pass
 
 
-def hosts_view(pool: HostPool, jobs: list[dict]) -> dict[str, dict]:
+def host_beat_ages(workdir: str, jobs: list[dict]) -> dict[str, float]:
+    """Newest relayed-beat age per host, scanned from each running
+    job's newest attempt heartbeat tree.  Remote-transport gangs write
+    (via the relay) into ``host_<name>`` subdirs, so attribution is
+    direct; a single-host gang's flat rank beats are attributed to its
+    only host.  Powers the lease column of the status host rows — live
+    and offline read the same files."""
+    from . import health
+    ages: dict[str, float] = {}
+
+    def fold(host: str, beats: dict) -> None:
+        if not beats:
+            return
+        age = min(b.age() for b in beats.values())
+        if host not in ages or age < ages[host]:
+            ages[host] = age
+
+    for j in jobs:
+        hosts = j.get("hosts") or []
+        if not hosts or j.get("state") not in (RUNNING, PREEMPTING):
+            continue
+        attempts = sorted(glob.glob(os.path.join(
+            os.path.abspath(workdir), "jobs", j["job"],
+            "runner", "ep_*", "attempt_*", "hb")))
+        if not attempts:
+            continue
+        for host, beats in health.read_hosts(attempts[-1]).items():
+            if host is None:
+                if len(hosts) == 1:
+                    fold(hosts[0], beats)
+            elif host in hosts:
+                fold(host, beats)
+    return ages
+
+
+def hosts_view(pool: HostPool, jobs: list[dict], *,
+               beat_ages: Mapping[str, float] | None = None,
+               transports: Mapping[str, str] | None = None
+               ) -> dict[str, dict]:
     """The hosts section of a status view: per-host liveness state,
-    device budget/usage, and which gangs sit on it — computed the same
+    device budget/usage, which gangs sit on it — computed the same
     way live and offline (slot→host is deterministic: consecutive
-    ranges in inventory order)."""
+    ranges in inventory order) — plus, when the caller supplies them,
+    the network-liveness columns: ``beat_age_s`` (newest relayed beat,
+    see :func:`host_beat_ages`), ``transport`` (kind of the last launch
+    that placed a gang there), and ``lease`` — the operator state
+    verbatim when not live, else ``suspect`` iff a hosted gang's beats
+    have gone silent past the lease window, else ``live``.  A live host
+    with gangs but no beats yet is still ``live`` (startup grace,
+    mirroring the in-gang LeaseMonitor)."""
     slot_host: dict[int, str] = {}
     i = 0
     for h in pool.specs():
@@ -1422,6 +1578,21 @@ def hosts_view(pool: HostPool, jobs: list[dict]) -> dict[str, dict]:
         for host in j.get("hosts") or []:
             if host in out and j["job"] not in out[host]["gangs"]:
                 out[host]["gangs"].append(j["job"])
+    window: float | None = None
+    for name, row in out.items():
+        age = (beat_ages or {}).get(name)
+        if age is not None:
+            row["beat_age_s"] = round(age, 2)
+        row["transport"] = (transports or {}).get(name, "local")
+        if row["state"] != HOST_LIVE:
+            row["lease"] = row["state"]
+        elif row["gangs"] and age is not None:
+            if window is None:
+                from .health import lease_window_s
+                window = lease_window_s()
+            row["lease"] = ("suspect" if age > window else "live")
+        else:
+            row["lease"] = "live"
     return out
 
 
@@ -1505,6 +1676,7 @@ def offline_status(workdir: str) -> dict[str, Any]:
     state: dict[str, str] = {}
     slots: dict[str, list[int]] = {}
     job_hosts: dict[str, list[str]] = {}
+    host_transports: dict[str, str] = {}
     counters: dict[str, dict[str, int]] = {}
     for ev in events:
         kind = ev.get("ev")
@@ -1528,6 +1700,8 @@ def offline_status(workdir: str) -> dict[str, Any]:
             state[name] = RUNNING
             slots[name] = list(ev.get("slots", []))
             job_hosts[name] = list(ev.get("hosts") or [])
+            for h in job_hosts[name]:
+                host_transports[h] = ev.get("transport", "local")
             c["episodes"] = ev.get("episode", c["episodes"] + 1)
         elif kind == "pids":
             c["attempts"] += 1
@@ -1613,7 +1787,9 @@ def offline_status(workdir: str) -> dict[str, Any]:
     out = {"devices": {"total": devices, "free": max(free, 0)},
            "tenants": by_tenant, "jobs": jobs}
     if pool is not None:
-        out["hosts"] = hosts_view(pool, jobs)
+        out["hosts"] = hosts_view(
+            pool, jobs, beat_ages=host_beat_ages(workdir, jobs),
+            transports=host_transports)
     serving = serving_status(os.path.abspath(workdir), jobs)
     if serving:
         out["serving"] = serving
@@ -1691,9 +1867,16 @@ def format_status(status: Mapping[str, Any]) -> str:
             f"{j['episodes']:>3} {j['preempts']:>3}  {hb}")
     for hname, h in (status.get("hosts") or {}).items():
         gangs = ",".join(h.get("gangs") or []) or "-"
+        extra = ""
+        if h.get("lease"):
+            extra += f" lease={h['lease']}"
+        if h.get("beat_age_s") is not None:
+            extra += f" beat={h['beat_age_s']:.1f}s"
+        if h.get("transport") and h["transport"] != "local":
+            extra += f" via={h['transport']}"
         lines.append(f"host:    {hname:<16} {h.get('state', '?'):<9} "
                      f"{h.get('used', 0)}/{h.get('devices', 0)} devices "
-                     f"@{h.get('addr', '?')} gangs={gangs}")
+                     f"@{h.get('addr', '?')} gangs={gangs}{extra}")
     serving = status.get("serving") or {}
     auto = (serving.get("autoscale") or {}).get("models") or {}
     for model, m in sorted((serving.get("models") or {}).items()):

@@ -184,6 +184,8 @@ class ResilientRunner:
                  jitter_rng: random.Random | None = None,
                  host_map: list | None = None,
                  host_down_probe: Callable[[str], bool] | None = None,
+                 host_suspect_probe: Callable[[str], bool] | None = None,
+                 transport=None,
                  on_spawn: Callable[[list], None] | None = None):
         if (nprocs is None) == (hosts is None):
             raise ValueError("exactly one of nprocs / hosts is required")
@@ -213,6 +215,8 @@ class ResilientRunner:
         self._pending_host_drop: str | None = None
         self.host_map = [str(h) for h in host_map] if host_map else None
         self.host_down_probe = host_down_probe
+        self.host_suspect_probe = host_suspect_probe
+        self.transport = transport
         if self.host_map is not None and len(self.host_map) != \
                 self.world_size():
             raise ValueError(
@@ -328,6 +332,13 @@ class ResilientRunner:
         env["SPARKNET_FAULT_ATTEMPT"] = str(attempt)
         env["SPARKNET_RESTART_COUNT"] = str(attempt)
         env["SPARKNET_INCARNATION"] = str(self.incarnation)
+        # incarnation fence token: fleet episode base + attempt, strictly
+        # increasing across every relaunch of the same logical job — the
+        # checkpoint layer uses it to refuse zombie writers (only when a
+        # fleet-level base is present; standalone runners stay unfenced)
+        base = self.extra_env.get("SPARKNET_FENCE_BASE")
+        if base:
+            env["SPARKNET_FENCE_TOKEN"] = str(int(base) + attempt)
         adir = self._attempt_dir(attempt)
         health_kw = dict(
             heartbeat_dir=os.path.join(adir, "hb"),
@@ -342,7 +353,10 @@ class ResilientRunner:
                               cwd=self.cwd, timeout=self.timeout,
                               platform=self.platform,
                               devices_per_proc=self.devices_per_proc,
-                              extra_env=env, **health_kw)
+                              extra_env=env, transport=self.transport,
+                              host_suspect_probe=self.host_suspect_probe,
+                              host_down_probe=self.host_down_probe,
+                              **health_kw)
         return launch_local(self.cmd, self.nprocs, platform=self.platform,
                             devices_per_proc=self.devices_per_proc,
                             coordinator=f"127.0.0.1:{free_port()}",

@@ -734,7 +734,7 @@ class ServingFleet:
         RUNNING replicas join (or re-join at a fresh URL after a
         restart), jobs that left RUNNING are pruned, and the state
         snapshots for offline status views are refreshed."""
-        from .fleet import HOST_LOST, PREEMPTING, RUNNING
+        from .fleet import HOST_LOST, HOST_SUSPECT, PREEMPTING, RUNNING
         pool = getattr(self.sched, "pool", None)
         for name in list(self._model_of):
             job = self.sched.jobs.get(name)
@@ -742,6 +742,17 @@ class ServingFleet:
                 continue
             registered = name in self.router.replica_ids(live_only=False)
             if job.state == RUNNING:
+                # a replica behind a SUSPECT link is unroutable but NOT
+                # dead: unroute it now (requests take bounded failover
+                # to reachable replicas) and let the normal re-admission
+                # below re-add it the poll after its host heals — its
+                # process never stopped, its endpoint is still live
+                if registered and pool is not None and any(
+                        pool.state.get(h) == HOST_SUSPECT
+                        for h in getattr(job, "hosts", ())):
+                    self.router.mark_dead(name, "host suspect")
+                    self._endpoints.pop(name, None)
+                    continue
                 ep = self._read_endpoint(job)
                 if ep and (not registered
                            or self._endpoints.get(name) != ep["url"]):

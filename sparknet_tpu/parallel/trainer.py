@@ -1233,9 +1233,10 @@ class DistributedTrainer:
 
     def _save_round_checkpoint_impl(
             self, directory: str | None = None) -> str | None:
-        from ..utils import faults
+        from ..utils import faults, knobs
         from ..utils.checkpoint import (
-            AsyncCheckpointWriter, save_checkpoint, snapshot_tree,
+            AsyncCheckpointWriter, CheckpointFencedError, advance_fence,
+            check_fence, save_checkpoint, snapshot_tree,
         )
         directory = directory or self.config.checkpoint_dir
         if not directory:
@@ -1249,6 +1250,12 @@ class DistributedTrainer:
         if jax.process_index() != 0:
             return None
         os.makedirs(directory, exist_ok=True)
+        # incarnation fencing: claim the dir with our launch-stamped
+        # token (0 = unmanaged, fencing inert).  A zombie writer from a
+        # fenced-off incarnation is refused HERE, before any bytes move
+        fence_token = knobs.get_int("SPARKNET_FENCE_TOKEN", 0)
+        if fence_token:
+            advance_fence(directory, fence_token)
         # capture the round-scoped fields NOW — on the async path the
         # trainer's counters will have moved on by write time
         round_now, iter_now = self.round, self.iter
@@ -1267,6 +1274,7 @@ class DistributedTrainer:
         }
 
         def job() -> None:
+            check_fence(directory, fence_token)
             save_checkpoint(path, blob)
             # torn-write chaos window: the npz is durable, the manifest is
             # not yet — crash_in_ckpt kills HERE; resume must treat the
@@ -1278,6 +1286,7 @@ class DistributedTrainer:
             # catches it)
             corrupt = injector.corrupt_checkpoint(round_now)
             manifest["sha256"] = _sha256_file(path)
+            manifest["fence_token"] = fence_token
             mpath = os.path.join(directory,
                                  f"manifest_{round_now:08d}.json")
             # unique temp name (pid-stamped): a crashed writer's leftover
@@ -1286,6 +1295,19 @@ class DistributedTrainer:
             tmp = f"{mpath}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(manifest, f, indent=1)
+            # rename-time fence: the LAST gate before the checkpoint
+            # becomes visible.  A successor may have claimed the dir
+            # while our npz was in flight (the zombie-writer window) —
+            # refuse, and leave zero new state behind
+            try:
+                check_fence(directory, fence_token)
+            except CheckpointFencedError:
+                for p in (tmp, path):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                raise
             os.replace(tmp, mpath)  # manifest appears atomically, last
             if corrupt:
                 print(f"FAULT: corrupt_ckpt scribbling {path}",
@@ -1337,14 +1359,22 @@ class DistributedTrainer:
         search (the audit's rollback horizon: newer checkpoints may carry
         an unverified divergence).  Returns the manifest resumed from, or
         None when no valid checkpoint exists."""
+        from ..utils import knobs
         from ..utils.checkpoint import (
-            CheckpointError, flush_all_writers, load_checkpoint,
+            CheckpointError, advance_fence, flush_all_writers,
+            load_checkpoint,
         )
         # async tier: settle every in-flight background write (this
         # trainer's AND any other live instance writing the same
         # directory) before scanning — the newest manifest must not be
         # sitting in a writer queue when we look for it
         flush_all_writers()
+        # claim the dir for OUR incarnation before reading: from here a
+        # zombie writer from a fenced-off predecessor refuses at its
+        # next fence check instead of clobbering what we resume from
+        fence_token = knobs.get_int("SPARKNET_FENCE_TOKEN", 0)
+        if fence_token and os.path.isdir(directory):
+            advance_fence(directory, fence_token)
         for mpath in sorted(
                 glob.glob(os.path.join(directory, "manifest_*.json")),
                 key=_manifest_round, reverse=True):

@@ -162,13 +162,25 @@ def _stream(prefix: str, pipe, log_path: str | None = None) -> None:
             log.close()
 
 
-def _make_monitor(heartbeat_dir: str | None, round_deadline: float | None):
+def _make_monitor(heartbeat_dir: str | None, round_deadline: float | None,
+                  *, host_map: list | None = None, transport=None,
+                  host_suspect_probe=None, host_down_probe=None):
     if not (heartbeat_dir and round_deadline):
         return None
     # lazy import: the health plane is optional and the launcher should
     # stay importable without it on minimal rigs
-    from ..parallel.health import StragglerMonitor
+    from ..parallel.health import GangHealth, StragglerMonitor
     os.makedirs(heartbeat_dir, exist_ok=True)
+    # lease-aware gang monitor when beats ride a remote transport (the
+    # relay is part of the tick) or the fleet can mark hosts suspect —
+    # either way partition-vs-death discipline applies
+    if host_map is not None and (
+            (transport is not None and not transport.local)
+            or host_suspect_probe is not None):
+        return GangHealth(heartbeat_dir, round_deadline, host_map=host_map,
+                          transport=transport,
+                          suspect_probe=host_suspect_probe,
+                          down_probe=host_down_probe)
     return StragglerMonitor(heartbeat_dir, round_deadline)
 
 
@@ -258,36 +270,60 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
                platform: str | None = None,
                devices_per_proc: int | None = None,
                host_map: list | None = None,
-               on_spawn=None) -> int:
-    """Run ``cmd`` on every host via ssh; host 0 doubles as coordinator.
-    The health plane (``heartbeat_dir``/``round_deadline``) requires the
-    dir to be on a filesystem shared with the supervisor — the same
-    assumption the checkpoint dir makes.  Addresses in ``LOCAL_ADDRS``
-    are spawned directly (no ssh wrapping) with the same env contract —
-    that is the simulated N-host pod rig: a HostPool whose entries all
-    say ``local`` exercises every cross-host path on one CPU box.
-    ``platform``/``devices_per_proc`` apply to those local spawns (remote
-    hosts see their chips natively).  ``host_map`` gives each rank its
-    host *label* (defaults to its address) for beacon routing and the
-    SPARKNET_FLEET_HOST tag.  ``on_spawn`` receives the local ``Popen``
-    handles (signalling an ssh one ends its remote command via the ssh
-    session, so preemption still works, host by host)."""
+               on_spawn=None,
+               transport=None,
+               host_suspect_probe=None,
+               host_down_probe=None) -> int:
+    """Run ``cmd`` on every host via the host transport; host 0 doubles
+    as coordinator.  ``transport`` (a ``parallel.transport.HostTransport``)
+    is the exec/ship/beat seam; when omitted it is chosen from the env —
+    ssh when ``SPARKNET_SSH_CMD`` is set or any address is remote, local
+    otherwise, chaos-wrapped when network faults are active.  Addresses
+    in ``LOCAL_ADDRS`` are spawned directly ONLY under a local transport;
+    with SPARKNET_SSH_CMD set even ``localhost`` rides the ssh wire
+    format (that is the CI fake-ssh rig — the argv/env/stdio plumbing is
+    the production path, no sshd required).
+
+    Health plane: under a local transport ranks beat straight into the
+    shared ``heartbeat_dir``; under a remote one each rank beats into a
+    host-local staging dir and the supervisor's monitor relays beats
+    back over the transport each tick, with LEASE discipline on top —
+    a whole-host beacon silence marks the host SUSPECT and *suspends*
+    its ranks (a network partition must not kill a healthy gang or burn
+    restart budget) unless ``host_down_probe`` confirms real death, in
+    which case the straggler kill proceeds and the resilience layer
+    takes the lost-host path.  ``host_suspect_probe`` lets the fleet
+    feed externally-known suspicion into the same suspension.
+
+    ``platform``/``devices_per_proc`` apply to direct local spawns
+    (remote hosts see their chips natively).  ``host_map`` gives each
+    rank its host *label* (defaults to its address) for beacon routing
+    and the SPARKNET_FLEET_HOST tag.  ``on_spawn`` receives the local
+    ``Popen`` handles (signalling an ssh one ends its remote command via
+    the ssh session, so preemption still works, host by host)."""
     _check_host_map(host_map, len(hosts))
     if host_map is None:
         host_map = [str(h) for h in hosts]
+    if transport is None:
+        from ..parallel.transport import default_transport
+        transport = default_transport(hosts)
     all_local = all(h in LOCAL_ADDRS for h in hosts)
     port = coordinator_port or (free_port() if all_local else 9876)
     addr0 = "127.0.0.1" if hosts[0] in LOCAL_ADDRS else hosts[0]
     coordinator = f"{addr0}:{port}"
     cwd = cwd or os.getcwd()
-    monitor = _make_monitor(heartbeat_dir, round_deadline)
+    monitor = _make_monitor(heartbeat_dir, round_deadline,
+                            host_map=host_map, transport=transport,
+                            host_suspect_probe=host_suspect_probe,
+                            host_down_probe=host_down_probe)
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
     procs = []
     threads = []
     for pid, host in enumerate(hosts):
-        hb = _rank_hb_dir(heartbeat_dir, host_map, pid)
-        if host in LOCAL_ADDRS:
+        direct = host in LOCAL_ADDRS and transport.local
+        if direct:
+            hb = _rank_hb_dir(heartbeat_dir, host_map, pid)
             env = _proc_env(os.environ, coordinator, len(hosts), pid,
                             platform, devices_per_proc, extra_env)
             if hb:
@@ -304,17 +340,19 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
                 ("SPARKNET_PROC_ID", str(pid)),
                 ("SPARKNET_FLEET_HOST", str(host_map[pid])),
             ]
-            if hb:
-                pairs.append(("SPARKNET_HEARTBEAT_DIR", hb))
+            if heartbeat_dir:
+                # remote ranks beat into host-local staging; the
+                # monitor's relay moves beats into host_<name>/ — the
+                # shared-filesystem assumption stops at the supervisor
+                from ..parallel.health import stage_dir
+                pairs.append(("SPARKNET_HEARTBEAT_DIR",
+                              stage_dir(heartbeat_dir,
+                                        str(host_map[pid]))))
             if extra_env:
                 pairs.extend((k, str(v)) for k, v in extra_env.items())
-            envs = " ".join(f"{k}={v!r}" for k, v in pairs)
-            remote = f"cd {cwd} && env {envs} " + " ".join(cmd)
-            p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host, remote],
-                                 stdout=subprocess.PIPE,
-                                 stderr=subprocess.STDOUT)
+            p = transport.popen(host, cmd, env_pairs=pairs, cwd=cwd)
         log = os.path.join(log_dir, f"rank_{pid}.log") if log_dir else None
-        tag = host_map[pid] if host in LOCAL_ADDRS else host
+        tag = host_map[pid] if direct else host
         t = threading.Thread(target=_stream, args=(tag, p.stdout, log),
                              daemon=True)
         t.start()
@@ -325,6 +363,11 @@ def launch_ssh(cmd: list[str], hosts: list[str], *,
     rc = _wait_all(procs, timeout, monitor=monitor, report=report)
     for t in threads:
         t.join(timeout=5)
+    if report is not None:
+        report["transport"] = transport.kind
+        if monitor is not None and hasattr(monitor, "ever_suspect"):
+            report["suspect_hosts"] = sorted(monitor.ever_suspect)
+            report["confirmed_down"] = sorted(monitor.confirmed_down)
     return rc
 
 

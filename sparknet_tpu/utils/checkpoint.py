@@ -58,6 +58,70 @@ class CheckpointError(Exception):
         self.path = path
 
 
+class CheckpointFencedError(CheckpointError):
+    """A writer from a fenced-off incarnation tried to publish into a
+    checkpoint dir a successor has claimed — the zombie-writer refusal.
+    Carries the writer's ``token`` and the dir's current ``fence``."""
+
+    def __init__(self, path: str, token: int, fence: int):
+        super().__init__(
+            f"incarnation fence: writer token {token} < dir fence "
+            f"{fence} — a successor owns this checkpoint dir; refusing "
+            f"to publish", path)
+        self.token = token
+        self.fence = fence
+
+
+# -- incarnation fencing ----------------------------------------------------
+# A checkpoint dir carries a monotonic fence token (FENCE.json).  Every
+# writer claims the dir with its own incarnation token before writing;
+# a claim can only RAISE the fence.  A gang requeued past a partition
+# gets a strictly larger token (fleet episode x 1e5 + restart attempt,
+# stamped into SPARKNET_FENCE_TOKEN by the launch stack), so a zombie
+# writer returning from behind the partition finds fence > token and is
+# refused with ``CheckpointFencedError`` BEFORE its npz write and again
+# at manifest-rename time — the successor's state is never clobbered.
+# The discipline is cooperative and targets STALE writers (whose tokens
+# are, by construction, lower); it is not a general concurrent-writer
+# lock.
+
+FENCE_FILE = "FENCE.json"
+
+
+def read_fence(directory: str) -> int:
+    """The dir's current fence token (0 = never claimed/unreadable)."""
+    try:
+        with open(os.path.join(directory, FENCE_FILE)) as f:
+            return int(json.load(f)["token"])
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return 0
+
+
+def check_fence(directory: str, token: int) -> None:
+    """Raise ``CheckpointFencedError`` when ``directory`` has been
+    claimed by a higher incarnation than ``token``."""
+    fence = read_fence(directory)
+    if fence > token:
+        raise CheckpointFencedError(os.path.join(directory, FENCE_FILE),
+                                    token, fence)
+
+
+def advance_fence(directory: str, token: int) -> int:
+    """Claim ``directory`` for incarnation ``token`` (monotonic max,
+    atomic tmp+rename).  Returns the resulting fence.  A claim BELOW the
+    current fence raises — the claimant is the zombie."""
+    check_fence(directory, token)
+    fence = max(read_fence(directory), token)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, FENCE_FILE)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"token": fence, "pid": os.getpid(),
+                   "time": round(time.time(), 3)}, f)
+    os.replace(tmp, path)
+    return fence
+
+
 def _flatten(tree: Any, prefix: str, out: dict[str, np.ndarray],
              meta: dict[str, Any]) -> None:
     if isinstance(tree, dict):

@@ -52,6 +52,32 @@ first-class, deterministic test input.  Faults are described by the
                                 + a clean rc-0 exit, and the fleet layer
                                 must requeue-and-resume the job — NOT
                                 count it complete
+              | partition     — needs @host:NAME: sever the network link
+                                to that host at the transport layer
+                                (parallel/transport.ChaosTransport) —
+                                its processes stay ALIVE but beats stop
+                                arriving and new exec/ship calls fail.
+                                The lease layer must mark the host
+                                SUSPECT (never LOST) and suspend its
+                                gang without burning restart budget
+              | heal          — needs @host:NAME: undo a partition — the
+                                link comes back, relayed beats flow
+                                again, the suspended gang resumes
+              | slow_link     — arg = per-operation delay ("50ms"),
+                                needs @host:NAME: a degraded link — every
+                                transport op to that host pays the delay
+                                (the straggler-ATTRIBUTION case: slow,
+                                not dead, and the blame must land on the
+                                link, not the chip)
+              | drop_ship     — arg = probability p in (0, 1]: each
+                                artifact-shipping call fails with that
+                                deterministic per-call probability — the
+                                retry/backoff path must absorb it
+              | torn_ship     — the next shipping call writes a partial
+                                destination file then fails (a torn
+                                transfer); the crc-verified resume must
+                                detect and finish it, never serve the
+                                torn prefix.  Fires once per process
 
 Scoping:
   @round:N   — fire at round N (required for crash/hang/straggle/
@@ -98,14 +124,22 @@ from typing import Callable, Mapping
 
 KINDS = ("crash", "perma_crash", "hang", "straggle", "slow_feed",
          "nan_inject", "corrupt_ckpt", "crash_in_ckpt", "corrupt_record",
-         "feeder_die", "feeder_hang", "bitflip_params", "preempt")
+         "feeder_die", "feeder_hang", "bitflip_params", "preempt",
+         "partition", "heal", "slow_link", "drop_ship", "torn_ship")
+
+# the network kinds: consumed by parallel/transport.ChaosTransport, not
+# by the in-process hook points
+NET_KINDS = ("partition", "heal", "slow_link", "drop_ship", "torn_ship")
+# network kinds that must name the host whose link they describe
+_NEED_HOST = ("partition", "heal", "slow_link")
 
 # kinds that keep firing on every job attempt unless @attempt pins one
-_EVERY_ATTEMPT = ("slow_feed", "perma_crash", "corrupt_record")
+# (network state belongs to the link, not to any one attempt)
+_EVERY_ATTEMPT = ("slow_feed", "perma_crash", "corrupt_record") + NET_KINDS
 # kinds whose ':' arg is a duration
-_DURATION_ARG = ("slow_feed", "straggle", "feeder_hang")
+_DURATION_ARG = ("slow_feed", "straggle", "feeder_hang", "slow_link")
 # kinds whose ':' arg is a probability in (0, 1]
-_PROB_ARG = ("corrupt_record",)
+_PROB_ARG = ("corrupt_record", "drop_ship")
 # kinds that must name a round (for feeder_* the "round" is the batch
 # sequence index the prefetch feeder is about to produce)
 _NEED_ROUND = ("crash", "hang", "straggle", "nan_inject", "crash_in_ckpt",
@@ -118,8 +152,9 @@ class FaultSpec:
     round: int | None = None
     rank: int | None = None
     attempt: int | None = None     # None => kind-specific default (see doc)
-    delay_s: float = 0.0           # slow_feed / straggle / feeder_hang only
-    prob: float = 0.0              # corrupt_record only
+    delay_s: float = 0.0           # slow_feed/straggle/feeder_hang/slow_link
+    prob: float = 0.0              # corrupt_record / drop_ship only
+    host: str | None = None        # partition / heal / slow_link only
 
 
 def _parse_duration(text: str) -> float:
@@ -171,12 +206,17 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
         elif arg:
             raise ValueError(f"{kind} takes no ':' arg (got {raw!r})")
         fields: dict[str, int] = {}
+        host: str | None = None
         for mod in mods:
             key, _, val = mod.partition(":")
             key = key.strip()
-            if key not in ("round", "rank", "attempt") or not val:
+            if key not in ("round", "rank", "attempt", "host") or not val:
                 raise ValueError(f"bad modifier {mod!r} in {raw!r} "
-                                 f"(want @round:N / @rank:R / @attempt:A)")
+                                 f"(want @round:N / @rank:R / @attempt:A "
+                                 f"/ @host:NAME)")
+            if key == "host":
+                host = val.strip()
+                continue
             try:
                 fields[key] = int(val)
             except ValueError:
@@ -184,6 +224,11 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
                     f"modifier {mod!r} in {raw!r}: not an integer") from None
         if kind in _NEED_ROUND and "round" not in fields:
             raise ValueError(f"{kind} needs @round:N ({raw!r})")
+        if kind in _NEED_HOST and host is None:
+            raise ValueError(f"{kind} needs @host:NAME ({raw!r}) — a "
+                             f"link fault must name whose link")
+        if host is not None and kind not in NET_KINDS:
+            raise ValueError(f"{kind} takes no @host modifier ({raw!r})")
         if kind == "perma_crash" and "rank" not in fields:
             raise ValueError(
                 f"perma_crash needs @rank:R ({raw!r}) — a rankless "
@@ -196,7 +241,7 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
         specs.append(FaultSpec(kind=kind, round=fields.get("round"),
                                rank=fields.get("rank"),
                                attempt=fields.get("attempt"),
-                               delay_s=delay, prob=prob))
+                               delay_s=delay, prob=prob, host=host))
     return tuple(specs)
 
 
@@ -331,6 +376,38 @@ class FaultInjector:
             h = zlib.crc32(f"corrupt_record:{seq}".encode()) & 0xFFFFFFFF
             if h < spec.prob * 2**32:
                 return True
+        return False
+
+    def net_specs(self) -> tuple[FaultSpec, ...]:
+        """The active network-fault specs (partition/heal/slow_link) —
+        ``parallel.transport.ChaosTransport`` seeds its link state from
+        these at construction time."""
+        return tuple(s for s in self.specs
+                     if s.kind in ("partition", "heal", "slow_link")
+                     and self._active(s, None))
+
+    def drop_ship(self, seq: int) -> bool:
+        """True when shipping call number ``seq`` should fail — a pure
+        function of ``seq`` (like ``corrupt_record``) so a retried ship
+        sequence hits the SAME drops on replay."""
+        for spec in self.specs:
+            if spec.kind != "drop_ship" or not self._active(spec, None):
+                continue
+            h = zlib.crc32(f"drop_ship:{seq}".encode()) & 0xFFFFFFFF
+            if h < spec.prob * 2**32:
+                return True
+        return False
+
+    def torn_ship(self) -> bool:
+        """True when the NEXT shipping call should tear mid-transfer
+        (partial destination bytes, then failure).  At most once per
+        process: the resumed transfer must run clean."""
+        for spec in self.specs:
+            if (spec.kind != "torn_ship" or spec in self._fired
+                    or not self._active(spec, None)):
+                continue
+            self._fired.add(spec)
+            return True
         return False
 
     def feeder_event(self, batch_idx: int,

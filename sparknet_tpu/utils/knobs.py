@@ -240,6 +240,41 @@ _register(
          "Directory for liveness beat files; empty disables the health "
          "plane.",
          "sparknet_tpu/parallel/health.py"),
+    Knob("SPARKNET_LEASE_S", "float", "2",
+         "Heartbeat lease duration: a host whose relayed beats are older "
+         "than LEASE_S * LEASE_MISSES is SUSPECT (suspended, never "
+         "killed) until it heals or a down-probe confirms death.",
+         "sparknet_tpu/parallel/health.py"),
+    Knob("SPARKNET_LEASE_MISSES", "int", "3",
+         "Consecutive missed leases before a host turns SUSPECT.",
+         "sparknet_tpu/parallel/health.py"),
+    # --- host transport (the remote half of the pod fleet) ---
+    Knob("SPARKNET_SSH_CMD", "str", "",
+         "ssh binary for the SshTransport wire path (default 'ssh'); "
+         "point it at a local fake-ssh script to drive the real remote "
+         "argv/env/stdio plumbing in CI without an sshd.  Setting it "
+         "also makes named-but-loopback addresses (127.0.0.1, "
+         "localhost) take the ssh path.",
+         "sparknet_tpu/parallel/transport.py"),
+    Knob("SPARKNET_SHIP_CHUNK_MB", "float", "4",
+         "Chunk size (MB) for crc-verified artifact/checkpoint shipping "
+         "ranged reads.",
+         "sparknet_tpu/parallel/transport.py"),
+    Knob("SPARKNET_SHIP_RETRIES", "int", "4",
+         "Attempts for one artifact ship (resumable: each retry keeps "
+         "the destination's valid prefix).",
+         "sparknet_tpu/parallel/transport.py"),
+    Knob("SPARKNET_FENCE_BASE", "int", "0",
+         "Fleet-stamped incarnation fence base (episode * 1e5); the "
+         "runner adds its attempt number to mint SPARKNET_FENCE_TOKEN. "
+         "0/unset = fencing off.",
+         "sparknet_tpu/parallel/resilience.py"),
+    Knob("SPARKNET_FENCE_TOKEN", "int", "0",
+         "This writer's incarnation fence token: checkpoint dirs refuse "
+         "publishes from tokens below the dir's claimed fence (the "
+         "zombie-writer guard).  Minted by the launch stack, not set by "
+         "hand.",
+         "sparknet_tpu/utils/checkpoint.py"),
     # --- checkpointing / IO ---
     Knob("SPARKNET_ASYNC_CKPT", "bool", "1",
          "Set to 0 to force synchronous checkpoint writes (default "
@@ -427,6 +462,11 @@ _register(
          "tools/run_tier1.sh"),
     Knob("SPARKNET_PODSOAK", "bool", "",
          "Set to 1 to run the simulated 3-host pod burn-in slice in "
+         "run_tier1.sh.",
+         "tools/run_tier1.sh"),
+    Knob("SPARKNET_NETSOAK", "bool", "",
+         "Set to 1 to run the network chaos burn-in (partition-suspend-"
+         "heal + fenced-zombie episodes over the fake-ssh transport) in "
          "run_tier1.sh.",
          "tools/run_tier1.sh"),
     Knob("SPARKNET_SOAK_QPS", "float", "4.0",

@@ -637,6 +637,46 @@ def entries_from_podsoak(doc: Mapping[str, Any],
                        **prov)]
 
 
+def entries_from_netsoak(doc: Mapping[str, Any],
+                         path: str | None = None, *,
+                         round_tag: str | None = None,
+                         t: float | None = None,
+                         device_hint: str | None = None) -> list[dict]:
+    """tools/soak.py ``--net`` verdicts (SOAK_net_*): the network chaos
+    legs.  The banded numbers are the partition-recovery wall time (the
+    suspend→heal→bit-identical episode end to end), the fenced-ship
+    transfer rate, and the fenced-resume episode wall — the costs a
+    transport regression would move first."""
+    if doc.get("mode") != "net" or not doc.get("episodes"):
+        return []
+    by_name = {ep.get("episode"): ep for ep in doc["episodes"]}
+    prov = _prov_fields(doc)
+    fp = fingerprint(model="lenet", dtype="f32", world=4,
+                     device=device_hint)
+    metrics: dict[str, Any] = {}
+    part = by_name.get("partition_suspend_heal")
+    if part:
+        metrics["netsoak_partition_recovery_s"] = part.get("elapsed_s")
+    fenced = by_name.get("fenced_zombie_ship")
+    if fenced:
+        metrics["netsoak_fenced_resume_s"] = fenced.get("elapsed_s")
+        ship = fenced.get("ship") or {}
+        wall = ship.get("wall_s")
+        if wall and ship.get("bytes"):
+            metrics["netsoak_ship_mb_per_s"] = round(
+                ship["bytes"] / wall / 1e6, 3)
+    slow = by_name.get("slow_link_attribution")
+    if slow:
+        metrics["netsoak_slow_link_episode_s"] = slow.get("elapsed_s")
+    metrics = {k: v for k, v in metrics.items() if v is not None}
+    if not metrics:
+        return []
+    return [make_entry("netsoak", path, fp, metrics,
+                       round_tag=round_tag, t=t,
+                       notes=None if doc.get("ok") else "net soak FAILED",
+                       **prov)]
+
+
 def entries_from_roundbench(doc: Mapping[str, Any],
                             path: str | None = None, *,
                             round_tag: str | None = None,
@@ -802,6 +842,9 @@ def entries_from_any(doc: Mapping[str, Any], path: str | None = None, *,
                                           t=t, device_hint=device_hint)
     if doc.get("mode") == "pod" and "episodes" in doc:
         return entries_from_podsoak(doc, path, round_tag=round_tag, t=t,
+                                    device_hint=device_hint)
+    if doc.get("mode") == "net" and "episodes" in doc:
+        return entries_from_netsoak(doc, path, round_tag=round_tag, t=t,
                                     device_hint=device_hint)
     if doc.get("kind") == "tuning_table":
         return entries_from_tuning_table(doc, path, round_tag=round_tag,

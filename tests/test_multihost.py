@@ -139,6 +139,61 @@ def test_worker_death_is_reported_not_hung(tmp_path):
     assert time.monotonic() - t0 < 400, "launcher hung past its timeout"
 
 
+def test_ssh_wire_contract_single_host(tmp_path):
+    """The ssh wire itself, ungated: one host over the shim needs no
+    multiprocess XLA, so THIS leg pins the remote command construction
+    (BatchMode, cwd, env contract) on every tier-1 rig — including the
+    ones where the 2-host mesh test below must skip."""
+    from sparknet_tpu.tools.launch import free_port, launch_ssh
+
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    log = tmp_path / "ssh.log"
+    shim = shim_dir / "ssh"
+    shim.write_text(
+        "#!/bin/bash\n"
+        f"echo \"ARGS:$*\" >> {log}\n"
+        "exec bash -c \"$4\"\n")
+    shim.chmod(0o755)
+
+    single = str(tmp_path / "single.npz")
+    wired = str(tmp_path / "wired.npz")
+    _run_single(single, "sync")
+
+    old_env = dict(os.environ)
+    os.environ.pop("XLA_FLAGS", None)
+    for k in list(os.environ):
+        if k.startswith("SPARKNET_"):
+            os.environ.pop(k)
+    os.environ["SPARKNET_SSH_CMD"] = str(shim)
+    try:
+        rc = launch_ssh(
+            [sys.executable, DRIVER, "--strategy", "sync", "--out", wired,
+             "--local-devices", "4"],
+            hosts=["127.0.0.1"], coordinator_port=free_port(),
+            cwd=REPO, timeout=420)
+    finally:
+        os.environ.clear()
+        os.environ.update(old_env)
+    assert rc == 0, f"ssh-shim single-host run failed rc={rc}"
+
+    args = [l for l in log.read_text().strip().splitlines()
+            if l.startswith("ARGS:")]
+    assert len(args) == 1
+    a = args[0]
+    assert "-o BatchMode=yes" in a and "127.0.0.1" in a
+    assert f"cd {REPO}" in a
+    assert "SPARKNET_COORDINATOR=" in a
+    assert "SPARKNET_NUM_PROCS='1'" in a and "SPARKNET_PROC_ID='0'" in a
+
+    a, b = np.load(single), np.load(wired)
+    np.testing.assert_allclose(a["__losses__"], b["__losses__"],
+                               rtol=1e-5, atol=1e-6)
+    for k in a.files:
+        if not k.startswith("__"):
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6)
+
+
 def test_ssh_mode_via_shim(tmp_path, multiprocess_cpu):
     """Exercise launch_ssh end-to-end against a local `ssh` shim: the shim
     logs the wire command (host, BatchMode, env contract) and executes the
@@ -166,11 +221,13 @@ def test_ssh_mode_via_shim(tmp_path, multiprocess_cpu):
     _run_single(single, "sync")
 
     old_env = dict(os.environ)
-    os.environ["PATH"] = f"{shim_dir}:{os.environ['PATH']}"
     os.environ.pop("XLA_FLAGS", None)
     for k in list(os.environ):
         if k.startswith("SPARKNET_"):
             os.environ.pop(k)
+    # the fake-ssh knob: forces the ssh wire format even for localhost
+    # addresses (otherwise the local transport would spawn directly)
+    os.environ["SPARKNET_SSH_CMD"] = str(shim)
     try:
         rc = launch_ssh(
             [sys.executable, DRIVER, "--strategy", "sync", "--out", multi,

@@ -1,0 +1,458 @@
+"""The host-transport seam (parallel/transport.py) and the network
+survival discipline built on it: the ssh wire format under a fake-ssh
+shim (the CI rig for the production launch path), lease-based
+partition-vs-death classification (LeaseMonitor / GangHealth),
+crc-verified resumable checkpoint shipping, and incarnation fencing.
+
+None of these tests need the multiprocess-XLA fixture: workers are
+plain python subprocesses, so the ssh tier's argv/env/stdio contract is
+pinned on every tier-1 run, not only on rigs whose CPU backend supports
+multiprocess collectives."""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_shim(tmp_path):
+    """The fake-ssh shim: logs the exact wire argv, then executes the
+    remote command string locally (argv[4] = the `cd .. && env .. cmd`
+    string, exactly what sshd would hand the remote shell)."""
+    log = tmp_path / "ssh.log"
+    shim = tmp_path / "fake-ssh"
+    shim.write_text("#!/bin/bash\n"
+                    f"echo \"ARGS:$*\" >> {log}\n"
+                    "exec bash -c \"$4\"\n")
+    shim.chmod(0o755)
+    return shim, log
+
+
+# -- ssh wire format / env contract ---------------------------------------
+
+def test_launch_ssh_wire_format_and_env_contract(tmp_path, monkeypatch):
+    """launch_ssh over the fake-ssh shim: every rank rides the exact
+    production wire (`<ssh> -o BatchMode=yes <host> "cd <cwd> && env
+    K='v' ... cmd"`), and the remote process sees the full env contract
+    (coordinator, world size, proc id, host tag, extra env)."""
+    from sparknet_tpu.tools.launch import launch_ssh
+
+    shim, log = _make_shim(tmp_path)
+    monkeypatch.setenv("SPARKNET_SSH_CMD", str(shim))
+    out = tmp_path / "out"
+    out.mkdir()
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import json, os\n"
+        "keys = ['SPARKNET_COORDINATOR', 'SPARKNET_NUM_PROCS',\n"
+        "        'SPARKNET_PROC_ID', 'SPARKNET_FLEET_HOST', 'WIRE_EXTRA']\n"
+        "rec = {k: os.environ.get(k) for k in keys}\n"
+        "dst = os.path.join(os.environ['WIRE_OUT'],\n"
+        "                   os.environ['SPARKNET_PROC_ID'] + '.json')\n"
+        "with open(dst, 'w') as f:\n"
+        "    json.dump(rec, f)\n")
+
+    report = {}
+    rc = launch_ssh(
+        [sys.executable, str(worker)], hosts=["hosta", "hostb"],
+        cwd=str(tmp_path), timeout=120, report=report,
+        extra_env={"WIRE_OUT": str(out), "WIRE_EXTRA": "rode-the-wire"})
+    assert rc == 0, f"fake-ssh launch failed rc={rc}"
+    assert report["transport"] == "ssh"
+
+    # wire argv: one line per rank, exact ssh shape
+    args = [l for l in log.read_text().strip().splitlines()
+            if l.startswith("ARGS:")]
+    assert len(args) == 2
+    assert any(" hosta " in a for a in args)
+    assert any(" hostb " in a for a in args)
+    for a in args:
+        assert "-o BatchMode=yes" in a
+        assert f"cd {tmp_path}" in a
+        assert "SPARKNET_COORDINATOR=" in a
+        assert "SPARKNET_NUM_PROCS='2'" in a
+
+    # env contract as the remote process actually saw it
+    for pid, host in ((0, "hosta"), (1, "hostb")):
+        with open(out / f"{pid}.json") as f:
+            rec = json.load(f)
+        assert rec["SPARKNET_PROC_ID"] == str(pid)
+        assert rec["SPARKNET_NUM_PROCS"] == "2"
+        assert rec["SPARKNET_FLEET_HOST"] == host
+        assert rec["SPARKNET_COORDINATOR"].startswith("hosta:")
+        assert rec["WIRE_EXTRA"] == "rode-the-wire"
+
+
+def test_launch_ssh_teardown_on_first_death(tmp_path, monkeypatch):
+    """The first nonzero remote exit tears the whole gang down — the
+    surviving rank (asleep for 300s) must be killed well before both its
+    sleep and the launcher timeout."""
+    from sparknet_tpu.tools.launch import launch_ssh
+
+    shim, _ = _make_shim(tmp_path)
+    monkeypatch.setenv("SPARKNET_SSH_CMD", str(shim))
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os, sys, time\n"
+        "if os.environ.get('SPARKNET_PROC_ID') == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(300)\n")
+
+    report = {}
+    t0 = time.monotonic()
+    rc = launch_ssh([sys.executable, str(worker)],
+                    hosts=["hosta", "hostb"], cwd=str(tmp_path),
+                    timeout=120, report=report)
+    elapsed = time.monotonic() - t0
+    assert rc == 3, f"remote exit code must surface verbatim, got {rc}"
+    assert elapsed < 60, f"teardown took {elapsed:.0f}s — gang not torn " \
+                         f"down on first death"
+    assert report["cause"] == "exit"
+    assert report["first_failure"] == 1
+
+
+# -- lease-based liveness -------------------------------------------------
+
+def test_lease_monitor_states(tmp_path):
+    from sparknet_tpu.parallel import health
+
+    root = str(tmp_path / "hb")
+    t = {"now": 1000.0}
+    health.write_beat(health.host_dir(root, "a"), 0, 5, "round_start",
+                      clock=lambda: t["now"])
+    mon = health.LeaseMonitor(root, lease_s=1.0, misses=2,
+                              clock=lambda: t["now"])
+    assert mon.state("a") == health.LEASE_LIVE
+    assert mon.state("never-beat") == health.LEASE_NO_BEATS
+    t["now"] = 1003.0   # 3s silence > 1.0 x 2 window
+    assert mon.state("a") == health.LEASE_SUSPECT
+    assert mon.states(["a", "b"]) == {"a": health.LEASE_SUSPECT,
+                                      "b": health.LEASE_NO_BEATS}
+
+
+class _StubInjector:
+    """The three hooks ChaosTransport consumes, programmable."""
+
+    def __init__(self, drop_seqs=(), torn_count=0):
+        self.drop_seqs = set(drop_seqs)
+        self.torn_left = torn_count
+        self.specs = []
+
+    def net_specs(self):
+        return []
+
+    def drop_ship(self, seq):
+        return seq in self.drop_seqs
+
+    def torn_ship(self):
+        if self.torn_left > 0:
+            self.torn_left -= 1
+            return True
+        return False
+
+
+def test_gang_health_partition_suspends_then_heals(tmp_path):
+    """The partition-vs-death state machine: whole-host beat silence on
+    a non-local transport marks the host SUSPECT and suspends (not
+    kills) its ranks; when the link heals and beats flow again the host
+    returns to straggler discipline — no rank was ever flagged."""
+    from sparknet_tpu.parallel import health
+    from sparknet_tpu.parallel.transport import ChaosTransport, SshTransport
+
+    root = str(tmp_path / "hb")
+    t = {"now": 1000.0}
+    clk = lambda: t["now"]
+    chaos = ChaosTransport(SshTransport(), injector=_StubInjector())
+    lease = health.LeaseMonitor(root, lease_s=1.0, misses=2, clock=clk)
+    gh = health.GangHealth(root, 5.0, host_map=["a", "b"],
+                           transport=chaos, lease=lease, clock=clk)
+
+    def beat(rank, host):
+        health.write_beat(health.stage_dir(root, host), rank, 1,
+                          "round_start", clock=clk)
+
+    beat(0, "a")
+    beat(1, "b")
+    assert gh.check([0, 1]) == []
+    assert gh.suspect_hosts == set()
+
+    # sever the link to b: beats stop relaying, lease expires -> SUSPECT
+    chaos.partition("b")
+    t["now"] = 1003.0
+    beat(0, "a")
+    assert gh.check([0, 1]) == []
+    assert gh.suspect_hosts == {"b"}
+
+    # deep into straggler territory (7s > 5s deadline): rank 1 is
+    # shielded by the suspension — a partition must not kill the gang
+    t["now"] = 1007.0
+    beat(0, "a")
+    assert gh.check([0, 1]) == []
+
+    # heal: fresh beats relay, suspect clears, nobody was flagged
+    chaos.heal("b")
+    beat(1, "b")
+    beat(0, "a")
+    assert gh.check([0, 1]) == []
+    assert gh.suspect_hosts == set()
+    assert gh.ever_suspect == {"b"}
+
+
+def test_gang_health_down_probe_escalates_to_kill(tmp_path):
+    """Same silence signature, but the down-probe confirms real death:
+    suspension is bypassed and straggler discipline kills the rank (the
+    resilience layer then takes the PR 16 lost-host path)."""
+    from sparknet_tpu.parallel import health
+    from sparknet_tpu.parallel.transport import ChaosTransport, SshTransport
+
+    root = str(tmp_path / "hb")
+    t = {"now": 1000.0}
+    clk = lambda: t["now"]
+    chaos = ChaosTransport(SshTransport(), injector=_StubInjector())
+    lease = health.LeaseMonitor(root, lease_s=1.0, misses=2, clock=clk)
+    gh = health.GangHealth(root, 5.0, host_map=["a", "b"],
+                           transport=chaos, lease=lease, clock=clk,
+                           down_probe=lambda h: h == "b")
+
+    health.write_beat(health.stage_dir(root, "a"), 0, 1, "round_start",
+                      clock=clk)
+    health.write_beat(health.stage_dir(root, "b"), 1, 1, "round_start",
+                      clock=clk)
+    assert gh.check([0, 1]) == []
+    chaos.partition("b")
+    t["now"] = 1007.0   # past the lease window AND the round deadline
+    health.write_beat(health.stage_dir(root, "a"), 0, 1, "round_start",
+                      clock=clk)
+    assert gh.check([0, 1]) == [1]
+    assert gh.confirmed_down == {"b"}
+    assert gh.suspect_hosts == set()
+
+
+# -- verified, resumable shipping -----------------------------------------
+
+def test_verified_copy_resumes_torn_prefix(tmp_path, monkeypatch):
+    from sparknet_tpu.parallel.transport import _verified_copy
+
+    src = tmp_path / "blob.bin"
+    data = bytes(range(256)) * 20   # 5120 bytes = 5 x 1024-byte chunks
+    src.write_bytes(data)
+    dst = tmp_path / "landed" / "blob.bin"
+    # a torn previous transfer: two good chunks + a corrupt partial tail
+    os.makedirs(dst.parent)
+    (dst.parent / "blob.bin.tmp.ship").write_bytes(
+        data[:2048] + b"\xff" * 500)
+    rec = _verified_copy(str(src), str(dst), chunk=1024)
+    assert rec["resumed_bytes"] == 2048
+    assert rec["bytes"] == len(data)
+    assert dst.read_bytes() == data
+
+
+def test_chaos_ship_drop_retries_then_lands(tmp_path, monkeypatch):
+    from sparknet_tpu.parallel.transport import ChaosTransport, \
+        LocalTransport
+
+    monkeypatch.setenv("SPARKNET_SHIP_RETRIES", "3")
+    src = tmp_path / "a.bin"
+    src.write_bytes(b"payload" * 512)
+    dst = tmp_path / "remote" / "a.bin"
+    chaos = ChaosTransport(LocalTransport(),
+                           injector=_StubInjector(drop_seqs={0}))
+    rec = chaos.ship(str(src), "hostb", str(dst))
+    assert rec["bytes"] == len(b"payload" * 512)
+    assert dst.read_bytes() == src.read_bytes()
+
+
+def test_chaos_torn_ship_resumes_on_retry(tmp_path, monkeypatch):
+    """A torn transfer leaves half the bytes in the temp; the retry must
+    resume past the intact prefix and the landed file must be whole."""
+    from sparknet_tpu.parallel.transport import ChaosTransport, \
+        LocalTransport
+
+    monkeypatch.setenv("SPARKNET_SHIP_RETRIES", "3")
+    monkeypatch.setenv("SPARKNET_SHIP_CHUNK_MB", "0.0009765625")  # 1 KiB
+    src = tmp_path / "a.bin"
+    data = bytes(range(256)) * 20
+    src.write_bytes(data)
+    dst = tmp_path / "remote" / "a.bin"
+    chaos = ChaosTransport(LocalTransport(),
+                           injector=_StubInjector(torn_count=1))
+    rec = chaos.ship(str(src), "hostb", str(dst))
+    assert rec["resumed_bytes"] == 2048   # the torn half, whole chunks
+    assert dst.read_bytes() == data
+
+
+def test_chaos_partitioned_ship_and_exec_refuse(tmp_path, monkeypatch):
+    from sparknet_tpu.parallel.transport import ChaosTransport, \
+        LocalTransport, PartitionedError
+
+    monkeypatch.setenv("SPARKNET_SHIP_RETRIES", "2")
+    src = tmp_path / "a.bin"
+    src.write_bytes(b"x" * 100)
+    chaos = ChaosTransport(LocalTransport(), injector=_StubInjector())
+    chaos.partition("hostb")
+    with pytest.raises(PartitionedError):
+        chaos.ship(str(src), "hostb", str(tmp_path / "dst" / "a.bin"))
+    with pytest.raises(PartitionedError):
+        chaos.popen("hostb", ["true"], env_pairs=[])
+    assert chaos.beat_sync("hostb", str(tmp_path), str(tmp_path)) == 0
+    chaos.heal("hostb")
+    chaos.popen("hostb", [sys.executable, "-c", "pass"],
+                env_pairs=[]).wait(timeout=30)
+
+
+# -- checkpoint shipping --------------------------------------------------
+
+def _fake_ckpt(directory, round_idx, payload):
+    os.makedirs(directory, exist_ok=True)
+    name = f"ckpt_round_{round_idx:08d}.npz"
+    path = os.path.join(directory, name)
+    with open(path, "wb") as f:
+        f.write(payload)
+    man = {"file": name, "round": round_idx,
+           "sha256": hashlib.sha256(payload).hexdigest()}
+    with open(os.path.join(directory, f"manifest_{round_idx:08d}.json"),
+              "w") as f:
+        json.dump(man, f)
+    return path
+
+
+def test_ship_latest_checkpoint_picks_newest_valid(tmp_path):
+    from sparknet_tpu.parallel.transport import LocalTransport, \
+        newest_valid_round, ship_latest_checkpoint
+
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    _fake_ckpt(src, 3, b"round-three" * 100)
+    # round 7 is torn on the source: manifest sha no longer matches
+    p7 = _fake_ckpt(src, 7, b"round-seven" * 100)
+    with open(p7, "ab") as f:
+        f.write(b"corruption")
+    assert newest_valid_round(src) == 3
+
+    rec = ship_latest_checkpoint(LocalTransport(), "hostb", src, dst)
+    assert rec["round"] == 3
+    assert newest_valid_round(dst) == 3
+    again = ship_latest_checkpoint(LocalTransport(), "hostb", src, dst)
+    assert again["skipped"] == "up to date"
+
+
+def test_ship_latest_checkpoint_empty_source(tmp_path):
+    from sparknet_tpu.parallel.transport import LocalTransport, \
+        ship_latest_checkpoint
+
+    assert ship_latest_checkpoint(
+        LocalTransport(), "hostb", str(tmp_path / "nothing"),
+        str(tmp_path / "dst")) is None
+
+
+# -- incarnation fencing --------------------------------------------------
+
+def test_fence_monotonic_advance_and_typed_refusal(tmp_path):
+    from sparknet_tpu.utils.checkpoint import (
+        CheckpointError, CheckpointFencedError, advance_fence,
+        check_fence, read_fence)
+
+    d = str(tmp_path / "ckpt")
+    assert read_fence(d) == 0
+    assert advance_fence(d, 100001) == 100001
+    check_fence(d, 100001)          # current holder passes
+    assert advance_fence(d, 200001) == 200001   # new incarnation claims
+    # the zombie (older token) is refused, with a typed error carrying
+    # both sides of the comparison
+    with pytest.raises(CheckpointFencedError) as ei:
+        check_fence(d, 100001)
+    assert ei.value.token == 100001
+    assert ei.value.fence == 200001
+    assert isinstance(ei.value, CheckpointError)
+    # a stale claimant cannot LOWER the fence either
+    with pytest.raises(CheckpointFencedError):
+        advance_fence(d, 100001)
+    assert read_fence(d) == 200001
+    assert read_fence(str(tmp_path / "absent")) == 0
+
+
+def test_zombie_writer_refused_at_manifest_rename(tmp_path, monkeypatch):
+    """The zombie-writer window, end to end through the trainer: a save
+    whose incarnation is fenced off WHILE its npz is in flight must be
+    refused at the manifest rename — the last gate before visibility —
+    with a typed error and zero new artifacts (torn or visible).  The
+    successor then resumes from the last checkpoint the zombie landed
+    legitimately."""
+    import numpy as np
+
+    import sparknet_tpu.utils.checkpoint as ckpt_mod
+    from test_resilience import _batch, _make_trainer
+
+    d = tmp_path / "ck"
+    monkeypatch.setenv("SPARKNET_FENCE_TOKEN", "100001")
+    tr = _make_trainer(d, async_checkpoint=False)
+    tr.train_round(_batch(0))          # round 1 lands under token 100001
+    w1 = np.asarray(tr.params["conv1"][0]).copy()
+
+    # a successor incarnation claims the dir exactly when the zombie's
+    # npz has landed but its manifest has not (what the successor's
+    # resume_latest does on the shipped copy)
+    real_save = ckpt_mod.save_checkpoint
+
+    def racing_save(path, tree):
+        real_save(path, tree)
+        ckpt_mod.advance_fence(str(d), 200002)
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", racing_save)
+    with pytest.raises(ckpt_mod.CheckpointFencedError) as ei:
+        tr.train_round(_batch(1))
+    assert ei.value.token == 100001
+    assert ei.value.fence == 200002
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", real_save)
+
+    # the refused round left nothing behind: no manifest, no npz, no temp
+    leftovers = [n for n in os.listdir(d)
+                 if "00000002" in n or ".tmp." in n]
+    assert leftovers == []
+    assert ckpt_mod.read_fence(str(d)) == 200002
+
+    # the successor resumes cleanly from the zombie's last GOOD round
+    monkeypatch.setenv("SPARKNET_FENCE_TOKEN", "200002")
+    tr2 = _make_trainer(d, seed=99, async_checkpoint=False)
+    assert tr2.resumed is not None
+    assert tr2.round == 1
+    np.testing.assert_array_equal(np.asarray(tr2.params["conv1"][0]), w1)
+
+
+# -- status view columns --------------------------------------------------
+
+def test_hosts_view_lease_and_transport_columns():
+    from sparknet_tpu.parallel.fleet import (
+        HOST_DRAINING, RUNNING, HostPool, hosts_view)
+
+    pool = HostPool.from_spec("a=2,b=2,c=2")
+    pool.mark("c", HOST_DRAINING)
+    jobs = [{"job": "j1", "state": RUNNING, "slots": [0, 1],
+             "hosts": ["a"]},
+            {"job": "j2", "state": RUNNING, "slots": [2, 3],
+             "hosts": ["b"]}]
+    view = hosts_view(pool, jobs,
+                      beat_ages={"a": 99.0, "b": 0.2},
+                      transports={"a": "ssh", "b": "ssh"})
+    assert view["a"]["lease"] == "suspect"     # 99s > default 6s window
+    assert view["a"]["beat_age_s"] == 99.0
+    assert view["a"]["transport"] == "ssh"
+    assert view["b"]["lease"] == "live"
+    assert view["c"]["lease"] == HOST_DRAINING  # operator state verbatim
+    assert view["c"]["transport"] == "local"
+
+
+def test_mark_host_suspect_accepted(tmp_path):
+    from sparknet_tpu.parallel.fleet import FleetError, request_mark_host
+
+    request_mark_host(str(tmp_path), "b", "suspect", by="test")
+    with open(tmp_path / "host_control.jsonl") as f:
+        rec = json.loads(f.read().strip())
+    assert rec["state"] == "suspect"
+    with pytest.raises(FleetError):
+        request_mark_host(str(tmp_path), "b", "wedged")

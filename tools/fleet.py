@@ -29,10 +29,14 @@ separated) or a path to a JSON file (``[{"name", "devices", "addr"}]``);
 SPARKNET_FLEET_HOSTS supplies the same when the flag is absent.  With a
 pool, gangs place across hosts all-or-nothing (packing the fewest
 hosts), the status views grow per-host rows (state, device usage, gang
-placement), and ``mark-host <host> live|draining|lost`` appends to the
-host-control channel the running scheduler polls: ``draining`` evicts
-the host's gangs gracefully (snapshot, requeue, bit-identical resume),
-``lost`` kills and requeues them onto surviving hosts.
+placement, last relayed beat age, lease state, transport kind), and
+``mark-host <host> live|suspect|draining|lost`` appends to the
+host-control channel the running scheduler polls: ``suspect`` records
+a partition suspicion (gangs keep running — partition is not death),
+``draining`` evicts the host's gangs gracefully (snapshot, requeue,
+bit-identical resume), ``lost`` kills and requeues them onto surviving
+hosts.  Hosts reached over a non-local transport (addr beyond
+localhost, or SPARKNET_SSH_CMD set) show ``via=ssh`` in their row.
 
 ``status`` (or ``--status``) reads the journal + heartbeats + the
 telemetry registry snapshots the workers wrote — no scheduler process
@@ -92,7 +96,8 @@ def _mark_host(argv) -> int:
         description="request a host state change (the running scheduler "
                     "applies it at its next step)")
     ap.add_argument("host")
-    ap.add_argument("state", choices=("live", "draining", "lost"))
+    ap.add_argument("state",
+                    choices=("live", "suspect", "draining", "lost"))
     ap.add_argument("--workdir", required=True)
     ap.add_argument("--by", default="operator")
     args = ap.parse_args(argv)

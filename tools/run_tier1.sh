@@ -117,6 +117,24 @@ maybe_podsoak() {
   fi
 }
 
+# ~60-second network chaos slice (tools/soak.py --net --net-slice) —
+# opt-in via SPARKNET_NETSOAK=1.  Two legs over the production ssh wire
+# format (SshTransport through a local fake-ssh shim) wrapped in
+# ChaosTransport: a symmetric partition mid-round must SUSPEND the gang
+# (suspect, not straggler-killed, no restart-budget burn), heal, and
+# finish bit-identical to the fault-free baseline; and a fenced
+# checkpoint ship — torn first transfer resumed crc-verified onto a
+# checkpoint-less host, bit-identical resume, zombie writer refused at
+# the fence with a typed error.  (The full acceptance run adds the
+# slow-link-attribution leg: `python tools/soak.py --net`.)
+maybe_netsoak() {
+  if [ "${SPARKNET_NETSOAK:-}" = "1" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python tools/soak.py --net --net-slice \
+      --seed "${SPARKNET_SOAK_SEED:-0}" --out /tmp/_netsoak.json
+  fi
+}
+
 # ~2-second serving smoke (tools/serveload.py --smoke) — opt-in via
 # SPARKNET_SERVESMOKE=1.  In-process engine + closed-loop clients;
 # fails the gate unless results are bit-identical to solo references,
@@ -224,6 +242,7 @@ case "${1:-}" in
   --soak)  SPARKNET_SOAK=1 maybe_soak ;;
   --fleetsoak) SPARKNET_FLEETSOAK=1 maybe_fleetsoak ;;
   --podsoak) SPARKNET_PODSOAK=1 maybe_podsoak ;;
+  --netsoak) SPARKNET_NETSOAK=1 maybe_netsoak ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --recordbench) SPARKNET_RECORDBENCH=1 maybe_recordbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
@@ -234,16 +253,17 @@ case "${1:-}" in
   --fusebench) SPARKNET_FUSEBENCH=1 maybe_fusebench ;;
   --tunebench) SPARKNET_TUNEBENCH=1 maybe_tunebench ;;
   --all)   maybe_lint && run_tier1 && run_chaos && maybe_soak \
-             && maybe_fleetsoak && maybe_podsoak \
+             && maybe_fleetsoak && maybe_podsoak && maybe_netsoak \
              && maybe_feedbench && maybe_recordbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
              && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
              && maybe_perfgate ;;
   "")      maybe_lint && run_tier1 && maybe_soak && maybe_fleetsoak \
-             && maybe_podsoak && maybe_feedbench && maybe_recordbench \
+             && maybe_podsoak && maybe_netsoak \
+             && maybe_feedbench && maybe_recordbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
              && maybe_roundbench && maybe_obssmoke \
              && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--netsoak|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac

@@ -24,8 +24,10 @@ resumed queue to never double-launch, and ZERO orphaned worker
 processes at the end.
 
 Pod mode (``--pod N``) is the POD-SCALE burn-in: a simulated N-host rig
-(every host a local slice of the device budget — the same code path a
-real SSH inventory takes) runs mixed tenants — two training gangs plus a
+(every host a local slice of the device budget, but placed over the
+REAL ssh wire format — ``SshTransport`` through a fake-ssh shim, or a
+caller-supplied ``SPARKNET_SSH_CMD`` for a live inventory) runs mixed
+tenants — two training gangs plus a
 replicated serving tenant behind the request router — under a seeded
 production-shaped :class:`TrafficModel`: a diurnal paced-load curve, a
 flash crowd, corrupt-upload bursts through the data quarantine plane,
@@ -38,14 +40,35 @@ flight-recorder dump and fails the run.  ``--forever`` keeps scheduling
 episodes until one fails (the standing burn-in posture); ``--pod-slice``
 is the ~60 s CI shape (one host-kill + one flash crowd).
 
+Net mode (``--net``) is the NETWORK chaos burn-in — the partition-vs-
+death legs the pod burn-in grows in PR 17, runnable standalone so CI
+can gate on them.  Every leg drives the production ssh wire format
+(``SshTransport`` through a local fake-ssh shim) wrapped in a
+``ChaosTransport``: (1) *partition-suspend-heal* — sever the beat relay
+to a mid-round gang; the lease must mark the host SUSPECT (not kill it,
+not burn restart budget), the heal must lift the suspension, and the
+finished params must be bit-identical to the fault-free baseline;
+(2) *fenced-zombie-ship* — an incarnation checkpoints on one host, its
+requeue lands on a checkpoint-less host that pulls the newest valid
+round over a link that TEARS the first transfer (the retry resumes the
+torn prefix, crc-verified), resumes bit-identically, and the fenced-off
+zombie returning from behind the partition is refused at the fence with
+a typed error and zero corruption; (3, full runs only) *slow-link
+attribution* — a delayed relay is NOT silence: no suspect, no straggler
+kill, bit-identical finish.  A full ``--pod`` episode set appends the
+same legs, so the pod burn-in exercises them too; ``--net-slice`` keeps
+the ~60 s CI shape (legs 1 + 2).
+
 Usage:
   python tools/soak.py --runs 8 --seed 0 --out soak.json
   python tools/soak.py --fleet 4 --fleet-kill --seed 0   # fleet chaos
   python tools/soak.py --pod 3 --seed 0 --out SOAK_pod.json
   python tools/soak.py --pod 3 --forever   # standing burn-in
+  python tools/soak.py --net --seed 0 --out SOAK_net.json
   SPARKNET_SOAK=1 tools/run_tier1.sh       # the 2-run CI smoke
   SPARKNET_FLEETSOAK=1 tools/run_tier1.sh  # the 2-job fleet smoke
   SPARKNET_PODSOAK=1 tools/run_tier1.sh    # the 3-host pod slice
+  SPARKNET_NETSOAK=1 tools/run_tier1.sh    # the 2-leg net slice
 
 Exit code 0 iff every run recovered exactly; the JSON verdict names each
 run's schedule, exit code, attempt count, and whether the params matched.
@@ -89,7 +112,10 @@ def _schedules(rng):
 # correlated across every rank and attempt
 _KEEP_ENV = ("SPARKNET_SOAK", "SPARKNET_TELEMETRY", "SPARKNET_TRACE_DIR",
              "SPARKNET_METRICS_SNAP", "SPARKNET_METRICS_SNAP_S",
-             "SPARKNET_RUN_ID", "SPARKNET_FLIGHT_EVENTS")
+             "SPARKNET_RUN_ID", "SPARKNET_FLIGHT_EVENTS",
+             # a caller-supplied ssh shim (or real ssh wrapper) survives
+             # the scrub: the pod/net modes ride the wire it names
+             "SPARKNET_SSH_CMD")
 
 
 def _clean_env():
@@ -125,6 +151,301 @@ def _params_match(base_npz, out_npz):
         if not np.array_equal(a[k], b[k]):
             return False, k
     return True, None
+
+
+# ---------------------------------------------------------------------------
+# Net chaos legs (--net; full --pod runs append the same set): partition
+# vs death, fenced checkpoint shipping, and slow-link attribution over
+# the REAL ssh wire format (SshTransport through a fake-ssh shim) with
+# ChaosTransport injecting the network faults mid-episode
+# ---------------------------------------------------------------------------
+
+def _fake_ssh_shim(workdir: str) -> str:
+    """Write the fake-ssh shim: executes the remote command string
+    locally with the exact argv ssh receives (``$4`` is the remote
+    string after ``-o BatchMode=yes <host>``), so the wire format, env
+    contract, and stdio plumbing are the production path — no sshd.
+    ``exec`` keeps the worker pid == the Popen pid (signalling and
+    pid-identity checks work unchanged)."""
+    path = os.path.join(workdir, "fake-ssh")
+    with open(path, "w") as f:
+        f.write('#!/bin/bash\nexec bash -c "$4"\n')
+    os.chmod(path, 0o755)
+    return path
+
+
+class _TornOnceInjector:
+    """Minimal injector for ChaosTransport: tear the first ``torn``
+    ship attempts (each leaves a half-written temp the retry must
+    resume past), then run clean.  Duck-typed to the faults-injector
+    surface the transport consumes."""
+
+    def __init__(self, torn: int = 1):
+        self.torn = torn
+        self.specs = ()
+
+    def net_specs(self):
+        return []
+
+    def drop_ship(self, seq):
+        return False
+
+    def torn_ship(self):
+        if self.torn > 0:
+            self.torn -= 1
+            return True
+        return False
+
+
+def _net_knobs(workdir: str) -> None:
+    """The net-leg env: the fake-ssh wire (unless the caller supplied a
+    real SPARKNET_SSH_CMD), a tight lease so a partition is suspected
+    within ~1 s, and small ship chunks so torn-transfer resume moves a
+    real whole-chunk prefix."""
+    os.environ.setdefault("SPARKNET_SSH_CMD", _fake_ssh_shim(workdir))
+    os.environ.setdefault("SPARKNET_LEASE_S", "0.5")
+    os.environ.setdefault("SPARKNET_LEASE_MISSES", "2")
+    os.environ.setdefault("SPARKNET_SHIP_CHUNK_MB", "0.0625")
+    # the ssh-spawned workers inherit this process's env through the
+    # shim (the remote branch applies no platform/device carving)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+
+
+def _wire_driver(out, rounds, *, host, ckpt=None, extra_env=None,
+                 transport=None, heartbeat_dir=None, round_deadline=None,
+                 report=None) -> int:
+    """One driver run over the ssh wire: a single rank with 4 virtual
+    devices on the fake 'remote' host (SPARKNET_NUM_PROCS=1 — the gang
+    shape the pod fleet places).  ``host`` is the host LABEL
+    (beat-staging + lease identity); the transport address stays
+    127.0.0.1 so the coordinator resolves, exactly the name-vs-addr
+    split a HostPool inventory makes."""
+    from sparknet_tpu.tools.launch import free_port, launch_ssh
+    cmd = [sys.executable, DRIVER, "--strategy", "sync", "--out", out,
+           "--local-devices", "4", "--expect-devices", "4",
+           "--rounds", str(rounds)]
+    if ckpt:
+        cmd += ["--ckpt-dir", ckpt]
+    return launch_ssh(cmd, hosts=["127.0.0.1"], host_map=[host],
+                      coordinator_port=free_port(),
+                      cwd=REPO, timeout=300, extra_env=extra_env,
+                      transport=transport, heartbeat_dir=heartbeat_dir,
+                      round_deadline=round_deadline, report=report)
+
+
+def _net_partition_episode(workdir, baseline, rounds, *,
+                           slow_ms: float | None = None) -> dict:
+    """Symmetric partition mid-round (or, with ``slow_ms``, a degraded
+    link): sever the beat relay to a healthy mid-round gang.  The lease
+    must mark the host SUSPECT and *suspend* its ranks — no straggler
+    kill, no restart-budget burn — then lift the suspension on heal,
+    and the finished params must be bit-identical to the fault-free
+    baseline.  The slow-link variant asserts the opposite discipline:
+    delay is NOT silence — beats arrive late but fresh, so no suspect,
+    no kill (straggler attribution stays with the per-rank beats)."""
+    import threading
+
+    from sparknet_tpu.parallel import health
+    from sparknet_tpu.parallel.transport import (ChaosTransport,
+                                                 SshTransport)
+
+    name = "slow_link_attribution" if slow_ms else "partition_suspend_heal"
+    epdir = os.path.join(workdir, name)
+    os.makedirs(epdir, exist_ok=True)
+    out = os.path.join(epdir, "out.npz")
+    hb = os.path.join(epdir, "hb")
+    host = "hostb"
+    chaos = ChaosTransport(SshTransport(), injector=_TornOnceInjector(0))
+    flap: dict = {}
+
+    def flapper():
+        # wait until the first beat has been RELAYED (the monitor has
+        # host liveness on file — a partition before any relayed beat
+        # is startup grace, not a lease event), then flap the link
+        hdir = health.host_dir(hb, host)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if health._read_flat(hdir):
+                break
+            time.sleep(0.05)
+        else:
+            flap["error"] = "no beat ever relayed"
+            return
+        if slow_ms:
+            chaos.set_slow(host, slow_ms)
+            flap["slow_ms"] = slow_ms
+            time.sleep(3.0)
+            chaos.set_slow(host, 0)
+            flap["restored"] = True
+        else:
+            chaos.partition(host)
+            flap["partitioned"] = True
+            time.sleep(4.0)   # 4x the 1 s lease window, > round deadline
+            chaos.heal(host)
+            flap["healed"] = True
+
+    th = threading.Thread(target=flapper, daemon=True)
+    th.start()
+    report: dict = {}
+    t0 = time.monotonic()
+    rc = _wire_driver(out, rounds, host=host, transport=chaos,
+                      heartbeat_dir=hb, round_deadline=3.0, report=report)
+    th.join(timeout=15.0)
+    match, bad = False, None
+    if rc == 0:
+        match, bad = _params_match(baseline, out)
+    row = {"episode": name, "rc": rc, "cause": report.get("cause"),
+           "transport": report.get("transport"),
+           "suspects": report.get("suspect_hosts"),
+           "confirmed_down": report.get("confirmed_down"),
+           "stragglers": report.get("stragglers"), "flap": flap,
+           "match": match, "elapsed_s": round(time.monotonic() - t0, 1)}
+    if bad:
+        row["diverged_at"] = bad
+    if slow_ms:
+        row["ok"] = bool(rc == 0 and match
+                         and report.get("cause") == "clean"
+                         and not report.get("suspect_hosts")
+                         and not report.get("stragglers")
+                         and flap.get("restored"))
+    else:
+        row["ok"] = bool(rc == 0 and match
+                         and report.get("cause") == "clean"
+                         and report.get("suspect_hosts") == [host]
+                         and not report.get("confirmed_down")
+                         and not report.get("stragglers")
+                         and flap.get("healed"))
+    return row
+
+
+def _net_fenced_ship_episode(workdir, baseline, rounds) -> dict:
+    """Fenced, resumable checkpoint shipping end-to-end: incarnation 1
+    (fence token 100001) trains the first half of the rounds
+    checkpointing on hosta; its requeue lands on checkpoint-less hostb,
+    which pulls the newest valid round over a link that TEARS the first
+    transfer — the retry must resume the torn whole-chunk prefix and
+    land crc-verified.  Incarnation 2 (token 200002) resumes from the
+    shipped artifacts and must finish bit-identical to the
+    uninterrupted baseline.  Then the fenced-off incarnation returns
+    from behind the partition and tries to reclaim the dir: typed
+    refusal at the fence, zero state touched."""
+    import glob
+
+    from sparknet_tpu.parallel.transport import (
+        ChaosTransport, SshTransport, newest_valid_round,
+        ship_latest_checkpoint,
+    )
+    from sparknet_tpu.utils.checkpoint import (
+        CheckpointFencedError, advance_fence, read_fence,
+    )
+
+    epdir = os.path.join(workdir, "fenced_zombie_ship")
+    os.makedirs(epdir, exist_ok=True)
+    ck_a = os.path.join(epdir, "ckpt_host_hosta")
+    ck_b = os.path.join(epdir, "ckpt_host_hostb")
+    out = os.path.join(epdir, "out.npz")
+    t0 = time.monotonic()
+    row: dict = {"episode": "fenced_zombie_ship"}
+
+    rc1 = _wire_driver(os.path.join(epdir, "half.npz"), rounds // 2,
+                       host="hosta", ckpt=ck_a,
+                       extra_env={"SPARKNET_FENCE_TOKEN": "100001"})
+    row["rc_first"] = rc1
+
+    chaos = ChaosTransport(SshTransport(), injector=_TornOnceInjector())
+    try:
+        rec = ship_latest_checkpoint(chaos, "hostb", ck_a, ck_b)
+    except (OSError, RuntimeError, ValueError) as e:  # ShipError is OSError
+        rec = None
+        row["ship_error"] = f"{type(e).__name__}: {e}"
+    row["ship"] = rec
+
+    rc2 = _wire_driver(out, rounds, host="hostb", ckpt=ck_b,
+                       extra_env={"SPARKNET_FENCE_TOKEN": "200002"})
+    row["rc_resume"] = rc2
+    match, bad = False, None
+    if rc2 == 0:
+        match, bad = _params_match(baseline, out)
+    if bad:
+        row["diverged_at"] = bad
+
+    zombie: dict = {"refused": False}
+    try:
+        advance_fence(ck_b, 100002)
+    except CheckpointFencedError as e:
+        zombie = {"refused": True, "error": type(e).__name__,
+                  "token": e.token, "fence": e.fence}
+    torn_left = glob.glob(os.path.join(ck_b, "*.tmp*"))
+    row.update(
+        zombie=zombie, fence=read_fence(ck_b),
+        newest_round=newest_valid_round(ck_b), match=match,
+        elapsed_s=round(time.monotonic() - t0, 1),
+        ok=bool(rc1 == 0 and rc2 == 0 and match and rec
+                and rec.get("round") == rounds // 2
+                and rec.get("resumed_bytes", 0) > 0
+                and zombie.get("refused")
+                and zombie.get("fence") == read_fence(ck_b)
+                and not torn_left))
+    if torn_left:
+        row["torn_leftovers"] = torn_left
+    return row
+
+
+def _net_episodes(workdir, baseline, rounds, *, net_slice: bool) -> list:
+    """The net chaos leg set (shared by --net and full --pod runs)."""
+    episodes = [
+        _net_partition_episode(workdir, baseline, rounds),
+        _net_fenced_ship_episode(workdir, baseline, rounds),
+    ]
+    if not net_slice:
+        episodes.append(_net_partition_episode(workdir, baseline, rounds,
+                                               slow_ms=250.0))
+    for e in episodes:
+        print(f"net-soak: {e['episode']} -> "
+              f"{'OK' if e['ok'] else 'FAIL'} ({e['elapsed_s']}s)",
+              flush=True)
+    return episodes
+
+
+def net_soak(args) -> int:
+    from sparknet_tpu.parallel.health import lease_window_s
+
+    _clean_env()
+    own_tmp = args.workdir is None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sparknet_net_")
+    os.makedirs(workdir, exist_ok=True)
+    _net_knobs(workdir)
+    t0 = time.monotonic()
+    rounds = 8
+    base = os.path.join(workdir, "base.npz")
+    rc, _ = _run_driver(base, None, [], rounds=rounds)
+    if rc != 0:
+        raise RuntimeError(f"fault-free baseline failed rc={rc}")
+    episodes = _net_episodes(workdir, base, rounds,
+                             net_slice=args.net_slice)
+    passed = sum(1 for e in episodes if e["ok"])
+    report = {"mode": "net", "seed": args.seed,
+              "slice": bool(args.net_slice), "rounds": rounds,
+              "lease_window_s": lease_window_s(), "episodes": episodes,
+              "passed": passed, "failed": len(episodes) - passed,
+              "elapsed_s": round(time.monotonic() - t0, 1),
+              "ok": bool(episodes) and passed == len(episodes)}
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"net-soak: verdict written to {args.out} "
+              f"({passed}/{len(episodes)} episode(s) passed)")
+    else:
+        print(text)
+    if own_tmp and report["ok"]:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not report["ok"]:
+        print(f"net-soak: scratch kept at {workdir} for post-mortem",
+              file=sys.stderr)
+    return 0 if report["ok"] else 1
 
 
 # ---------------------------------------------------------------------------
@@ -646,6 +967,11 @@ def pod_soak(args) -> int:
     own_tmp = args.workdir is None
     workdir = args.workdir or tempfile.mkdtemp(prefix="sparknet_pod_")
     os.makedirs(workdir, exist_ok=True)
+    # the pod's host lifecycle rides the REAL ssh wire format: with
+    # SPARKNET_SSH_CMD set, every placement/exec/ship goes through
+    # SshTransport (the fake-ssh shim by default; a live inventory
+    # supplies its own wrapper and keeps it through _KEEP_ENV)
+    _net_knobs(workdir)
     t0 = time.monotonic()
 
     # one fault-free baseline for the training shape all tenants share
@@ -676,9 +1002,21 @@ def pod_soak(args) -> int:
         print("pod-soak: interrupted — closing out the verdict",
               file=sys.stderr, flush=True)
 
+    if not args.pod_slice and ok and not args.forever:
+        # the full burn-in grows the network chaos legs (partition
+        # suspend/heal, fenced zombie shipping, slow-link attribution)
+        # on its own fault-free baseline shape
+        net_base = os.path.join(workdir, "net_base.npz")
+        rc, _ = _run_driver(net_base, None, [], rounds=8)
+        if rc != 0:
+            raise RuntimeError(f"net-leg baseline failed rc={rc}")
+        episodes.extend(_net_episodes(os.path.join(workdir, "net"),
+                                      net_base, 8, net_slice=False))
+
     passed = sum(1 for e in episodes if e["ok"])
     report = {"mode": "pod", "seed": args.seed, "pod_hosts": args.pod,
               "devices_per_host": args.pod_devices,
+              "transport": "ssh",
               "slice": bool(args.pod_slice), "episodes": episodes,
               "passed": passed, "failed": len(episodes) - passed,
               "elapsed_s": round(time.monotonic() - t0, 1),
@@ -745,8 +1083,16 @@ def main(argv=None) -> int:
     ap.add_argument("--pod-leg-s", type=float, default=None,
                     help="seconds per traffic leg "
                          "(default SPARKNET_SOAK_LEG_S)")
+    ap.add_argument("--net", action="store_true",
+                    help="net mode: the partition/fenced-ship/slow-link "
+                         "chaos legs over the fake-ssh ChaosTransport")
+    ap.add_argument("--net-slice", action="store_true",
+                    help="the ~60s CI shape: partition-suspend-heal + "
+                         "fenced-zombie legs only (skips slow-link)")
     args = ap.parse_args(argv)
 
+    if args.net:
+        return net_soak(args)
     if args.pod:
         return pod_soak(args)
     if args.fleet:
