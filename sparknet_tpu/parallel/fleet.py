@@ -1820,6 +1820,15 @@ def serving_status(workdir: str, jobs: list[dict]) -> dict[str, Any]:
                 out[key] = json.load(f)
         except (OSError, json.JSONDecodeError):
             pass
+    try:
+        # journal-replayed channel state: works with the rollout
+        # controller dead, which is exactly when status matters most
+        from .rollout import status as rollout_status
+        ro = rollout_status(workdir)
+        if ro:
+            out["rollout"] = ro
+    except (OSError, ValueError):
+        pass
     return out
 
 
@@ -1903,4 +1912,15 @@ def format_status(status: Mapping[str, Any]) -> str:
     if counts:
         lines.append("router:  " + " ".join(
             f"{k}={v}" for k, v in sorted(counts.items())))
+    for model, ro in sorted((serving.get("rollout") or {}).items()):
+        line = (f"rollout: {model:<20} {ro.get('phase', '?'):<14} "
+                f"stable={ro.get('stable') or '-'}")
+        if ro.get("canary"):
+            line += (f" canary={ro['canary']}"
+                     f"@{ro.get('weight', 0.0):g}")
+        if ro.get("last_verdict"):
+            line += f" verdict={ro['last_verdict']}"
+        if ro.get("last_rollback_reason"):
+            line += f" | rolled back: {ro['last_rollback_reason']}"
+        lines.append(line)
     return "\n".join(lines)

@@ -43,8 +43,16 @@ drain before the SIGTERM ever fires — every admitted request completes.
 this process (the fast path for tests and single-process fleets);
 :class:`HttpReplica` drives a remote ``tools/serve.py`` over its JSON
 wire, mapping HTTP answers back onto the engine's typed errors (429 →
-``Overloaded``, 404 → ``UnknownModel``, 503/transport → ``EngineDead``)
-so the router's logic is transport-blind.
+``Overloaded``, 404 → ``UnknownModel``, 503/transport → ``EngineDead``,
+507 → ``OverBudget``) so the router's logic is transport-blind.
+
+**Rollout — weighted canary placement.**  A :class:`RolloutState`
+installed via ``set_rollout`` splits one model's plain-name traffic
+between its ``stable`` and ``canary`` versions by hash fraction of a
+deterministic per-request key (replays land on the same side), while
+version-pinned requests bypass the split entirely.  The state mirrors
+the registry's channel file (:mod:`.registry`) — the rollout controller
+(:mod:`.rollout`) keeps the two in sync.
 
 **ServingFleet** glues the router to the fleet scheduler: serving
 replicas are first-class ``JobSpec(kind="serve")`` tenants that the
@@ -68,6 +76,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Callable, Mapping, Sequence
@@ -75,8 +84,10 @@ from typing import Any, Callable, Mapping, Sequence
 import numpy as np
 
 from ..utils import telemetry
+from .registry import versioned
 from .serving import (
     EngineDead,
+    OverBudget,
     Overloaded,
     ServeResult,
     ServingError,
@@ -168,7 +179,9 @@ class HttpReplica:
     the engine's typed errors so the router never branches on
     transport: 429 → :class:`Overloaded`, 404 unknown model →
     :class:`UnknownModel`, 503 / connection death → :class:`EngineDead`
-    (which the router treats as "fail this replica over")."""
+    (which the router treats as "fail this replica over"), 507 →
+    :class:`OverBudget` (healthy replica, model cannot fit — typed,
+    never a failover hop)."""
 
     def __init__(self, rid: str, url: str,
                  models: Sequence[str] | None = None,
@@ -198,6 +211,14 @@ class HttpReplica:
                 raise UnknownModel(msg) from None
             if "HTTP 503" in msg:
                 raise EngineDead(f"replica {self.rid}: {msg}") from None
+            if "HTTP 507" in msg:
+                # out of HBM budget, NOT dead: a typed OverBudget must
+                # never burn a failover hop on a healthy replica
+                nums = re.findall(r"(\d+(?:\.\d+)?)\s*MB", msg)
+                raise OverBudget(
+                    model,
+                    float(nums[0]) if nums else 0.0,
+                    float(nums[1]) if len(nums) > 1 else 0.0) from None
             raise ServingError(msg) from None
         except (OSError, TimeoutError) as e:
             # connection refused/reset/timeout: the replica process is
@@ -257,6 +278,50 @@ def _hrw(model: str, rid: str) -> int:
     """Rendezvous weight: highest hash owns the model."""
     return int.from_bytes(
         hashlib.md5(f"{model}|{rid}".encode()).digest()[:8], "big")
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutState:
+    """Weighted stable-vs-canary placement for one model (the router's
+    in-memory mirror of the registry's channel file).
+
+    ``target`` is a pure function of the route key — the same request
+    replayed lands on the same version, so a rollout never makes replays
+    flap — and the split is by HASH FRACTION, not a counter: ``weight``
+    of the keyspace goes to the canary with no shared mutable state to
+    race on.  Pinned requests (an explicit ``version=``) bypass this
+    entirely and always hit their version bit-identically."""
+
+    model: str
+    stable: str
+    canary: str | None = None
+    weight: float = 0.0
+
+    def __post_init__(self):
+        if not self.stable:
+            raise ValueError(f"rollout for {self.model!r} needs a "
+                             f"stable version")
+        if not 0.0 <= self.weight <= 1.0:
+            raise ValueError(f"canary weight must be in [0, 1], "
+                             f"got {self.weight}")
+        if self.canary is None and self.weight > 0:
+            raise ValueError(f"rollout for {self.model!r} has weight "
+                             f"{self.weight} but no canary version")
+
+    def target(self, rkey: str) -> str:
+        """The versioned serving name this route key lands on."""
+        if self.canary is None or self.weight <= 0.0:
+            return versioned(self.model, self.stable)
+        frac = int.from_bytes(
+            hashlib.md5(f"rollout|{self.model}|{rkey}".encode())
+            .digest()[:8], "big") / 2.0 ** 64
+        if frac < self.weight:
+            return versioned(self.model, self.canary)
+        return versioned(self.model, self.stable)
+
+    def to_doc(self) -> dict[str, Any]:
+        return {"model": self.model, "stable": self.stable,
+                "canary": self.canary, "weight": self.weight}
 
 
 class RouterFuture:
@@ -323,6 +388,7 @@ class Router:
         self._lock = threading.Lock()
         self._replicas: dict[str, _Replica] = {}
         self._gone: dict[str, dict[str, Any]] = {}   # DEAD/RELEASED stubs
+        self._rollouts: dict[str, RolloutState] = {}  # by base model
         self.counts = {"requests": 0, "spills": 0, "failovers": 0,
                        "rejections": 0, "deaths": 0, "drains": 0}
         reg = telemetry.get_registry()
@@ -360,6 +426,46 @@ class Router:
             rep.state = RELEASED
             self._gone[rid] = self._stub(rep)
         self._count("release")
+
+    # -- rollout (weighted stable/canary placement) -----------------------
+    def set_rollout(self, state: RolloutState) -> None:
+        """Install (or retune — weight changes are just re-installs) the
+        stable/canary split for ``state.model``.  Plain-name requests for
+        that model start resolving to versioned serving names."""
+        with self._lock:
+            self._rollouts[state.model] = state
+        telemetry.get_recorder().record(
+            "router_rollout", **state.to_doc())
+        self._count("rollout_set")
+
+    def clear_rollout(self, model: str) -> None:
+        """Back to plain by-name routing for ``model`` (idempotent)."""
+        with self._lock:
+            if self._rollouts.pop(model, None) is not None:
+                self._count("rollout_clear")
+
+    def rollout(self, model: str) -> RolloutState | None:
+        with self._lock:
+            return self._rollouts.get(model)
+
+    @staticmethod
+    def _route_key(tenant: str, x: np.ndarray) -> str:
+        """Deterministic per-request key: same tenant + same input bytes
+        → same key → same rollout side, every replay."""
+        h = hashlib.sha1(tenant.encode())
+        h.update(np.ascontiguousarray(x).tobytes())
+        return h.hexdigest()
+
+    def _resolve(self, model: str, x: np.ndarray, tenant: str,
+                 version: str | None, rkey: str | None) -> str:
+        if version is not None:
+            return versioned(model, version)    # pinned: no dice roll
+        with self._lock:
+            state = self._rollouts.get(model)
+        if state is None:
+            return model
+        return state.target(rkey if rkey is not None
+                            else self._route_key(tenant, x))
 
     def replica_ids(self, model: str | None = None,
                     live_only: bool = True) -> list[str]:
@@ -423,13 +529,23 @@ class Router:
         self._m_events.inc(ev=ev)
 
     # -- the request path -------------------------------------------------
-    def submit(self, model: str, x: np.ndarray,
-               tenant: str = "anon") -> RouterFuture:
+    def submit(self, model: str, x: np.ndarray, tenant: str = "anon",
+               version: str | None = None,
+               rkey: str | None = None) -> RouterFuture:
         """Route one request; returns a failover-aware future.  Raises
         the replica vocabulary synchronously: :class:`Overloaded` when
         the chosen replica (and the least-loaded alternative) reject,
         :class:`UnknownModel` / :class:`EngineDead` when nothing can
-        take the model at all."""
+        take the model at all.
+
+        ``version`` pins the request to one published version
+        (``model@version`` placement, no rollout dice roll); otherwise
+        an installed :class:`RolloutState` splits plain-name traffic
+        stable-vs-canary by ``rkey`` (derived deterministically from
+        tenant + input bytes when not given).  Failover hops keep the
+        resolved version — a mid-request replica death never silently
+        moves a request across the canary boundary."""
+        model = self._resolve(model, x, tenant, version, rkey)
         excluded: set[str] = set()
         spilled_reject = False
         for _ in range(self.cfg.max_failovers + 2):
@@ -467,14 +583,28 @@ class Router:
                 self._settle(rep, ok=False)
                 excluded.add(rep.rid)
                 continue
+            except OverBudget:
+                # the replica is healthy, the model just cannot fit its
+                # HBM budget: a typed answer for the caller, never a
+                # failover hop and never a mark_dead
+                self._settle(rep, ok=False)
+                raise
+            except ServingError:
+                # any other typed serving error: settle the outstanding
+                # count (it used to leak here) and let the caller see it
+                self._settle(rep, ok=False)
+                raise
             return RouterFuture(self, rep, inner, model, x, tenant)
         raise EngineDead(
             f"request for {model!r} exhausted "
             f"{self.cfg.max_failovers} failover hops")
 
     def classify(self, model: str, x: np.ndarray, tenant: str = "anon",
-                 timeout: float | None = 30.0) -> ServeResult:
-        return self.submit(model, x, tenant).result(timeout)
+                 timeout: float | None = 30.0,
+                 version: str | None = None,
+                 rkey: str | None = None) -> ServeResult:
+        return self.submit(model, x, tenant, version=version,
+                           rkey=rkey).result(timeout)
 
     # -- drain (the lossless scale-down path) -----------------------------
     def start_drain(self, rid: str) -> None:
@@ -535,8 +665,11 @@ class Router:
                 for r in self._replicas.values()}
             gone = dict(self._gone)
             counts = dict(self.counts)
+            rollouts = {m: st.to_doc()
+                        for m, st in self._rollouts.items()}
         models = sorted({m for r in reps.values() for m in r["models"]})
         return {"replicas": reps, "gone": gone, "counts": counts,
+                "rollouts": rollouts,
                 "by_model": {m: {"home": self.home(m),
                                  "replicas": self.replica_ids(m)}
                              for m in models}}

@@ -346,7 +346,8 @@ class LoadedModel:
 
     def __init__(self, name: str, net_param, cfg: ServeConfig,
                  weights: str | None = None,
-                 max_param_mb: float | None = None):
+                 max_param_mb: float | None = None,
+                 version: str | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -356,6 +357,7 @@ class LoadedModel:
         t0 = time.perf_counter()
         deploy, self.in_shape = deploy_from(net_param, cfg.batch_shapes[-1])
         self.name = name
+        self.version = version     # registry version id, None by-name
         self.dtype = cfg.dtype
         self.batch_shapes = cfg.batch_shapes
         self.net = Net(deploy, NetState(Phase.TEST),
@@ -419,7 +421,8 @@ class LoadedModel:
         return np.asarray(jax.device_get(self.infer_async(batch)))
 
     def info(self) -> dict[str, Any]:
-        return {"name": self.name, "in_shape": list(self.in_shape),
+        return {"name": self.name, "version": self.version,
+                "in_shape": list(self.in_shape),
                 "classes": self.classes, "dtype": self.dtype,
                 "param_mb": round(self.param_bytes / 2**20, 3),
                 "batch_shapes": list(self.batch_shapes),
@@ -467,6 +470,46 @@ class ModelHouse:
             self._models[name] = lm
             self._models.move_to_end(name)
             self._evict_over_budget(keep=name)
+        return lm
+
+    def load_version(self, model: str, version: str, registry=None,
+                     force: bool | None = None) -> LoadedModel:
+        """Load one PUBLISHED registry version under its versioned
+        serving key (``model@version``): the manifest resolves the
+        weights (sha-checked against the bundle) and the model serves
+        bit-identically wherever that version id lands.  ``registry``
+        defaults to the ``SPARKNET_REGISTRY_DIR`` one; no registry
+        configured is a loud error, not a silent by-name fallback."""
+        from .registry import active_registry, versioned
+        if registry is None:
+            registry = active_registry()
+        if registry is None:
+            raise ValueError(
+                f"cannot load {model!r} version {version!r}: no model "
+                f"registry configured — set SPARKNET_REGISTRY_DIR (or "
+                f"pass one) so version ids resolve to artifact bundles")
+        manifest = registry.manifest(model, version)  # typed when absent
+        key = versioned(model, version)
+        with self._lock:
+            hit = self._models.get(key)
+            if hit is not None:
+                self._models.move_to_end(key)
+                return hit
+        zoo = zoo_models()
+        if model not in zoo:
+            raise UnknownModel(
+                f"model {model!r} not in the zoo (known: {sorted(zoo)})")
+        if force is None:
+            force = knobs.raw("SPARKNET_SERVE_FORCE_ADMIT") == "1"
+        lm = LoadedModel(key, zoo[model](), self.cfg,
+                         weights=registry.weights_path(model, version),
+                         max_param_mb=None if force
+                         else self.cfg.hbm_budget_mb, version=version)
+        lm.declared_slo = manifest.get("slo")
+        with self._lock:
+            self._models[key] = lm
+            self._models.move_to_end(key)
+            self._evict_over_budget(keep=key)
         return lm
 
     def _evict_over_budget(self, keep: str) -> None:
@@ -1105,6 +1148,19 @@ class InferenceEngine:
             with self._cond:
                 self._batches_in_flight -= 1
             self._fail_batch(reqs, model, e)
+            return
+        from ..utils import faults
+        if faults.get_injector().bad_canary(model):
+            probs = np.full_like(probs, np.nan)
+        if not np.isfinite(probs[:n]).all():
+            # a poisoned head (nan/inf rows) must never reach a caller:
+            # fail the batch typed — the per-version SLO judge sees the
+            # availability burn and the rollout controller rolls back
+            with self._cond:
+                self._batches_in_flight -= 1
+            self._fail_batch(reqs, model, ServingError(
+                f"model {model!r} produced non-finite probabilities — "
+                f"refusing to serve them"))
             return
         infer_ms = (t_done - t_dispatch) * 1e3
         self._m_infer.observe(infer_ms / 1e3)

@@ -78,6 +78,17 @@ first-class, deterministic test input.  Faults are described by the
                                 transfer); the crc-verified resume must
                                 detect and finish it, never serve the
                                 torn prefix.  Fires once per process
+              | bad_canary    — arg = model or version id: that serving
+                                model's head produces NaN rows on EVERY
+                                batch (a bad deployment, not a blip).
+                                The engine's non-finite guard turns the
+                                rows into typed failures, the per-version
+                                SLO judge burns, and the rollout
+                                controller must auto-roll back.  NOTE the
+                                arg uses ':' (``bad_canary:mv-abc``), as
+                                '@' is the modifier separator — it
+                                matches the full versioned serving name,
+                                its base model, or its version id
 
 Scoping:
   @round:N   — fire at round N (required for crash/hang/straggle/
@@ -125,7 +136,8 @@ from typing import Callable, Mapping
 KINDS = ("crash", "perma_crash", "hang", "straggle", "slow_feed",
          "nan_inject", "corrupt_ckpt", "crash_in_ckpt", "corrupt_record",
          "feeder_die", "feeder_hang", "bitflip_params", "preempt",
-         "partition", "heal", "slow_link", "drop_ship", "torn_ship")
+         "partition", "heal", "slow_link", "drop_ship", "torn_ship",
+         "bad_canary")
 
 # the network kinds: consumed by parallel/transport.ChaosTransport, not
 # by the in-process hook points
@@ -135,11 +147,15 @@ _NEED_HOST = ("partition", "heal", "slow_link")
 
 # kinds that keep firing on every job attempt unless @attempt pins one
 # (network state belongs to the link, not to any one attempt)
-_EVERY_ATTEMPT = ("slow_feed", "perma_crash", "corrupt_record") + NET_KINDS
+_EVERY_ATTEMPT = ("slow_feed", "perma_crash", "corrupt_record",
+                  "bad_canary") + NET_KINDS
 # kinds whose ':' arg is a duration
 _DURATION_ARG = ("slow_feed", "straggle", "feeder_hang", "slow_link")
 # kinds whose ':' arg is a probability in (0, 1]
 _PROB_ARG = ("corrupt_record", "drop_ship")
+# kinds whose ':' arg names a serving model / version ('@' is taken by
+# the modifier grammar, so the name rides the ':' arg)
+_NAME_ARG = ("bad_canary",)
 # kinds that must name a round (for feeder_* the "round" is the batch
 # sequence index the prefetch feeder is about to produce)
 _NEED_ROUND = ("crash", "hang", "straggle", "nan_inject", "crash_in_ckpt",
@@ -155,6 +171,7 @@ class FaultSpec:
     delay_s: float = 0.0           # slow_feed/straggle/feeder_hang/slow_link
     prob: float = 0.0              # corrupt_record / drop_ship only
     host: str | None = None        # partition / heal / slow_link only
+    model: str | None = None       # bad_canary only
 
 
 def _parse_duration(text: str) -> float:
@@ -186,7 +203,15 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
                              f"(known: {', '.join(KINDS)})")
         delay = 0.0
         prob = 0.0
-        if kind in _DURATION_ARG:
+        model: str | None = None
+        if kind in _NAME_ARG:
+            if not arg:
+                raise ValueError(
+                    f"{kind} needs a model-or-version arg in {raw!r} "
+                    f"(e.g. 'bad_canary:mv-abc123' — ':' not '@', the "
+                    f"'@' is the modifier separator)")
+            model = arg.strip()
+        elif kind in _DURATION_ARG:
             if not arg:
                 raise ValueError(f"{kind} needs a duration arg in {raw!r}")
             delay = _parse_duration(arg)
@@ -241,7 +266,8 @@ def parse_faults(text: str) -> tuple[FaultSpec, ...]:
         specs.append(FaultSpec(kind=kind, round=fields.get("round"),
                                rank=fields.get("rank"),
                                attempt=fields.get("attempt"),
-                               delay_s=delay, prob=prob, host=host))
+                               delay_s=delay, prob=prob, host=host,
+                               model=model))
     return tuple(specs)
 
 
@@ -429,6 +455,23 @@ class FaultInjector:
             if spec.kind == "feeder_die":
                 return ("die", 0.0)
             return ("hang", spec.delay_s)
+
+    def bad_canary(self, model: str, rank: int | None = None) -> bool:
+        """True when serving model ``model`` should produce NaN rows on
+        this batch.  Fires on EVERY batch (a bad deployment stays bad —
+        the rollout judge needs a sustained burn, not a blip).  The spec
+        arg matches the full versioned serving name, its base model, or
+        bare version id, so a soak can plant the fault by version alone
+        (``bad_canary:mv-abc123``)."""
+        for spec in self.specs:
+            if spec.kind != "bad_canary" or not self._active(spec, rank):
+                continue
+            want = spec.model or ""
+            if (model == want
+                    or model.rsplit("@", 1)[-1] == want
+                    or model.split("@", 1)[0] == want):
+                return True
+        return False
 
     def bitflip_rank(self, round_idx: int) -> int | None:
         """The replica index whose resident params should get a bit

@@ -446,6 +446,29 @@ _register(
     Knob("SPARKNET_AUTOSCALE_EVAL_S", "float", "1.0",
          "Policy evaluation period seconds.",
          "sparknet_tpu/parallel/autoscale.py"),
+    # --- deployment plane (model registry + canary rollout) ---
+    Knob("SPARKNET_REGISTRY_DIR", "path", "",
+         "Root of the immutable model registry (version bundles + "
+         "per-model channel files). Unset = deployment plane off, "
+         "plain by-name serving.",
+         "sparknet_tpu/parallel/registry.py"),
+    Knob("SPARKNET_ROLLOUT_CANARY_FRACTION", "float", "0.1",
+         "Traffic share a newly started canary takes (0, 1].",
+         "sparknet_tpu/parallel/rollout.py"),
+    Knob("SPARKNET_ROLLOUT_JUDGE_S", "float", "8.0",
+         "Sustained-health seconds before the judge promotes a canary.",
+         "sparknet_tpu/parallel/rollout.py"),
+    Knob("SPARKNET_ROLLOUT_POLL_S", "float", "0.5",
+         "Judge poll interval seconds.",
+         "sparknet_tpu/parallel/rollout.py"),
+    Knob("SPARKNET_ROLLOUT_MIN_REQUESTS", "int", "20",
+         "Observed-request floor before a canary is promotable (blips "
+         "over tiny samples never decide a rollout).",
+         "sparknet_tpu/parallel/rollout.py"),
+    Knob("SPARKNET_ROLLOUT_BREACH_POLLS", "int", "2",
+         "Consecutive breach verdicts that trigger auto-rollback "
+         "(multi-window burn discipline: one blip never pages).",
+         "sparknet_tpu/parallel/rollout.py"),
     # --- CI gates (read by the tier-1 runner, not by library code) ---
     Knob("SPARKNET_LINT", "bool", "1",
          "Set to 0 to skip the sparklint gate in tools/run_tier1.sh "
@@ -505,6 +528,11 @@ _register(
          "tools/run_tier1.sh"),
     Knob("SPARKNET_PERFGATE", "bool", "",
          "Set to 1 to run the perf regression gate in run_tier1.sh.",
+         "tools/run_tier1.sh"),
+    Knob("SPARKNET_ROLLSMOKE", "bool", "",
+         "Set to 1 to run the rollout chaos leg (canary promote + "
+         "planted-bad-canary rollback + controller-kill resume) in "
+         "run_tier1.sh.",
          "tools/run_tier1.sh"),
     # --- tombstones: window closed, any surviving mention fails lint ---
     Knob("SPARKNET_LRN_CUMSUM", "bool", "",

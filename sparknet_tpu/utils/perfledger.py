@@ -677,6 +677,49 @@ def entries_from_netsoak(doc: Mapping[str, Any],
                        **prov)]
 
 
+def entries_from_rollout(doc: Mapping[str, Any],
+                         path: str | None = None, *,
+                         round_tag: str | None = None,
+                         t: float | None = None,
+                         device_hint: str | None = None) -> list[dict]:
+    """tools/soak.py ``--rollout`` verdicts (SOAK_rollout_*): the
+    deployment-plane chaos legs.  The banded numbers are the
+    promote-path wall (good canary start→judged→promoted), the breach
+    detection-to-rollback wall (planted bad canary), the journal-replay
+    resume wall, and the stable-pinned error count (MUST stay 0 — a
+    rollout that bleeds onto stable traffic is the regression this
+    ledger exists to catch)."""
+    if doc.get("mode") != "rollout" or not doc.get("episodes"):
+        return []
+    by_name = {ep.get("episode"): ep for ep in doc["episodes"]}
+    prov = _prov_fields(doc)
+    fp = fingerprint(model="lenet", dtype="f32", world=1, replicas=2,
+                     device=device_hint)
+    metrics: dict[str, Any] = {}
+    promo = by_name.get("canary_promote")
+    if promo:
+        metrics["rollout_promote_s"] = promo.get("elapsed_s")
+        metrics["rollout_stable_errors"] = promo.get("stable_errors")
+    bad = by_name.get("bad_canary_rollback")
+    if bad:
+        metrics["rollout_detect_s"] = bad.get("detect_s")
+        if bad.get("stable_errors") is not None:
+            metrics["rollout_stable_errors"] = (
+                (metrics.get("rollout_stable_errors") or 0)
+                + bad["stable_errors"])
+    kill = by_name.get("controller_kill_resume")
+    if kill:
+        metrics["rollout_resume_s"] = kill.get("elapsed_s")
+    metrics = {k: v for k, v in metrics.items() if v is not None}
+    if not metrics:
+        return []
+    return [make_entry("rollout", path, fp, metrics,
+                       round_tag=round_tag, t=t,
+                       notes=None if doc.get("ok")
+                       else "rollout soak FAILED",
+                       **prov)]
+
+
 def entries_from_roundbench(doc: Mapping[str, Any],
                             path: str | None = None, *,
                             round_tag: str | None = None,
@@ -845,6 +888,9 @@ def entries_from_any(doc: Mapping[str, Any], path: str | None = None, *,
                                     device_hint=device_hint)
     if doc.get("mode") == "net" and "episodes" in doc:
         return entries_from_netsoak(doc, path, round_tag=round_tag, t=t,
+                                    device_hint=device_hint)
+    if doc.get("mode") == "rollout" and "episodes" in doc:
+        return entries_from_rollout(doc, path, round_tag=round_tag, t=t,
                                     device_hint=device_hint)
     if doc.get("kind") == "tuning_table":
         return entries_from_tuning_table(doc, path, round_tag=round_tag,

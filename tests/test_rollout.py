@@ -1,0 +1,600 @@
+"""Deployment plane coverage: the immutable content-hashed model
+registry (publication fence, drift refusals, channel pointers), the
+router's weighted stable-vs-canary placement (deterministic per-request,
+pin-respecting), the 507 -> OverBudget wire mapping (typed, never a
+failover hop), the planted ``bad_canary`` fault + the engine's
+non-finite output guard, and the rollout controller's judged
+promote/rollback transitions with write-ahead journal crash recovery.
+
+Controller units run on scripted verdicts and a fake clock; the one
+real-engine test pins the NaN-guard contract (a poisoned model fails
+requests TYPED and never serves a non-finite row).  The end-to-end
+composition — real registry, real router, per-version engines, judged
+promote AND judged rollback under planted faults — is the
+``run_tier1.sh --rollsmoke`` gate (tools/soak.py --rollout).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.parallel.registry import (
+    DuplicateVersion, ModelRegistry, UnknownVersion, active_registry,
+    split_versioned, versioned,
+)
+from sparknet_tpu.parallel.rollout import (
+    JOURNAL, RolloutConfig, RolloutController, RolloutError, replay,
+    status,
+)
+from sparknet_tpu.parallel.router import (
+    HttpReplica, RolloutState, Router, RouterConfig,
+)
+from sparknet_tpu.parallel.serving import (
+    InferenceEngine, ModelHouse, OverBudget, ServeConfig, ServingError,
+    UnknownModel,
+)
+from sparknet_tpu.utils import faults
+
+pytestmark = pytest.mark.rollout
+
+
+# ---------------------------------------------------------------------------
+# Registry: publication fence, refusal discipline, channel pointers
+# ---------------------------------------------------------------------------
+
+def test_publish_roundtrip_and_immutability(tmp_path):
+    reg = ModelRegistry(tmp_path / "reg")
+    vid = reg.publish("lenet", slo={"p99_ms": 50.0}, notes="first")
+    assert vid.startswith("mv-")
+    man = reg.manifest("lenet", vid)
+    assert man["model"] == "lenet" and man["id"] == vid
+    assert man["slo"] == {"p99_ms": 50.0}
+    assert "provenance" in man
+    assert reg.versions("lenet") == [vid]
+    # identical content re-published: typed, carrying the existing id
+    with pytest.raises(DuplicateVersion) as ei:
+        reg.publish("lenet", slo={"p99_ms": 50.0}, notes="first")
+    assert ei.value.version == vid
+    # different content is a different id
+    v2 = reg.publish("lenet", slo={"p99_ms": 50.0}, notes="second")
+    assert v2 != vid and sorted(reg.versions("lenet")) == sorted([vid, v2])
+
+
+def test_unknown_version_is_typed(tmp_path):
+    reg = ModelRegistry(tmp_path / "reg")
+    with pytest.raises(UnknownVersion) as ei:
+        reg.manifest("lenet", "mv-nope")
+    assert isinstance(ei.value, KeyError)
+    assert "lenet" in str(ei.value) and "mv-nope" in str(ei.value)
+
+
+def test_versioned_name_grammar(tmp_path):
+    assert versioned("lenet", "mv-1") == "lenet@mv-1"
+    assert split_versioned("lenet@mv-1") == ("lenet", "mv-1")
+    assert split_versioned("lenet") == ("lenet", None)
+    reg = ModelRegistry(tmp_path / "reg")
+    with pytest.raises(ValueError, match="reserved"):
+        reg.publish("bad@name")
+
+
+def _plant_manifest(root, model, vid, doc):
+    d = os.path.join(root, model, vid)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(doc, f)
+
+
+def test_manifest_drift_refusals(tmp_path):
+    reg = ModelRegistry(tmp_path / "reg")
+    vid = reg.publish("lenet")
+    good = reg.manifest("lenet", vid)
+    # no integer schema version
+    _plant_manifest(reg.root, "lenet", "mv-drift",
+                    {**good, "id": "mv-drift", "version": "one"})
+    with pytest.raises(ValueError, match="refusing a drifted file"):
+        reg.manifest("lenet", "mv-drift")
+    # newer schema than this build
+    _plant_manifest(reg.root, "lenet", "mv-new",
+                    {**good, "id": "mv-new", "version": 99})
+    with pytest.raises(ValueError, match="refusing to guess"):
+        reg.manifest("lenet", "mv-new")
+    # a moved/renamed bundle is a corrupted bundle
+    _plant_manifest(reg.root, "lenet", "mv-moved", good)
+    with pytest.raises(ValueError, match="moved bundle"):
+        reg.manifest("lenet", "mv-moved")
+    # not a manifest at all
+    _plant_manifest(reg.root, "lenet", "mv-kind",
+                    {"kind": "something_else"})
+    with pytest.raises(ValueError, match="not a model-version manifest"):
+        reg.manifest("lenet", "mv-kind")
+
+
+def test_weight_bundle_rot_is_refused(tmp_path):
+    w = tmp_path / "w.npz"
+    np.savez(w, layer0=np.arange(4, dtype=np.float32))
+    reg = ModelRegistry(tmp_path / "reg")
+    vid = reg.publish("lenet", weights=str(w))
+    path = reg.weights_path("lenet", vid)
+    assert path is not None and os.path.dirname(path).endswith(vid)
+    # the registry owns its copy: the source rotting changes nothing
+    w.write_bytes(b"rotten")
+    assert reg.weights_path("lenet", vid) == path
+    # the BUNDLE rotting is refused loudly
+    with open(path, "ab") as f:
+        f.write(b"x")
+    with pytest.raises(ValueError, match="rotted"):
+        reg.weights_path("lenet", vid)
+
+
+def test_channels_lifecycle(tmp_path):
+    reg = ModelRegistry(tmp_path / "reg")
+    assert reg.channels("lenet") == {"stable": None, "canary": None,
+                                     "weight": 0.0}
+    v1 = reg.publish("lenet", notes="a")
+    v2 = reg.publish("lenet", notes="b")
+    # pointers may only name published bytes
+    with pytest.raises(UnknownVersion):
+        reg.set_channels("lenet", stable="mv-ghost")
+    reg.set_channels("lenet", stable=v1)
+    reg.set_channels("lenet", canary=v2, weight=0.25)
+    ch = reg.channels("lenet")
+    assert ch == {"stable": v1, "canary": v2, "weight": 0.25}
+    assert reg.resolve("lenet") == v1
+    assert reg.resolve("lenet", "canary") == v2
+    assert reg.channel_of("lenet", v1) == "stable"
+    assert reg.channel_of("lenet", v2) == "canary"
+    assert reg.channel_of("lenet", "mv-ghost") is None
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        reg.set_channels("lenet", weight=1.5)
+    # clearing the canary zeroes its weight (no ghost traffic share)
+    reg.set_channels("lenet", canary=None)
+    assert reg.channels("lenet") == {"stable": v1, "canary": None,
+                                     "weight": 0.0}
+    with pytest.raises(UnknownVersion):
+        reg.resolve("lenet", "canary")
+
+
+def test_channels_drift_refusal(tmp_path):
+    reg = ModelRegistry(tmp_path / "reg")
+    os.makedirs(os.path.join(reg.root, "lenet"), exist_ok=True)
+    with open(os.path.join(reg.root, "lenet", "channels.json"), "w") as f:
+        f.write("not json{")
+    with pytest.raises(ValueError, match="unparseable"):
+        reg.channels("lenet")
+    with open(os.path.join(reg.root, "lenet", "channels.json"), "w") as f:
+        json.dump({"kind": "model_channels", "version": 99}, f)
+    with pytest.raises(ValueError, match="refusing to guess"):
+        reg.channels("lenet")
+
+
+def test_active_registry_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("SPARKNET_REGISTRY_DIR", raising=False)
+    assert active_registry() is None
+    monkeypatch.setenv("SPARKNET_REGISTRY_DIR", str(tmp_path / "reg"))
+    reg = active_registry()
+    assert reg is not None and reg.root == str(tmp_path / "reg")
+
+
+# ---------------------------------------------------------------------------
+# RolloutState: deterministic weighted placement
+# ---------------------------------------------------------------------------
+
+def test_rollout_state_is_deterministic_and_weighted():
+    st = RolloutState(model="m", stable="v1", canary="v2", weight=0.5)
+    keys = [f"k{i}" for i in range(2000)]
+    first = [st.target(k) for k in keys]
+    assert first == [st.target(k) for k in keys]      # pure function
+    share = sum(1 for t in first if t == "m@v2") / len(first)
+    assert 0.4 < share < 0.6                          # hash-fraction split
+    assert all(RolloutState(model="m", stable="v1").target(k) == "m@v1"
+               for k in keys[:50])                    # no canary: stable
+    full = RolloutState(model="m", stable="v1", canary="v2", weight=1.0)
+    assert all(full.target(k) == "m@v2" for k in keys[:50])
+
+
+def test_rollout_state_validation():
+    with pytest.raises(ValueError, match="stable"):
+        RolloutState(model="m", stable="")
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        RolloutState(model="m", stable="v1", canary="v2", weight=1.5)
+    with pytest.raises(ValueError, match="no canary"):
+        RolloutState(model="m", stable="v1", weight=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Router: rollout resolution, version pins, 507 -> OverBudget
+# ---------------------------------------------------------------------------
+
+class _StubFuture:
+    def __init__(self, value):
+        self._value = value
+
+    def done(self):
+        return True
+
+    def result(self, timeout=None):
+        return self._value
+
+
+class _StubClient:
+    def __init__(self, rid, models):
+        self.rid = rid
+        self.models = frozenset(models)
+        self.calls = 0
+        self.raise_on_submit = None
+
+    def submit(self, model, x, tenant):
+        self.calls += 1
+        if self.raise_on_submit is not None:
+            raise self.raise_on_submit
+        return _StubFuture((self.rid, model))
+
+    def alive(self):
+        return True
+
+    def describe(self):
+        return {"transport": "stub"}
+
+
+def test_router_resolves_rollout_and_respects_pins():
+    r1 = _StubClient("r1", ["m@v1"])
+    r2 = _StubClient("r2", ["m@v2"])
+    router = Router(RouterConfig())
+    router.add_replica("r1", r1)
+    router.add_replica("r2", r2)
+    x = np.ones(3, np.float32)
+    # no rollout installed: the plain name is unroutable in a fully
+    # versioned fleet — typed, not silently guessed
+    with pytest.raises(UnknownModel):
+        router.submit("m", x)
+    router.set_rollout(RolloutState(model="m", stable="v1", canary="v2",
+                                    weight=1.0))
+    assert router.submit("m", x).result(5) == ("r2", "m@v2")
+    # an explicit pin bypasses the dice roll entirely
+    assert router.submit("m", x, version="v1").result(5) == ("r1", "m@v1")
+    assert router.rollout("m").canary == "v2"
+    assert router.stats()["rollouts"]["m"]["weight"] == 1.0
+    # back to stable-only: plain traffic all-stable again
+    router.set_rollout(RolloutState(model="m", stable="v1"))
+    assert router.submit("m", x).result(5) == ("r1", "m@v1")
+    router.clear_rollout("m")
+    assert router.rollout("m") is None
+
+
+def test_router_split_is_per_request_sticky():
+    r1 = _StubClient("r1", ["m@v1"])
+    r2 = _StubClient("r2", ["m@v2"])
+    router = Router(RouterConfig())
+    router.add_replica("r1", r1)
+    router.add_replica("r2", r2)
+    router.set_rollout(RolloutState(model="m", stable="v1", canary="v2",
+                                    weight=0.5))
+    xs = [np.full(3, i, np.float32) for i in range(20)]
+    lands = [router.submit("m", x, tenant="t").result(5)[1] for x in xs]
+    assert set(lands) == {"m@v1", "m@v2"}    # both sides get traffic
+    # the same request replayed never flaps across the canary boundary
+    assert lands == [router.submit("m", x, tenant="t").result(5)[1]
+                     for x in xs]
+
+
+def test_http_507_maps_to_typed_overbudget(monkeypatch):
+    from sparknet_tpu import classify as classify_mod
+
+    def boom(url, model, x, tenant="anon", timeout=None):
+        raise RuntimeError(
+            f"{url}/v1/classify: HTTP 507 (over_budget model {model!r} "
+            f"needs 10.0 MB of params but the HBM budget is 5 MB — it "
+            f"could never fit)")
+
+    monkeypatch.setattr(classify_mod, "remote_classify", boom)
+    rep = HttpReplica("r0", "http://127.0.0.1:1", models=("m",))
+    with pytest.raises(OverBudget) as ei:
+        rep.submit("m", np.zeros(2, np.float32), "t")
+    assert ei.value.param_mb == 10.0
+    assert ei.value.budget_mb == 5.0
+
+
+def test_overbudget_is_never_a_failover_hop():
+    r1 = _StubClient("r1", ["m"])
+    r2 = _StubClient("r2", ["m"])
+    router = Router(RouterConfig())
+    router.add_replica("r1", r1)
+    router.add_replica("r2", r2)
+    home = router.home("m")
+    victim = {"r1": r1, "r2": r2}[home]
+    other = r2 if victim is r1 else r1
+    victim.raise_on_submit = OverBudget("m", 10.0, 5.0)
+    with pytest.raises(OverBudget):
+        router.submit("m", np.ones(2, np.float32))
+    # typed answer, zero failover burn, replica still healthy + settled
+    assert other.calls == 0
+    assert router.counts["failovers"] == 0
+    assert home in router.replica_ids("m")
+    assert router.outstanding(home) == 0
+
+
+# ---------------------------------------------------------------------------
+# bad_canary fault: spec grammar, injector matching, the NaN guard
+# ---------------------------------------------------------------------------
+
+def test_bad_canary_spec_parse():
+    (spec,) = faults.parse_faults("bad_canary:mv-abc123")
+    assert spec.kind == "bad_canary" and spec.model == "mv-abc123"
+    with pytest.raises(ValueError, match="':' not '@'"):
+        faults.parse_faults("bad_canary")
+    (spec,) = faults.parse_faults("bad_canary:mv-a@rank:1")
+    assert spec.model == "mv-a" and spec.rank == 1
+
+
+def test_bad_canary_injector_matching(monkeypatch):
+    monkeypatch.setenv("SPARKNET_FAULT", "bad_canary:mv-abc")
+    inj = faults.get_injector()
+    assert inj.bad_canary("lenet@mv-abc")     # full versioned name
+    assert inj.bad_canary("mv-abc")           # bare version id
+    assert not inj.bad_canary("lenet@mv-other")
+    assert not inj.bad_canary("lenet")
+    monkeypatch.setenv("SPARKNET_FAULT", "bad_canary:lenet")
+    inj = faults.get_injector()               # env change re-parses
+    assert inj.bad_canary("lenet@mv-abc")     # base-model spelling
+    assert inj.bad_canary("lenet")
+
+
+@pytest.mark.serving
+def test_nan_guard_fails_requests_typed_and_engine_survives(monkeypatch):
+    cfg = ServeConfig(batch_shapes=(1,), seed=0)
+    house = ModelHouse(cfg)
+    lm = house.load("lenet")
+    eng = InferenceEngine(house, cfg)
+    try:
+        x = np.zeros(lm.in_shape, np.float32)
+        clean = eng.classify("lenet", x, timeout=60)
+        assert np.isfinite(clean.probs).all()
+        monkeypatch.setenv("SPARKNET_FAULT", "bad_canary:lenet")
+        faults.reset_injector()
+        with pytest.raises(ServingError, match="non-finite"):
+            eng.classify("lenet", x, timeout=60)
+        assert eng.alive                      # a bad model != a dead engine
+        assert eng.stats()["failed"] >= 1
+        monkeypatch.delenv("SPARKNET_FAULT")
+        faults.reset_injector()
+        again = eng.classify("lenet", x, timeout=60)
+        assert np.array_equal(clean.probs, again.probs)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Controller: judged transitions on scripted verdicts + a fake clock
+# ---------------------------------------------------------------------------
+
+def _verdict_doc(state, requests=50):
+    return {"state": state,
+            "windows": {"fast": {"requests": requests},
+                        "slow": {"requests": requests}}}
+
+
+class _Rig:
+    """Registry + stub fleet + fake clock around one controller."""
+
+    def __init__(self, tmp, **cfg_kw):
+        kw = dict(fraction=0.25, judge_s=2.0, poll_s=0.5,
+                  min_requests=5, breach_polls=2)
+        kw.update(cfg_kw)
+        self.reg = ModelRegistry(os.path.join(tmp, "registry"))
+        self.workdir = os.path.join(tmp, "wd")
+        self.up: set = set()
+        self.retired: list = []
+        self.verdicts: dict = {}
+        self.bands: dict = {}
+        self.now = 0.0
+        self.router = Router(RouterConfig())
+        self.ctl = self.controller()
+        self.v1 = self.reg.publish("demo", notes="v1")
+        self.v2 = self.reg.publish("demo", notes="v2")
+        self.reg.set_channels("demo", stable=self.v1)
+
+    def controller(self):
+        return RolloutController(
+            self.reg, self.workdir, ensure=self.up.add,
+            retire=self._retire, verdict=self.verdicts.get,
+            bands=lambda name: self.bands.get(name, []),
+            router=self.router,
+            cfg=RolloutConfig(fraction=0.25, judge_s=2.0, poll_s=0.5,
+                              min_requests=5, breach_polls=2),
+            clock=lambda: self.now)
+
+    def _retire(self, name):
+        self.retired.append(name)
+        self.up.discard(name)
+
+    def events(self):
+        return [(r["ev"], r.get("version"))
+                for r in map(json.loads,
+                             open(os.path.join(self.workdir, JOURNAL)))]
+
+
+def test_start_canary_refusal_discipline(tmp_path):
+    rig = _Rig(tmp_path)
+    with pytest.raises(RolloutError, match="IS the stable"):
+        rig.ctl.start_canary("demo", rig.v1)
+    with pytest.raises(UnknownVersion):
+        rig.ctl.start_canary("demo", "mv-ghost")
+    rig.ctl.start_canary("demo", rig.v2)
+    v3 = rig.reg.publish("demo", notes="v3")
+    with pytest.raises(RolloutError, match="already has canary"):
+        rig.ctl.start_canary("demo", v3)
+    # and a model with no stable baseline has nothing to roll back TO
+    rig.reg.publish("other", notes="x")
+    with pytest.raises(RolloutError, match="no stable"):
+        rig.ctl.start_canary("other", "mv-whatever")
+
+
+def test_judge_promotes_only_after_sustained_health_over_floor(tmp_path):
+    rig = _Rig(tmp_path)
+    rig.ctl.start_canary("demo", rig.v2, weight=0.25)
+    name = versioned("demo", rig.v2)
+    assert rig.up == {versioned("demo", rig.v1), name}
+    assert rig.reg.channels("demo")["canary"] == rig.v2
+    assert rig.router.rollout("demo").weight == 0.25
+    # healthy but young: keep watching
+    rig.verdicts[name] = _verdict_doc("ok")
+    assert rig.ctl.judge("demo") == "canary"
+    # enough wall time but too few observed requests: still watching
+    rig.now = 3.0
+    rig.verdicts[name] = _verdict_doc("ok", requests=2)
+    assert rig.ctl.judge("demo") == "canary"
+    # sustained health over the floor: promotable
+    rig.verdicts[name] = _verdict_doc("ok")
+    rig.now = 6.0
+    assert rig.ctl.judge("demo") == "promote"
+    rig.ctl.promote("demo")
+    ch = rig.reg.channels("demo")
+    assert ch == {"stable": rig.v2, "canary": None, "weight": 0.0}
+    assert versioned("demo", rig.v1) in rig.retired
+    assert rig.up == {name}
+    # the plain name keeps resolving (stable-only rollout state stays)
+    ro = rig.router.rollout("demo")
+    assert ro.stable == rig.v2 and ro.canary is None
+    evs = [e for e, _ in rig.events()]
+    assert evs == ["canary_begin", "canary_live", "judge",
+                   "promote_begin", "promote_done"]
+
+
+def test_judge_rolls_back_only_on_consecutive_breaches(tmp_path):
+    rig = _Rig(tmp_path)
+    rig.ctl.start_canary("demo", rig.v2)
+    name = versioned("demo", rig.v2)
+    # one breach is a blip, not a page
+    rig.verdicts[name] = _verdict_doc("breach")
+    assert rig.ctl.judge("demo") == "canary"
+    rig.verdicts[name] = _verdict_doc("ok")
+    assert rig.ctl.judge("demo") == "canary"   # streak reset
+    rig.verdicts[name] = _verdict_doc("breach")
+    assert rig.ctl.judge("demo") == "canary"
+    assert rig.ctl.judge("demo") == "rollback"  # 2nd consecutive
+    rig.ctl.rollback("demo", reason="sustained SLO breach")
+    ch = rig.reg.channels("demo")
+    assert ch == {"stable": rig.v1, "canary": None, "weight": 0.0}
+    assert name in rig.retired
+    ro = rig.router.rollout("demo")
+    assert ro.stable == rig.v1 and ro.canary is None
+    st = status(rig.workdir)["demo"]
+    assert st["phase"] == "stable" and st["canary"] is None
+    assert "breach" in st["last_rollback_reason"]
+
+
+def test_band_violations_judge_as_breach(tmp_path):
+    rig = _Rig(tmp_path)
+    rig.ctl.start_canary("demo", rig.v2)
+    name = versioned("demo", rig.v2)
+    rig.verdicts[name] = _verdict_doc("ok")
+    rig.bands[name] = ["step_s above band"]
+    assert rig.ctl.judge("demo") == "canary"
+    assert rig.ctl.judge("demo") == "rollback"
+
+
+def test_judge_journals_verdict_transitions_only(tmp_path):
+    rig = _Rig(tmp_path)
+    rig.ctl.start_canary("demo", rig.v2)
+    name = versioned("demo", rig.v2)
+    rig.verdicts[name] = _verdict_doc("ok")
+    for _ in range(10):
+        rig.ctl.judge("demo")
+    rig.verdicts[name] = _verdict_doc("breach")
+    rig.ctl.judge("demo")
+    evs = [e for e, _ in rig.events()]
+    assert evs.count("judge") == 2             # ok-transition + breach
+
+
+def test_resume_rolls_back_an_unjudged_canary(tmp_path):
+    rig = _Rig(tmp_path)
+    rig.ctl.start_canary("demo", rig.v2)
+    # the controller dies here; a fresh one must land fully stable
+    res = rig.controller().resume()
+    assert res == {"demo": "rolled_back"}
+    assert rig.reg.channels("demo") == {"stable": rig.v1, "canary": None,
+                                        "weight": 0.0}
+    assert versioned("demo", rig.v2) in rig.retired
+    assert rig.up == {versioned("demo", rig.v1)}
+    # replaying twice is a no-op
+    assert rig.controller().resume() == {"demo": "consistent"}
+
+
+def test_resume_finishes_a_durably_decided_promote(tmp_path):
+    rig = _Rig(tmp_path)
+
+    class _Killed(Exception):
+        pass
+
+    class _DiesApplying(RolloutController):
+        def _apply_promote(self, *a, **k):
+            raise _Killed()
+
+    ctl = _DiesApplying(
+        rig.reg, rig.workdir, ensure=rig.up.add, retire=rig._retire,
+        verdict=rig.verdicts.get, router=rig.router,
+        cfg=rig.ctl.cfg, clock=lambda: rig.now)
+    ctl.start_canary("demo", rig.v2)
+    with pytest.raises(_Killed):
+        ctl.promote("demo")
+    res = rig.controller().resume()
+    assert res == {"demo": "promoted"}
+    assert rig.reg.channels("demo") == {"stable": rig.v2, "canary": None,
+                                        "weight": 0.0}
+    assert versioned("demo", rig.v1) in rig.retired
+    assert rig.controller().resume() == {"demo": "consistent"}
+
+
+def test_replay_tolerates_a_torn_tail(tmp_path):
+    rig = _Rig(tmp_path)
+    rig.ctl.start_canary("demo", rig.v2)
+    path = os.path.join(rig.workdir, JOURNAL)
+    with open(path, "a") as f:
+        f.write('{"v": 1, "seq": 99, "ev": "promote_b')   # torn write
+    st = replay(path)["demo"]
+    assert st["phase"] == "canary" and st["canary"] == rig.v2
+    # resume still lands consistent off the intact prefix
+    assert rig.controller().resume() == {"demo": "rolled_back"}
+
+
+def test_status_is_none_for_a_workdir_that_never_rolled_out(tmp_path):
+    assert status(str(tmp_path)) is None
+
+
+def test_rollout_config_env_and_validation(monkeypatch):
+    with pytest.raises(ValueError, match="fraction"):
+        RolloutConfig(fraction=0.0)
+    with pytest.raises(ValueError, match="breach_polls"):
+        RolloutConfig(breach_polls=0)
+    monkeypatch.setenv("SPARKNET_ROLLOUT_CANARY_FRACTION", "0.2")
+    monkeypatch.setenv("SPARKNET_ROLLOUT_BREACH_POLLS", "5")
+    cfg = RolloutConfig.from_env()
+    assert cfg.fraction == 0.2 and cfg.breach_polls == 5
+
+
+# ---------------------------------------------------------------------------
+# ModelHouse.load_version: registry-resolved, versioned serving keys
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+def test_load_version_serves_under_versioned_key(tmp_path, monkeypatch):
+    monkeypatch.delenv("SPARKNET_REGISTRY_DIR", raising=False)
+    cfg = ServeConfig(batch_shapes=(1,), seed=0)
+    house = ModelHouse(cfg)
+    with pytest.raises(ValueError, match="SPARKNET_REGISTRY_DIR"):
+        house.load_version("lenet", "mv-x")
+    reg = ModelRegistry(tmp_path / "reg")
+    vid = reg.publish("lenet", slo={"p99_ms": 80.0})
+    with pytest.raises(UnknownVersion):
+        house.load_version("lenet", "mv-ghost", registry=reg)
+    lm = house.load_version("lenet", vid, registry=reg)
+    assert lm.name == versioned("lenet", vid)
+    assert lm.version == vid
+    assert lm.info()["version"] == vid
+    assert lm.declared_slo == {"p99_ms": 80.0}
+    # cache hit under the versioned key
+    assert house.load_version("lenet", vid, registry=reg) is lm

@@ -47,7 +47,12 @@ parse the human table.  A workdir that hosts a serving fleet
 serving rows: per-model replica counts, the autoscaler's last scale
 decision + reason (``autoscale.json``), the router table with
 per-replica state/outstanding/failure counts (``router.json``), and
-per-replica queue depth folded from the serving beacon extras.
+per-replica queue depth folded from the serving beacon extras.  A
+workdir with a rollout decision log (``rollout.jsonl``) additionally
+gets ``rollout:`` rows — per-model stable/canary versions, canary
+weight, phase, last judge verdict, and the last rollback reason —
+replayed from the journal, so they work with the rollout controller
+dead.
 
 Exit code 0 when every job completed; 3 when any was quarantined (each
 leaves a ``postmortem.json`` in its job dir).

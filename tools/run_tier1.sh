@@ -135,6 +135,24 @@ maybe_netsoak() {
   fi
 }
 
+# ~30-second rollout smoke (tools/soak.py --rollout) — opt-in via
+# SPARKNET_ROLLSMOKE=1.  Three deployment-plane legs over a real model
+# registry + router + per-version engines: a healthy canary must earn
+# promotion (green per-version SLO verdicts over the request floor,
+# old stable drained, pinned answers bit-identical across the pointer
+# flip), a planted bad_canary fault (NaN-emitting head, failed TYPED
+# by the engine) must auto-roll back within the judge window with zero
+# stable-pinned errors and a flight dump on disk, and a controller
+# killed mid-rollout must resume to exactly one of {fully stable,
+# fully promoted} with no orphan replicas.
+maybe_rollsmoke() {
+  if [ "${SPARKNET_ROLLSMOKE:-}" = "1" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python tools/soak.py --rollout --seed "${SPARKNET_SOAK_SEED:-0}" \
+      --out /tmp/_rollsmoke.json
+  fi
+}
+
 # ~2-second serving smoke (tools/serveload.py --smoke) — opt-in via
 # SPARKNET_SERVESMOKE=1.  In-process engine + closed-loop clients;
 # fails the gate unless results are bit-identical to solo references,
@@ -243,6 +261,7 @@ case "${1:-}" in
   --fleetsoak) SPARKNET_FLEETSOAK=1 maybe_fleetsoak ;;
   --podsoak) SPARKNET_PODSOAK=1 maybe_podsoak ;;
   --netsoak) SPARKNET_NETSOAK=1 maybe_netsoak ;;
+  --rollsmoke) SPARKNET_ROLLSMOKE=1 maybe_rollsmoke ;;
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --recordbench) SPARKNET_RECORDBENCH=1 maybe_recordbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
@@ -254,16 +273,17 @@ case "${1:-}" in
   --tunebench) SPARKNET_TUNEBENCH=1 maybe_tunebench ;;
   --all)   maybe_lint && run_tier1 && run_chaos && maybe_soak \
              && maybe_fleetsoak && maybe_podsoak && maybe_netsoak \
+             && maybe_rollsmoke \
              && maybe_feedbench && maybe_recordbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
              && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
              && maybe_perfgate ;;
   "")      maybe_lint && run_tier1 && maybe_soak && maybe_fleetsoak \
-             && maybe_podsoak && maybe_netsoak \
+             && maybe_podsoak && maybe_netsoak && maybe_rollsmoke \
              && maybe_feedbench && maybe_recordbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
              && maybe_roundbench && maybe_obssmoke \
              && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--netsoak|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--netsoak|--rollsmoke|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac

@@ -28,9 +28,18 @@ Endpoints:
                          registry (queue depth, p50/p99, rejections,
                          request/infer latency histograms; see
                          sparknet_tpu/utils/telemetry.py).
-  GET  /v1/models        loaded models with shapes/classes/bytes.
-  POST /v1/models/load   {"name": m, "weights": path?} — hot-load.
+  GET  /v1/models        loaded models with shapes/classes/bytes (and
+                         version + channel for registry loads).
+  POST /v1/models/load   {"name": m, "weights": path?} — hot-load; or
+                         {"model": m, "version": v} — load a published
+                         registry version (needs SPARKNET_REGISTRY_DIR)
+                         under its versioned key m@v.
   POST /v1/models/evict  {"name": m}.
+
+/v1/classify accepts an optional "version": v — the request pins to
+that published version (serving name m@v) bit-identically, bypassing
+any canary split the router may be running.  --models accepts versioned
+specs ("lenet@mv-abc123") that load from the registry.
 
 Usage:
   python tools/serve.py --models lenet,cifar10_quick --port 8100 \
@@ -134,7 +143,20 @@ def make_handler(engine, house):
                 self.end_headers()
                 self.wfile.write(body)
             elif self.path == "/v1/models":
-                self._send(200, {"models": house.loaded()})
+                models = house.loaded()
+                reg = None
+                if any(info.get("version") for info in models.values()):
+                    from sparknet_tpu.parallel.registry import (
+                        active_registry,
+                    )
+                    reg = active_registry()
+                if reg is not None:
+                    for info in models.values():
+                        if info.get("version"):
+                            info["channel"] = reg.channel_of(
+                                info["name"].partition("@")[0],
+                                info["version"])
+                self._send(200, {"models": models})
             else:
                 self._send(404, {"error": f"no route {self.path!r}"})
 
@@ -145,8 +167,13 @@ def make_handler(engine, house):
                 return self._send(400, {"error": f"bad JSON: {e}"})
             try:
                 if self.path == "/v1/classify":
+                    model = payload.get("model", "")
+                    if payload.get("version"):
+                        # version pin: the request hits exactly that
+                        # published version, rollout splits never apply
+                        model = f"{model}@{payload['version']}"
                     res = engine.classify(
-                        payload.get("model", ""), decode_array(payload),
+                        model, decode_array(payload),
                         tenant=str(payload.get("tenant", "anon")),
                         timeout=float(payload.get("timeout_s", 30.0)))
                     return self._send(200, {
@@ -156,6 +183,15 @@ def make_handler(engine, house):
                         "infer_ms": res.infer_ms, "total_ms": res.total_ms,
                         "batch_n": res.batch_n, "padded_to": res.padded_to})
                 if self.path == "/v1/models/load":
+                    if payload.get("version"):
+                        # registry path: {"model": m, "version": v} loads
+                        # the published bundle under its versioned key
+                        lm = house.load_version(
+                            payload.get("model") or payload.get("name"),
+                            payload["version"],
+                            force=(True if payload.get("force")
+                                   else None))
+                        return self._send(200, {"loaded": lm.info()})
                     lm = house.load(payload["name"],
                                     weights=payload.get("weights"),
                                     force=(True if payload.get("force")
@@ -257,6 +293,18 @@ def make_fleet_handler(fleet):
                     for m in r["models"]:
                         models.setdefault(m, {"replicas": 0})
                         models[m]["replicas"] += 1
+                reg = None
+                if any("@" in m for m in models):
+                    from sparknet_tpu.parallel.registry import (
+                        active_registry,
+                    )
+                    reg = active_registry()
+                for m, info in models.items():
+                    base, sep, ver = m.partition("@")
+                    if sep:
+                        info["version"] = ver
+                        if reg is not None:
+                            info["channel"] = reg.channel_of(base, ver)
                 self._send(200, {"models": models})
             elif u.path == "/metrics":
                 from sparknet_tpu.utils import telemetry
@@ -281,7 +329,8 @@ def make_fleet_handler(fleet):
                     res = fleet.router.classify(
                         payload.get("model", ""), decode_array(payload),
                         tenant=str(payload.get("tenant", "anon")),
-                        timeout=float(payload.get("timeout_s", 30.0)))
+                        timeout=float(payload.get("timeout_s", 30.0)),
+                        version=payload.get("version") or None)
                     return self._send(200, {
                         "model": res.model, "request_id": res.request_id,
                         "probs": [float(p) for p in res.probs],
@@ -437,6 +486,7 @@ def main(argv=None) -> int:
         return fleet_main(args, cfg, stop)
 
     house = ModelHouse(cfg)
+    declared_p99: list[float] = []
     for name, weights in parse_models(args.models):
         if stop.is_set():
             # preempted while warming up: checkpoint-and-stop semantics
@@ -444,13 +494,30 @@ def main(argv=None) -> int:
             print("[serve] stopped during warm-up", file=sys.stderr,
                   flush=True)
             return 0
-        lm = house.load(name, weights=weights)
+        if "@" in name:
+            # registry spec ("lenet@mv-abc123"): the published bundle
+            # resolves the weights; =path would be a second truth
+            if weights:
+                raise SystemExit(f"--models {name}={weights}: a "
+                                 f"versioned spec takes no =weights "
+                                 f"(the registry bundle IS the weights)")
+            base, version = name.split("@", 1)
+            lm = house.load_version(base, version)
+            slo = getattr(lm, "declared_slo", None)
+            if isinstance(slo, dict) and slo.get("p99_ms"):
+                declared_p99.append(float(slo["p99_ms"]))
+        else:
+            lm = house.load(name, weights=weights)
         print(f"[serve] loaded {name}: in={lm.in_shape} "
               f"classes={lm.classes} {lm.param_bytes / 2**20:.1f} MB, "
               f"compiled {len(cfg.batch_shapes)} shapes in "
               f"{lm.compile_s:.1f}s", file=sys.stderr, flush=True)
 
     engine = InferenceEngine(house, cfg)
+    if cfg.slo_p99_ms is None and declared_p99:
+        # adopt the strictest manifest-declared p99 across versioned
+        # loads — a version that declared its SLO is judged against it
+        engine.slo.p99_ms = min(declared_p99)
     httpd = ThreadingHTTPServer((args.host, args.port),
                                 make_handler(engine, house))
     httpd.daemon_threads = True

@@ -59,16 +59,35 @@ kill, bit-identical finish.  A full ``--pod`` episode set appends the
 same legs, so the pod burn-in exercises them too; ``--net-slice`` keeps
 the ~60 s CI shape (legs 1 + 2).
 
+Rollout mode (``--rollout``) is the DEPLOYMENT-PLANE burn-in (PR 18):
+three legs over a real registry + router + per-version engines in one
+process.  (1) *canary-promote* — a healthy canary at 50 % traffic must
+earn promotion through sustained green per-version SLO verdicts over
+the request floor, with the old stable drained through the router
+fences and pinned-canary answers bit-identical across the pointer
+flip; (2) *bad-canary-rollback* — a canary poisoned with the planted
+``bad_canary`` fault (its head emits NaNs; the engine fails those
+requests TYPED, never serves them) must be auto-rolled back by the
+judge within the breach window, with zero errors on stable-pinned
+traffic, zero non-finite rows served, the channel pointer reverted,
+the canary drained, and a flight dump on disk; (3) *controller-kill-
+resume* — a controller killed after ``canary_live`` must resume to
+fully-stable (an unjudged canary takes no traffic) and one killed
+between ``promote_begin`` and its ``done`` must resume to
+fully-promoted, both idempotently with no orphan replicas.
+
 Usage:
   python tools/soak.py --runs 8 --seed 0 --out soak.json
   python tools/soak.py --fleet 4 --fleet-kill --seed 0   # fleet chaos
   python tools/soak.py --pod 3 --seed 0 --out SOAK_pod.json
   python tools/soak.py --pod 3 --forever   # standing burn-in
   python tools/soak.py --net --seed 0 --out SOAK_net.json
+  python tools/soak.py --rollout --seed 0 --out SOAK_rollout.json
   SPARKNET_SOAK=1 tools/run_tier1.sh       # the 2-run CI smoke
   SPARKNET_FLEETSOAK=1 tools/run_tier1.sh  # the 2-job fleet smoke
   SPARKNET_PODSOAK=1 tools/run_tier1.sh    # the 3-host pod slice
   SPARKNET_NETSOAK=1 tools/run_tier1.sh    # the 2-leg net slice
+  SPARKNET_ROLLSMOKE=1 tools/run_tier1.sh  # the 3-leg rollout smoke
 
 Exit code 0 iff every run recovered exactly; the JSON verdict names each
 run's schedule, exit code, attempt count, and whether the params matched.
@@ -1039,6 +1058,377 @@ def pod_soak(args) -> int:
     return 0 if report["ok"] else 1
 
 
+# ---------------------------------------------------------------------------
+# Rollout chaos legs (--rollout): the deployment plane end to end — a
+# healthy canary must promote, a poisoned canary must auto-roll back
+# with zero client-visible damage on stable traffic, and a controller
+# killed mid-rollout must resume to a consistent fleet.
+
+
+class _RolloutFleet:
+    """In-process serving tier keyed by VERSIONED name: the rollout
+    controller's ensure/retire/verdict wiring.  Every versioned name
+    gets its own house + engine (whose built-in per-version SLOMonitor
+    is the judge's verdict source) behind one real Router — the same
+    shape ``tools/serve.py --fleet`` runs, minus the HTTP hop."""
+
+    def __init__(self, registry, cfg, router):
+        self.registry = registry
+        self.cfg = cfg
+        self.router = router
+        self.live: dict = {}      # versioned name -> (rid, engine)
+
+    def ensure(self, name: str) -> None:
+        if name in self.live:
+            return
+        from sparknet_tpu.parallel.router import InProcessReplica
+        from sparknet_tpu.parallel.serving import InferenceEngine, ModelHouse
+        model, _, version = name.partition("@")
+        house = ModelHouse(self.cfg)
+        house.load_version(model, version, registry=self.registry)
+        eng = InferenceEngine(house, self.cfg)
+        rid = f"r-{version}"
+        self.router.add_replica(rid, InProcessReplica(rid, eng))
+        self.live[name] = (rid, eng)
+
+    def retire(self, name: str) -> None:
+        ent = self.live.pop(name, None)
+        if ent is None:
+            return
+        rid, eng = ent
+        self.router.drain(rid, timeout_s=30.0)
+        eng.stop()
+
+    def verdict(self, name: str):
+        ent = self.live.get(name)
+        if ent is None:
+            return None
+        return ent[1].slo.evaluate()
+
+    def close(self) -> None:
+        for name in list(self.live):
+            self.retire(name)
+
+
+def _rollout_promote_episode(ctl, fleet, reg, router, inputs, refs,
+                             v1, v2) -> dict:
+    """A HEALTHY canary must earn promotion: sustained green verdicts
+    over the request floor, old stable drained, pinned-canary answers
+    bit-identical across the pointer flip."""
+    import numpy as np
+    from sparknet_tpu.parallel.registry import versioned
+    from sparknet_tpu.parallel.serving import ServingError
+
+    t0 = time.monotonic()
+    reg.set_channels("lenet", stable=v1)
+    fleet.ensure(versioned("lenet", v1))
+    ctl.start_canary("lenet", v2, weight=0.5)
+    pins = inputs[:4]
+    pre = [router.classify("lenet", x, version=v2, timeout=60).probs
+           for x in pins]
+
+    errors = mism = iters = 0
+    decision = "canary"
+    deadline = time.monotonic() + 120.0
+    while decision == "canary" and time.monotonic() < deadline:
+        for i, x in enumerate(inputs):
+            try:
+                res = router.classify("lenet", x, tenant="rollsoak",
+                                      timeout=60)
+            except ServingError:
+                errors += 1      # untyped errors crash the episode: bug
+            else:
+                if not np.array_equal(res.probs, refs[res.padded_to][i]):
+                    mism += 1
+        iters += 1
+        decision = ctl.judge("lenet")
+        time.sleep(0.05)
+
+    promoted = decision == "promote"
+    if promoted:
+        ctl.promote("lenet")
+    post = [router.classify("lenet", x, version=v2, timeout=60).probs
+            for x in pins]
+    ch = reg.channels("lenet")
+    pin_ok = all(np.array_equal(a, b) for a, b in zip(pre, post))
+    old_gone = (versioned("lenet", v1) not in fleet.live
+                and f"r-{v1}" not in router.replica_ids())
+    return {"episode": "canary_promote", "stable": v1, "canary": v2,
+            "promoted": promoted, "iters": iters,
+            "stable_errors": errors, "mismatches": mism,
+            "pin_identical": pin_ok, "old_stable_drained": old_gone,
+            "channels": ch,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "ok": bool(promoted and errors == 0 and mism == 0
+                       and pin_ok and old_gone
+                       and ch["stable"] == v2 and ch["canary"] is None)}
+
+
+def _rollout_bad_canary_episode(ctl, fleet, reg, router, inputs, refs,
+                                stable, trace_dir) -> dict:
+    """A POISONED canary (planted ``bad_canary`` fault: the model head
+    emits NaNs) must be caught by the judge and auto-rolled back: zero
+    errors on stable-pinned traffic, zero non-finite rows ever served,
+    channel reverted, canary drained, flight dump on disk, and the
+    journal resuming as consistent with pinned answers bit-identical
+    across the recovery."""
+    import glob
+
+    import numpy as np
+
+    from sparknet_tpu.parallel.registry import versioned
+    from sparknet_tpu.parallel.rollout import RolloutController
+    from sparknet_tpu.parallel.serving import ServingError
+
+    t0 = time.monotonic()
+    v3 = reg.publish("lenet", notes="rollout soak v3 (to be poisoned)")
+    pins = inputs[:4]
+    pre = [router.classify("lenet", x, version=stable, timeout=60).probs
+           for x in pins]
+    dumps_before = len(glob.glob(os.path.join(
+        trace_dir, "*rollout_rollback*")))
+
+    # the canary is born bad: every batch of the poisoned version
+    # produces NaNs (the engine must fail them TYPED, never serve them)
+    os.environ["SPARKNET_FAULT"] = f"bad_canary:{v3}"
+    stable_errors = typed = untyped = served_bad = mism = 0
+    try:
+        ctl.start_canary("lenet", v3, weight=0.5)
+        t_live = time.monotonic()
+        decision = "canary"
+        deadline = time.monotonic() + 120.0
+        while decision == "canary" and time.monotonic() < deadline:
+            for i, x in enumerate(inputs):
+                try:
+                    res = router.classify("lenet", x, tenant="rollsoak",
+                                          timeout=60)
+                except ServingError:
+                    typed += 1       # the canary failing loudly is fine
+                # measuring untyped leakage IS this episode's job: the
+                # soak asserts this counter stays zero
+                except Exception:  # sparklint: disable=CD003
+                    untyped += 1     # anything untyped is not
+                else:
+                    if not np.isfinite(res.probs).all():
+                        served_bad += 1   # NaN reached a client: red
+                    elif not np.array_equal(res.probs,
+                                            refs[res.padded_to][i]):
+                        mism += 1
+            # stable-PINNED traffic must never feel the canary at all
+            try:
+                router.classify("lenet", inputs[0], version=stable,
+                                timeout=60)
+            except ServingError:
+                stable_errors += 1   # untyped here crashes the episode
+            decision = ctl.judge("lenet")
+            time.sleep(0.05)
+        rolled_back = decision == "rollback"
+        detect_s = round(time.monotonic() - t_live, 2)
+        if rolled_back:
+            ctl.rollback("lenet", reason="sustained SLO breach "
+                                         "(bad canary)")
+    finally:
+        os.environ.pop("SPARKNET_FAULT", None)
+
+    ch = reg.channels("lenet")
+    ro = router.rollout("lenet")
+    drained = (versioned("lenet", v3) not in fleet.live
+               and f"r-{v3}" not in router.replica_ids())
+    dumped = len(glob.glob(os.path.join(
+        trace_dir, "*rollout_rollback*"))) > dumps_before
+    post = [router.classify("lenet", x, version=stable, timeout=60).probs
+            for x in pins]
+    pin_ok = all(np.array_equal(a, b) for a, b in zip(pre, post))
+    # a fresh controller over the same journal must find nothing to fix
+    resumed = RolloutController(
+        reg, ctl.workdir, ensure=fleet.ensure, retire=fleet.retire,
+        verdict=fleet.verdict, router=router, cfg=ctl.cfg).resume()
+    post2 = [router.classify("lenet", x, version=stable,
+                             timeout=60).probs for x in pins]
+    pin_ok = pin_ok and all(np.array_equal(a, b)
+                            for a, b in zip(pre, post2))
+    return {"episode": "bad_canary_rollback", "stable": stable,
+            "canary": v3, "rolled_back": rolled_back,
+            "detect_s": detect_s, "stable_errors": stable_errors,
+            "canary_typed_failures": typed, "untyped_errors": untyped,
+            "served_bad": served_bad, "mismatches": mism,
+            "drained": drained, "flight_dump": dumped,
+            "pin_identical": pin_ok,
+            "resume": resumed.get("lenet", "consistent"),
+            "channels": ch,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "ok": bool(rolled_back and stable_errors == 0 and typed > 0
+                       and untyped == 0 and served_bad == 0
+                       and mism == 0 and drained and dumped and pin_ok
+                       and ch["stable"] == stable
+                       and ch["canary"] is None and ch["weight"] == 0.0
+                       and ro is not None and ro.canary is None
+                       and resumed.get("lenet",
+                                       "consistent") == "consistent")}
+
+
+def _rollout_resume_episode(workdir) -> dict:
+    """Kill the controller at BOTH dangerous points — after the canary
+    went live (before any judgment) and between ``promote_begin`` and
+    its ``done`` — and prove resume lands on exactly one of {fully
+    stable, fully promoted}, idempotently, with no orphan replicas."""
+    from sparknet_tpu.parallel.registry import ModelRegistry, versioned
+    from sparknet_tpu.parallel.rollout import RolloutConfig, RolloutController
+
+    t0 = time.monotonic()
+    cfg = RolloutConfig(fraction=0.25, judge_s=0.5, poll_s=0.05,
+                        min_requests=1, breach_polls=1)
+
+    class _Killed(Exception):
+        pass
+
+    def rig(tag):
+        d = os.path.join(workdir, tag)
+        reg = ModelRegistry(os.path.join(d, "registry"))
+        up: set = set()
+        retired: list = []
+
+        def retire(name):
+            retired.append(name)
+            up.discard(name)
+
+        a = reg.publish("demo", notes="a")
+        b = reg.publish("demo", notes="b")
+        reg.set_channels("demo", stable=a)
+        kw = dict(ensure=up.add, retire=retire,
+                  verdict=lambda name: None, cfg=cfg)
+        return d, reg, up, retired, a, b, kw
+
+    # -- kill after canary_live: nobody is judging -> must roll back ---
+    d, reg, up, retired, a, b, kw = rig("mid_canary")
+    RolloutController(reg, d, **kw).start_canary("demo", b)
+    res1 = RolloutController(reg, d, **kw).resume()
+    ch = reg.channels("demo")
+    mid_canary_ok = (res1 == {"demo": "rolled_back"}
+                     and ch["stable"] == a and ch["canary"] is None
+                     and versioned("demo", b) in retired
+                     and up == {versioned("demo", a)})
+    res1b = RolloutController(reg, d, **kw).resume()
+    idem1 = res1b == {"demo": "consistent"}
+
+    # -- kill between promote_begin and done: the decision is durable
+    # -> resume must FINISH the promote, not un-decide it --------------
+    class _DiesApplying(RolloutController):
+        def _apply_promote(self, *args, **kwargs):
+            raise _Killed()
+
+    d, reg, up, retired, a, b, kw = rig("mid_promote")
+    ctl = _DiesApplying(reg, d, **kw)
+    ctl.start_canary("demo", b)
+    try:
+        ctl.promote("demo")
+    except _Killed:
+        pass
+    res2 = RolloutController(reg, d, **kw).resume()
+    ch = reg.channels("demo")
+    mid_promote_ok = (res2 == {"demo": "promoted"}
+                      and ch["stable"] == b and ch["canary"] is None
+                      and versioned("demo", a) in retired
+                      and up == {versioned("demo", b)})
+    res2b = RolloutController(reg, d, **kw).resume()
+    idem2 = res2b == {"demo": "consistent"}
+
+    return {"episode": "controller_kill_resume",
+            "mid_canary": res1.get("demo"),
+            "mid_promote": res2.get("demo"),
+            "idempotent": bool(idem1 and idem2),
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "ok": bool(mid_canary_ok and mid_promote_ok
+                       and idem1 and idem2)}
+
+
+def rollout_soak(args) -> int:
+    import numpy as np
+
+    from sparknet_tpu.parallel.registry import ModelRegistry, versioned
+    from sparknet_tpu.parallel.rollout import RolloutConfig, RolloutController
+    from sparknet_tpu.parallel.router import Router, RouterConfig
+    from sparknet_tpu.parallel.serving import (
+        ModelHouse, ServeConfig, solo_references,
+    )
+
+    _clean_env()
+    rng = np.random.default_rng(args.seed)
+    own_tmp = args.workdir is None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sparknet_rollout_")
+    os.makedirs(workdir, exist_ok=True)
+    trace_dir = os.environ.setdefault(
+        "SPARKNET_TRACE_DIR", os.path.join(workdir, "trace"))
+    os.makedirs(trace_dir, exist_ok=True)
+    regdir = os.path.join(workdir, "registry")
+    os.environ["SPARKNET_REGISTRY_DIR"] = regdir
+    t0 = time.monotonic()
+
+    reg = ModelRegistry(regdir)
+    # small fast SLO windows so a ~30 s leg sees real multi-window
+    # burn-rate judgments, not just the defaults' opening blur
+    cfg = ServeConfig(batch_shapes=(1, 4), seed=0,
+                      slo_fast_window_s=1.5, slo_window_s=6.0,
+                      slo_min_requests=4, slo_reject_budget=0.05,
+                      slo_sample_every_s=0.1)
+    router = Router(RouterConfig(spill_depth=8))
+    fleet = _RolloutFleet(reg, cfg, router)
+    ctl = RolloutController(
+        reg, workdir, ensure=fleet.ensure, retire=fleet.retire,
+        verdict=fleet.verdict, router=router,
+        cfg=RolloutConfig(fraction=0.5, judge_s=1.5, poll_s=0.05,
+                          min_requests=10, breach_polls=2))
+
+    v1 = reg.publish("lenet", slo={"p99_ms": 2000.0},
+                     notes="rollout soak v1")
+    v2 = reg.publish("lenet", slo={"p99_ms": 2000.0},
+                     notes="rollout soak v2")
+    # zoo-init versions share seed 0, so one solo house is the
+    # bit-identity oracle for BOTH sides of the split
+    lm = ModelHouse(cfg).load("lenet")
+    inputs = [rng.normal(size=lm.in_shape).astype(np.float32)
+              for _ in range(16)]
+    refs = solo_references(lm, inputs)
+
+    episodes = []
+    try:
+        episodes.append(_rollout_promote_episode(
+            ctl, fleet, reg, router, inputs, refs, v1, v2))
+        if episodes[-1]["ok"]:
+            episodes.append(_rollout_bad_canary_episode(
+                ctl, fleet, reg, router, inputs, refs, v2, trace_dir))
+        episodes.append(_rollout_resume_episode(
+            os.path.join(workdir, "resume")))
+    finally:
+        fleet.close()
+
+    for e in episodes:
+        print(f"rollout-soak: {e['episode']} -> "
+              f"{'OK' if e['ok'] else 'FAIL'} ({e['elapsed_s']}s)",
+              flush=True)
+    passed = sum(1 for e in episodes if e["ok"])
+    report = {"mode": "rollout", "seed": args.seed,
+              "episodes": episodes, "passed": passed,
+              "failed": len(episodes) - passed,
+              "elapsed_s": round(time.monotonic() - t0, 1),
+              "ok": len(episodes) == 3 and passed == len(episodes)}
+    text = json.dumps(report, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"rollout-soak: verdict written to {args.out} "
+              f"({passed}/{len(episodes)} episode(s) passed)")
+    else:
+        print(text)
+    if own_tmp and report["ok"]:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not report["ok"]:
+        print(f"rollout-soak: scratch kept at {workdir} for post-mortem "
+              "(rollout.jsonl + flight dumps)", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="chaos soak runner")
     ap.add_argument("--runs", type=int, default=8)
@@ -1089,8 +1479,14 @@ def main(argv=None) -> int:
     ap.add_argument("--net-slice", action="store_true",
                     help="the ~60s CI shape: partition-suspend-heal + "
                          "fenced-zombie legs only (skips slow-link)")
+    ap.add_argument("--rollout", action="store_true",
+                    help="rollout mode: canary-promote, bad-canary "
+                         "auto-rollback, and controller-kill-resume "
+                         "legs over the registry + rollout controller")
     args = ap.parse_args(argv)
 
+    if args.rollout:
+        return rollout_soak(args)
     if args.net:
         return net_soak(args)
     if args.pod:
