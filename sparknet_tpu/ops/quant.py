@@ -1,0 +1,79 @@
+"""Shared quantize/dequantize kernels — one kernel, two customers.
+
+Symmetric linear quantization used by (a) the communication-efficient
+round exchange (``parallel/comms.py`` — int8/bf16 weight-*delta* codecs
+with error feedback, ROADMAP item 5) and (b) the int8 serving path
+(ROADMAP item 3a — per-channel scales calibrated offline).  Both callers
+need the exact same arithmetic, so it lives here once: pure ``jnp``
+element-wise ops that XLA fuses into whatever program consumes them (on
+TPU these are VPU-width element-wise passes; no custom kernel is
+warranted — see the tiling discussion in the Pallas guide's quantization
+pattern, which only pays off fused into a matmul epilogue).
+
+Conventions
+-----------
+* **Symmetric, zero-point-free**: ``q = clip(round(x / s), -127, 127)``,
+  ``x̂ = q·s``.  Weight deltas and activations are centered near zero, so
+  an asymmetric zero point buys nothing and would break the cheap
+  "q == 0 ⇒ x̂ == 0" invariant the error-feedback path leans on.
+* **Scale granularity via ``keep_axes``**: the scale is one value per
+  index of the kept axes, reduced over every other axis.  ``()`` is
+  per-tensor; ``(0,)`` per-leading-index (per-channel for a [C, ...]
+  weight, per-tier-row for a stacked [n_workers, ...] delta);
+  ``(0, 1)`` per-(tier, channel).
+* **Zero-safe**: an all-zero tensor (or channel) gets scale 1.0, not
+  0/127 — dequantize(quantize(0)) is exactly 0 with no NaN/Inf anywhere
+  (the very first round's delta against the init broadcast can be all
+  zeros for frozen blobs).
+* Kernels are shape-polymorphic and dtype-stable: float32 in, float32
+  out of the dequantizers, regardless of the wire dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INT8_LEVELS = 127  # symmetric int8: wire values in [-127, 127] (no -128)
+
+
+def _reduce_axes(ndim: int, keep_axes: tuple[int, ...]) -> tuple[int, ...]:
+    keep = {a % max(ndim, 1) for a in keep_axes}
+    return tuple(i for i in range(ndim) if i not in keep)
+
+
+def int8_scale(x, keep_axes: tuple[int, ...] = ()):
+    """Symmetric per-group scale: amax/127 over the reduced axes,
+    keepdims so the scale broadcasts straight back onto ``x``.  Zero
+    groups get scale 1.0 (see module conventions)."""
+    x = jnp.asarray(x, jnp.float32)
+    axes = _reduce_axes(x.ndim, keep_axes)
+    amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True) if x.ndim \
+        else jnp.abs(x)
+    return jnp.where(amax > 0, amax / INT8_LEVELS, jnp.ones_like(amax))
+
+
+def quantize_int8(x, keep_axes: tuple[int, ...] = ()):
+    """x -> (q int8, scale f32).  Round-to-nearest-even onto the
+    127-level symmetric grid; the clip is belt-and-braces (amax/127
+    scaling already bounds |x/s| by 127 up to rounding)."""
+    x = jnp.asarray(x, jnp.float32)
+    s = int8_scale(x, keep_axes)
+    q = jnp.clip(jnp.round(x / s), -INT8_LEVELS, INT8_LEVELS)
+    return q.astype(jnp.int8), s
+
+
+def dequantize_int8(q, s):
+    """(q int8, scale) -> f32.  Exact for q == 0 by construction."""
+    return q.astype(jnp.float32) * jnp.asarray(s, jnp.float32)
+
+
+def quantize_bf16(x):
+    """f32 -> bf16 wire format (round-to-nearest-even mantissa drop).
+    Subnormal f32 values flush through bf16's wider-exponent subnormals
+    without becoming inf/NaN — covered by tests."""
+    return jnp.asarray(x, jnp.float32).astype(jnp.bfloat16)
+
+
+def dequantize_bf16(x):
+    """bf16 wire -> f32 (exact: every bf16 value is a f32 value)."""
+    return jnp.asarray(x).astype(jnp.float32)

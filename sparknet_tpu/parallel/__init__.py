@@ -3,8 +3,10 @@ from .trainer import (
     DistributedTrainer,
     TrainerConfig,
     TrainingDivergedError,
+    comm_config_from_env,
     device_crop_mirror_mean,
 )
+from . import comms
 from .cluster import init_cluster, is_multi_host, local_batch_slice
 from .resilience import (
     ElasticPolicy,

@@ -1,0 +1,170 @@
+"""Compressed weight-delta exchange for the τ-boundary averaging round.
+
+SparkNet's round exchanges full-precision weights; on cheap interconnects
+(the paper's own regime) those bytes ARE the round overhead.  This module
+shrinks them: each tier member encodes the **delta** of its local weights
+against the last broadcast state with a registered codec, the quantized
+deltas ride the collective, and every replica decodes and averages the
+same gathered payload — so the result is replicated by construction and
+the cross-replica audit fingerprint holds under every codec.
+
+Error feedback makes lossy codecs safe across rounds: the quantization
+error of round r (``delta - decode(encode(delta))``) is carried as a
+persistent per-tier residual and added into round r+1's delta before
+encoding, so compression error is deferred, never dropped (1-bit SGD /
+EF-SGD discipline).  The residual is trainer state: it is checkpointed,
+rolled back, and elastically re-tiered exactly like stacked optimizer
+state (``DistributedTrainer._host_blob``).
+
+A codec is three leaf-wise pieces over a stacked [n_tier, ...] delta
+pytree — ``encode`` (f32 -> wire payload), ``decode`` (wire -> f32), and
+a ``keep_residual`` flag real codecs leave True (a codec that sets it
+False drops its quantization error on the floor; ``tools/commbench.py``
+plants exactly such a codec and requires the error-feedback invariant
+gate to fail it).  The quantize/dequantize arithmetic itself lives in
+``ops/quant.py``, shared with the int8 serving path (ROADMAP 3a).
+
+Codec ``none`` is registered for completeness (identity wire format, 4
+bytes/weight) but the trainer never routes it through this machinery:
+with ``comm_codec="none"`` the round keeps the pre-existing fused
+single-program pmean — bit-identical to the trainer before this module
+existed, by construction rather than by numerical luck.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import quant
+
+
+@dataclasses.dataclass(frozen=True)
+class Codec:
+    """One wire format for stacked weight deltas.
+
+    ``encode`` maps a [n_tier, ...] f32 leaf to its wire payload (any
+    pytree of arrays — e.g. ``(q, scale)``); ``decode`` inverts it back
+    to f32 with the codec's declared loss.  Leaves keep their leading
+    tier axis through both, so scales are per-tier-row at minimum (one
+    worker's delta magnitude never pollutes another's grid)."""
+    name: str
+    encode: Callable[[Any], Any]
+    decode: Callable[[Any], Any]
+    # False = the codec refuses to carry its quantization error forward
+    # (no error feedback).  Only planted/broken codecs do this; the
+    # commbench EF-invariant gate exists to fail them.
+    keep_residual: bool = True
+
+
+_CODECS: dict[str, Codec] = {}
+
+
+def register_codec(codec: Codec, *, allow_replace: bool = False) -> Codec:
+    if codec.name in _CODECS and not allow_replace:
+        raise ValueError(f"codec {codec.name!r} already registered")
+    _CODECS[codec.name] = codec
+    return codec
+
+
+def get_codec(name: str) -> Codec:
+    try:
+        return _CODECS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown comm codec {name!r} (registered: "
+            f"{sorted(_CODECS)})") from None
+
+
+def codec_names() -> tuple[str, ...]:
+    return tuple(sorted(_CODECS))
+
+
+# -- the built-in wire formats -------------------------------------------
+def _int8_keep_axes(x) -> tuple[int, ...]:
+    """Per-(tier, channel) scale grid for weight-shaped leaves, falling
+    back to per-tier-row for vectors/scalars stacked on the tier axis
+    (a per-element "channel" scale on a [n, C] bias would just re-encode
+    the tensor in f32 scales)."""
+    return (0, 1) if jnp.ndim(x) > 2 else (0,)
+
+
+def _encode_int8(x):
+    return quant.quantize_int8(x, keep_axes=(0,))
+
+
+def _encode_int8_channel(x):
+    return quant.quantize_int8(x, keep_axes=_int8_keep_axes(x))
+
+
+def _decode_int8(payload):
+    q, s = payload
+    return quant.dequantize_int8(q, s)
+
+
+register_codec(Codec(
+    "none",
+    encode=lambda x: jnp.asarray(x, jnp.float32),
+    decode=lambda x: jnp.asarray(x, jnp.float32)))
+register_codec(Codec(
+    "bf16",
+    encode=quant.quantize_bf16,
+    decode=quant.dequantize_bf16))
+register_codec(Codec(
+    "int8", encode=_encode_int8, decode=_decode_int8))
+register_codec(Codec(
+    "int8_channel", encode=_encode_int8_channel, decode=_decode_int8))
+
+
+# -- tree-level operations the trainer compiles --------------------------
+def encode_tree(codec: Codec, tree):
+    """Stacked f32 delta pytree -> payload pytree (leaf-wise encode).
+    The payload nests each leaf's wire pytree in the original tree
+    position — ``decode_tree`` is its exact structural inverse."""
+    return jax.tree_util.tree_map(codec.encode, tree)
+
+
+def decode_tree(codec: Codec, payload, like):
+    """Payload pytree -> stacked f32 delta pytree.  ``like`` re-anchors
+    the tree structure (the payload's leaves may themselves be tuples,
+    so the original structure cannot be inferred from it alone)."""
+    flat, treedef = jax.tree_util.tree_flatten(like)
+    enc_leaves = treedef.flatten_up_to(payload)
+    return jax.tree_util.tree_unflatten(
+        treedef, [codec.decode(p) for p in enc_leaves])
+
+
+def roundtrip_tree(codec: Codec, tree):
+    """(payload, decoded, residual) of one error-feedback step over a
+    stacked delta tree.  The EF invariant — ``decoded + residual ==
+    tree`` exactly in f32 — holds for every residual-keeping codec by
+    construction (the residual IS that difference); a codec with
+    ``keep_residual=False`` zeroes it and fails the invariant for any
+    lossy wire format.  This is the single code path both the trainer's
+    encode program and the commbench gate call, so the gate proves the
+    production arithmetic, not a copy of it."""
+    payload = encode_tree(codec, tree)
+    decoded = decode_tree(codec, payload, tree)
+    if codec.keep_residual:
+        residual = jax.tree_util.tree_map(
+            lambda d, dh: d - dh, tree, decoded)
+    else:
+        residual = jax.tree_util.tree_map(jnp.zeros_like, tree)
+    return payload, decoded, residual
+
+
+def exchange_bytes(codec: Codec, params, n_tier: int) -> int:
+    """Analytic wire bytes of one round's exchange: the payload arrays a
+    [n_tier, ...]-stacked delta of ``params`` encodes to, sized via
+    ``jax.eval_shape`` (no FLOPs, no device memory).  This is the number
+    the ledger's ≥3× shrink claim is made from, so it must come from the
+    REAL encode, not a hand-derived formula that could drift from it."""
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct((n_tier,) + tuple(x.shape),
+                                       jnp.float32), params)
+    payload = jax.eval_shape(lambda t: encode_tree(codec, t), stacked)
+    return int(sum(leaf.size * jnp.dtype(leaf.dtype).itemsize
+                   for leaf in jax.tree_util.tree_leaves(payload)))

@@ -178,6 +178,33 @@ class TrainerConfig:
     # recovery semantics are exact.  ``SPARKNET_ASYNC_CKPT=0`` overrides
     # to the synchronous path regardless of this field.
     async_checkpoint: bool = True
+    # Compressed τ-boundary weight exchange (parallel/comms.py; ROADMAP
+    # item 5b).  "none" keeps the pre-existing fused single-program
+    # round — bit-identical to the trainer before codecs existed, BY
+    # CONSTRUCTION (no delta arithmetic runs at all).  Any other
+    # registered codec ("bf16" / "int8" / "int8_channel" / test-planted
+    # ones) splits the round: the compiled local-steps program returns
+    # per-tier weights WITHOUT the boundary pmean, an encode program
+    # quantizes each tier's delta against the last broadcast state (plus
+    # the error-feedback residual, which persists in trainer state and
+    # rides checkpoints), the gathered payload is decoded and averaged
+    # identically on every replica — so params stay replicated and the
+    # cross-replica audit holds under every codec.  Only the strategies
+    # that exchange weights at the τ boundary can compress them:
+    # local_sgd and hierarchical.  "sync" exchanges per-step GRADIENTS
+    # inside the scan and raises at init with any codec but "none".
+    comm_codec: str = "none"
+    # Overlap the encode→exchange→decode tail with subsequent host work
+    # (the harvest-lag discipline of PR 5 applied to the exchange): the
+    # three comm programs are DISPATCHED without host blocking, so the
+    # next round's feed staging / bookkeeping — and with harvest_lag > 0
+    # the next round itself — proceed while the bytes move.  Program
+    # order and results are bit-identical to comm_overlap=False; only
+    # the host-blocking policy (and therefore the measured
+    # stall_s["comm_*"]) changes.  Inert at comm_codec="none", where the
+    # exchange already rides inside the one compiled round with zero
+    # host stall to hide.
+    comm_overlap: bool = False
 
 
 class TrainingDivergedError(RuntimeError):
@@ -235,6 +262,29 @@ def device_crop_mirror_mean(crop: int, mirror: bool = True,
     return pre
 
 
+def comm_config_from_env(base: TrainerConfig | None = None) -> TrainerConfig:
+    """``base`` (or a default TrainerConfig) with the communication
+    round shape taken from the registered knobs where they are set:
+    ``SPARKNET_TAU`` (steps per round — the paper's swept frontier knob),
+    ``SPARKNET_COMM_CODEC`` and ``SPARKNET_COMM_OVERLAP``.  Unset knobs
+    leave ``base``'s fields untouched, so an explicitly-constructed
+    config still wins; drivers (tools/train, commbench, sweep harnesses)
+    call this so one env var re-shapes a whole launched grid without
+    code changes."""
+    from ..utils import knobs
+    cfg = base or TrainerConfig()
+    tau = knobs.get_int("SPARKNET_TAU", 0)
+    if tau > 0:
+        cfg = dataclasses.replace(cfg, tau=tau)
+    codec = knobs.get_str("SPARKNET_COMM_CODEC", "")
+    if codec:
+        cfg = dataclasses.replace(cfg, comm_codec=codec)
+    if knobs.is_set("SPARKNET_COMM_OVERLAP"):
+        cfg = dataclasses.replace(
+            cfg, comm_overlap=knobs.get_bool("SPARKNET_COMM_OVERLAP", False))
+    return cfg
+
+
 class DistributedTrainer:
     """Owns replicated params + (per-device or shared) solver state and a
     compiled per-round train step over a device mesh."""
@@ -245,6 +295,18 @@ class DistributedTrainer:
         self.config = config or TrainerConfig()
         if self.config.strategy not in ("local_sgd", "sync", "hierarchical"):
             raise ValueError(f"unknown strategy {self.config.strategy!r}")
+        from . import comms
+        # "none" stays structurally OFF this machinery (comms.py module
+        # doc): _codec None routes the round through the pre-codec fused
+        # program verbatim
+        self._codec = (None if self.config.comm_codec == "none"
+                       else comms.get_codec(self.config.comm_codec))
+        if self._codec is not None and self.config.strategy == "sync":
+            raise ValueError(
+                f"comm_codec={self.config.comm_codec!r} needs a τ-boundary "
+                f"weight exchange to compress; strategy 'sync' exchanges "
+                f"per-step gradients inside the scan (use local_sgd or "
+                f"hierarchical, or comm_codec='none')")
         if self.config.strategy == "hierarchical":
             self.mesh = mesh if mesh is not None else make_pod_mesh()
             if (HOST_AXIS not in self.mesh.shape
@@ -296,6 +358,21 @@ class DistributedTrainer:
         self._round = self._build_round()
         self._test_fwd = None
 
+        # -- compressed-exchange state (comm_codec != "none"): per-tier
+        # error-feedback residuals (trainer state: checkpointed, rolled
+        # back, re-tiered like stacked optimizer state) and the three
+        # compiled comm programs (encode / exchange / decode)
+        self.comm_residual = None
+        self._comm = None
+        if self._codec is not None:
+            n, spec = self._state_tier()
+            self.comm_residual = put_global_tree(
+                jax.tree_util.tree_map(
+                    lambda x: np.zeros((n,) + tuple(x.shape), np.float32),
+                    self.params),
+                NamedSharding(self.mesh, spec))
+            self._comm = self._build_comm_programs()
+
         # -- resilience state: completed-round counter, caller-maintained
         # feed cursor (any JSON value), and the manifest we resumed from
         self.round = 0
@@ -323,7 +400,9 @@ class DistributedTrainer:
         self.round_losses: dict[int, float] = {}
         self._ckpt_writer = None
         self.stall_s = {"loss_fetch": 0.0, "finite_check": 0.0,
-                        "audit_fetch": 0.0, "checkpoint": 0.0}
+                        "audit_fetch": 0.0, "checkpoint": 0.0,
+                        "comm_encode": 0.0, "comm_allreduce": 0.0,
+                        "comm_decode": 0.0}
         # the FeedStats of the newest input_feed() (if any) — published on
         # round_end heartbeats so fleet-level supervisors can see the data
         # plane's health without any extra channel
@@ -480,6 +559,13 @@ class DistributedTrainer:
                 (params, state, it, rng), split_micro(batches))
             return params, state, jnp.mean(losses)
 
+        # compressed exchange (comm_codec != "none"): the τ-boundary
+        # weight pmean LEAVES the compiled round — the body returns each
+        # tier member's local weights stacked on the tier axis (exactly
+        # like the optimizer state), and the encode→exchange→decode
+        # programs built by _build_comm_programs do the averaging outside
+        compressed = self._codec is not None
+
         def local_sgd_body(params, state, it, batches, rng, lr_scale):
             """τ local steps, then weight averaging (SparkNet semantics)."""
             state = jax.tree_util.tree_map(lambda x: x[0], state)
@@ -495,10 +581,17 @@ class DistributedTrainer:
 
             (params, state, it, _), losses = lax.scan(
                 step, (params, state, it, rng), split_micro(batches))
-            # the broadcast → reduce → scalarDivide of the reference's outer
-            # loop (ImageNetApp.scala:102,178-179), as one ICI collective:
-            params = lax.pmean(params, DATA_AXIS)
+            # the scalar loss is not part of the compressed exchange (3
+            # bytes saved would not buy the lost logging fidelity), so it
+            # is pmean'd here on either path
             loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
+            if not compressed:
+                # the broadcast → reduce → scalarDivide of the reference's
+                # outer loop (ImageNetApp.scala:102,178-179), as one ICI
+                # collective:
+                params = lax.pmean(params, DATA_AXIS)
+            else:
+                params = jax.tree_util.tree_map(lambda x: x[None], params)
             state = jax.tree_util.tree_map(lambda x: x[None], state)
             return params, state, loss
 
@@ -515,11 +608,16 @@ class DistributedTrainer:
             (params, state, it, _), losses = lax.scan(
                 make_psum_step(CHIP_AXIS, lr_scale),
                 (params, state, it, rng), split_micro(batches))
-            # the cross-host averaging rides DCN once per τ steps — the
-            # broadcast → reduce → scalarDivide of the reference's outer
-            # loop (ImageNetApp.scala:102,178-179)
-            params = lax.pmean(params, HOST_AXIS)
             loss = lax.pmean(jnp.mean(losses), HOST_AXIS)
+            if not compressed:
+                # the cross-host averaging rides DCN once per τ steps —
+                # the broadcast → reduce → scalarDivide of the reference's
+                # outer loop (ImageNetApp.scala:102,178-179)
+                params = lax.pmean(params, HOST_AXIS)
+            else:
+                # chips within a host already agree (per-step chip psum);
+                # stack one copy per HOST for the compressed DCN exchange
+                params = jax.tree_util.tree_map(lambda x: x[None], params)
             state = jax.tree_util.tree_map(lambda x: x[None], state)
             return params, state, loss
 
@@ -528,17 +626,103 @@ class DistributedTrainer:
         body = bodies[strategy]
         state_spec = (P() if strategy == "sync"
                       else self._state_tier()[1])
+        params_out_spec = self._state_tier()[1] if compressed else P()
         # batches: [tau, global_batch, ...] sharded on the batch axis
         batch_spec = P(None, self._batch_axes)
 
         mapped = shard_map(
             body, mesh=self.mesh,
             in_specs=(P(), state_spec, P(), batch_spec, P(), P()),
-            out_specs=(P(), state_spec, P()),
+            out_specs=(params_out_spec, state_spec, P()),
             **_SM_NOCHECK,
         )
-        donate = (0, 1) if self.config.donate else ()
+        # compressed path: the replicated input params stay live as the
+        # delta reference for encode/decode — only the state may donate
+        donate: tuple[int, ...] = ()
+        if self.config.donate:
+            donate = (1,) if compressed else (0, 1)
         return jax.jit(mapped, donate_argnums=donate)
+
+    def _build_comm_programs(self):
+        """The three programs of the compressed exchange.  All replicas
+        run identical programs over replicated inputs for decode, so the
+        new params are replicated bit-identically by construction — the
+        audit invariant holds under every codec with zero tolerance.
+
+        * **encode** (per-tier): ``delta_i = local_i - ref + residual_i``
+          then the codec's wire format; the new residual is the exact
+          f32 quantization error (error feedback — compression error is
+          deferred to round r+1, never dropped).
+        * **exchange**: reshard the stacked payload tier→replicated (one
+          all-gather).  This is the collective that moves the wire
+          bytes — the only traffic the codec is shrinking.
+        * **decode**: every replica decodes the same gathered payload,
+          means the deltas over the tier axis, and adds the same
+          replicated reference back.
+        """
+        from . import comms
+        codec = self._codec
+
+        def enc(local, ref, residual):
+            delta = jax.tree_util.tree_map(
+                lambda l, r, e: l - r[None] + e, local, ref, residual)
+            payload, _, new_res = comms.roundtrip_tree(codec, delta)
+            return payload, new_res
+
+        def dec(payload, ref):
+            deltas = comms.decode_tree(codec, payload, ref_stacked_like(ref))
+            return jax.tree_util.tree_map(
+                lambda r, d: r + jnp.mean(d, axis=0), ref, deltas)
+
+        n_tier = self._state_tier()[0]
+
+        def ref_stacked_like(ref):
+            # structural template only (decode_tree re-anchors the tree
+            # structure from it; values are never read)
+            return jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (n_tier,) + x.shape),
+                ref)
+
+        rep = replicated(self.mesh)
+        # local + residual are consumed; ref params must survive (decode
+        # still needs them after encode ran)
+        encode = jax.jit(enc, donate_argnums=(0, 2))
+        exchange = jax.jit(lambda t: t, out_shardings=rep)
+        decode = jax.jit(dec, out_shardings=rep)
+        return encode, exchange, decode
+
+    def _run_comm_round(self, batches, rng):
+        """One compressed round: local-steps program, then the
+        encode→exchange→decode tail.  ``comm_overlap`` is purely a
+        host-blocking policy — False inserts a ``block_until_ready``
+        after each stage so ``stall_s`` charges the true device time to
+        the right component (the roundbench discipline); True dispatches
+        all three and returns, letting the tail overlap whatever the
+        host does next (feed staging, bookkeeping, or — with
+        harvest_lag > 0 — the next round's dispatch).  Same programs,
+        same order, bit-identical results either way."""
+        overlap = self.config.comm_overlap
+        local, self.state, loss = self._round(
+            self.params, self.state, jnp.asarray(self.iter), batches, rng,
+            jnp.asarray(self.lr_scale, jnp.float32))
+        encode, exchange, decode = self._comm
+        t0 = time.perf_counter()
+        payload, self.comm_residual = encode(
+            local, self.params, self.comm_residual)
+        if not overlap:
+            jax.block_until_ready(payload)
+        self.stall_s["comm_encode"] += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        gathered = exchange(payload)
+        if not overlap:
+            jax.block_until_ready(gathered)
+        self.stall_s["comm_allreduce"] += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        self.params = decode(gathered, self.params)
+        if not overlap:
+            jax.block_until_ready(self.params)
+        self.stall_s["comm_decode"] += time.perf_counter() - t0
+        return loss
 
     # -- driver API -------------------------------------------------------
     @property
@@ -686,9 +870,12 @@ class DistributedTrainer:
         batches = {k: stage_local(v, self.input_sharding)
                    for k, v in batches.items()}
         self._rng, rng = jax.random.split(self._rng)
-        self.params, self.state, loss = self._round(
-            self.params, self.state, jnp.asarray(self.iter), batches, rng,
-            jnp.asarray(self.lr_scale, jnp.float32))
+        if self._comm is not None:
+            loss = self._run_comm_round(batches, rng)
+        else:
+            self.params, self.state, loss = self._round(
+                self.params, self.state, jnp.asarray(self.iter), batches,
+                rng, jnp.asarray(self.lr_scale, jnp.float32))
         if lag:
             # zero-stall path: loss + finite verdict stay on-device; the
             # dispatch returns immediately and the verdicts are harvested
@@ -1134,6 +1321,17 @@ class DistributedTrainer:
         }
         if self.config.strategy == "hierarchical":
             blob["n_hosts"] = self.n_hosts  # state is per-host
+        if self.comm_residual is not None:
+            # error-feedback residuals are trainer state: a rollback (or
+            # relaunch) that replayed params but dropped the residual
+            # would silently discard deferred quantization error and
+            # break the bit-exact-replay contract under lossy codecs
+            res = self.comm_residual
+            if jax.process_count() > 1:
+                res = jax.jit(lambda t: t,
+                              out_shardings=replicated(self.mesh))(res)
+            blob["comm_residual"] = res
+            blob["comm_codec"] = self.config.comm_codec
         return blob
 
     @staticmethod
@@ -1189,6 +1387,35 @@ class DistributedTrainer:
             self.state = put_global_tree(
                 state,
                 NamedSharding(self.mesh, self._state_tier()[1]))
+        if self.comm_residual is not None:
+            n_tier, tier_spec = self._state_tier()
+            saved_codec = str(np.asarray(blob.get("comm_codec", "")))
+            if "comm_residual" in blob and (
+                    saved_codec == self.config.comm_codec):
+                res = blob["comm_residual"]
+                saved_n = len(jax.tree_util.tree_leaves(res)) and int(
+                    jax.tree_util.tree_leaves(res)[0].shape[0])
+                if saved_n != n_tier:
+                    # same elastic contract as stacked optimizer state:
+                    # surviving tier row i inherits saved row i mod saved_n
+                    res = self._retier_state(res, n_tier)
+                self.comm_residual = put_global_tree(
+                    res, NamedSharding(self.mesh, tier_spec))
+            else:
+                # pre-codec checkpoint (or codec changed): the saved
+                # residual is meaningless on this wire format — start
+                # error feedback fresh (safe: EF state is an optimization
+                # of future rounds, never a correctness input)
+                if saved_codec and saved_codec != self.config.comm_codec:
+                    print(f"resume: checkpoint residuals are for codec "
+                          f"{saved_codec!r}, trainer runs "
+                          f"{self.config.comm_codec!r} — resetting error "
+                          f"feedback", file=sys.stderr, flush=True)
+                self.comm_residual = put_global_tree(
+                    jax.tree_util.tree_map(
+                        lambda x: np.zeros((n_tier,) + tuple(x.shape),
+                                           np.float32), blob["params"]),
+                    NamedSharding(self.mesh, tier_spec))
         self.iter = int(blob["iter"])
         if "round" in blob:
             self.round = int(blob["round"])

@@ -469,6 +469,19 @@ _register(
          "Consecutive breach verdicts that trigger auto-rollback "
          "(multi-window burn discipline: one blip never pages).",
          "sparknet_tpu/parallel/rollout.py"),
+    # --- communication-efficient rounds (trainer τ / codec / overlap) ---
+    Knob("SPARKNET_TAU", "int", "",
+         "Steps per round for driver-built trainers (comm_config_from_env; "
+         "the paper's swept τ knob — unset keeps the config's tau).",
+         "sparknet_tpu/parallel/trainer.py"),
+    Knob("SPARKNET_COMM_CODEC", "str", "",
+         "Weight-delta exchange codec for driver-built trainers: none / "
+         "bf16 / int8 / int8_channel (or any comms.register_codec name).",
+         "sparknet_tpu/parallel/trainer.py"),
+    Knob("SPARKNET_COMM_OVERLAP", "bool", "",
+         "Set to 1 to dispatch the encode/exchange/decode tail without "
+         "host blocking (overlapped averaging; bit-identical results).",
+         "sparknet_tpu/parallel/trainer.py"),
     # --- CI gates (read by the tier-1 runner, not by library code) ---
     Knob("SPARKNET_LINT", "bool", "1",
          "Set to 0 to skip the sparklint gate in tools/run_tier1.sh "
@@ -533,6 +546,10 @@ _register(
          "Set to 1 to run the rollout chaos leg (canary promote + "
          "planted-bad-canary rollback + controller-kill resume) in "
          "run_tier1.sh.",
+         "tools/run_tier1.sh"),
+    Knob("SPARKNET_COMMBENCH", "bool", "",
+         "Set to 1 to run the comm-codec parity gate (codec-none "
+         "bit-identity, EF invariant, overlap stall) in run_tier1.sh.",
          "tools/run_tier1.sh"),
     # --- tombstones: window closed, any surviving mention fails lint ---
     Knob("SPARKNET_LRN_CUMSUM", "bool", "",

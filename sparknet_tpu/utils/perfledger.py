@@ -50,7 +50,7 @@ LEDGER_RELPATH = os.path.join("perf", "LEDGER.jsonl")
 # fingerprint fields, in canonical key order
 FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
                       "backend", "fuse_plan", "replicas", "tune_plan",
-                      "feed_source")
+                      "feed_source", "tau", "comm_codec")
 
 # entries written before the vertical fusion pass existed carry no
 # fuse_plan field; they were structurally unfused, so they pool with
@@ -64,8 +64,15 @@ FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
 # Entries before the record-shard feed existed were all LMDB-decode
 # captures: they read as feed_source="lmdb" so the committed feed
 # history keeps gating, while records captures band separately.
+# Entries before communication-efficient rounds (r19) carry no tau /
+# comm_codec: every one of them ran the full-precision exchange (codec
+# "none"), and the ingesters that know a capture's real τ (roundbench/
+# commbench configs, trainer captures) stamp it explicitly — the pooled
+# default τ=1 only covers captures whose round shape never mattered to
+# their metrics (serving, feed, fusion).
 _FINGERPRINT_DEFAULTS = {"fuse_plan": "off", "replicas": 1,
-                         "tune_plan": "off", "feed_source": "lmdb"}
+                         "tune_plan": "off", "feed_source": "lmdb",
+                         "tau": 1, "comm_codec": "none"}
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -103,7 +110,9 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
                 fuse_plan: str | None = None,
                 replicas: int | None = None,
                 tune_plan: str | None = None,
-                feed_source: str | None = None) -> dict[str, Any]:
+                feed_source: str | None = None,
+                tau: int | None = None,
+                comm_codec: str | None = None) -> dict[str, Any]:
     """Canonical config fingerprint.  ``backend`` defaults to the
     platform half of ``device`` (``"tpu/TPU v5 lite"`` -> ``"tpu"``) —
     the field the baseline isolation hinges on.  ``fuse_plan`` is the
@@ -117,7 +126,11 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
     than the hardcoded defaults ("off"), same isolation argument.
     ``feed_source`` is the input-pipeline source family ("lmdb" decode
     path vs pre-decoded "records" shards): feed throughput bands are
-    incomparable across them, so they must not pool."""
+    incomparable across them, so they must not pool.  ``tau`` (steps
+    per averaging round) and ``comm_codec`` (the weight-delta wire
+    format) shape the round's collective traffic: a τ=10 int8 capture
+    and a τ=1 full-precision one are different communication programs
+    and must band separately."""
     if backend is None and device:
         backend = str(device).split("/", 1)[0]
     return {"model": model or "unknown", "dtype": dtype or "unknown",
@@ -128,7 +141,9 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
             "fuse_plan": fuse_plan or "off",
             "replicas": int(replicas) if replicas is not None else 1,
             "tune_plan": tune_plan or "off",
-            "feed_source": feed_source or "lmdb"}
+            "feed_source": feed_source or "lmdb",
+            "tau": int(tau) if tau is not None else 1,
+            "comm_codec": comm_codec or "none"}
 
 
 def fp_key(fp: Mapping[str, Any]) -> str:
@@ -167,8 +182,8 @@ def provenance(result_fp: Mapping[str, Any] | None = None) -> dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 # explicit overrides win; otherwise suffix heuristics decide
-_HIGHER_BETTER_SUFFIX = ("_img_s", "_qps", "_speedup_x", "_gbs",
-                         "_gflops")
+_HIGHER_BETTER_SUFFIX = ("_img_s", "_qps", "_speedup_x", "_shrink_x",
+                         "_gbs", "_gflops")
 _LOWER_BETTER_SUFFIX = ("_ms", "_s", "_seconds", "_pct_overhead",
                         "_rejected", "_errors", "_mismatches")
 _DIRECTION_OVERRIDES = {
@@ -745,6 +760,58 @@ def entries_from_roundbench(doc: Mapping[str, Any],
                        **prov)]
 
 
+def entries_from_commbench(doc: Mapping[str, Any],
+                           path: str | None = None, *,
+                           round_tag: str | None = None,
+                           t: float | None = None,
+                           device_hint: str | None = None) -> list[dict]:
+    """tools/commbench.py comm-codec gate reports: one entry per codec
+    (fingerprinted by its ``comm_codec``, so each wire format bands
+    against its own history) carrying the round wall, the per-component
+    comm stall (``stall_comm_*_s`` — stage attribution, not gated), and
+    the analytic exchange bytes; plus one summary entry on the
+    full-precision fingerprint with the headline sync-vs-overlap stall
+    and the int8 wire shrink (``_shrink_x`` — higher is better)."""
+    if not doc.get("commbench"):
+        return []
+    prov = _prov_fields(doc)
+    tau = doc.get("tau")
+    world = doc.get("devices")
+    note = None if doc.get("ok") else "commbench gate FAILED"
+    out: list[dict] = []
+    for codec, leg in (doc.get("codecs") or {}).items():
+        fp = fingerprint(model="lenet", dtype="f32",
+                         batch=doc.get("batch"), world=world,
+                         device=device_hint, tau=tau, comm_codec=codec)
+        metrics = {
+            "commbench_wall_s": leg.get("wall_s"),
+            "comm_stall_s": leg.get("comm_stall_s"),
+            "comm_exchange_bytes": leg.get("exchange_bytes"),
+        }
+        for comp, v in (leg.get("stall_s") or {}).items():
+            if comp.startswith("comm_"):
+                metrics[f"stall_{comp}_s"] = v
+        out.append(make_entry(
+            "commbench", path, fp,
+            {k: v for k, v in metrics.items() if v is not None},
+            round_tag=round_tag, t=t, notes=note, **prov))
+    summary = {
+        "comm_stall_sync_s": doc.get("comm_stall_sync_s"),
+        "comm_stall_overlap_s": doc.get("comm_stall_overlap_s"),
+        "comm_bytes_shrink_x": doc.get("comm_bytes_shrink_x"),
+        "commbench_wall_s": (doc.get("none") or {}).get("wall_s"),
+    }
+    summary = {k: v for k, v in summary.items() if v is not None}
+    if summary:
+        fp = fingerprint(model="lenet", dtype="f32",
+                         batch=doc.get("batch"), world=world,
+                         device=device_hint, tau=tau, comm_codec="none")
+        out.append(make_entry("commbench", path, fp, summary,
+                              round_tag=round_tag, t=t, notes=note,
+                              **prov))
+    return out
+
+
 def entries_from_op_table(doc: Mapping[str, Any],
                           path: str | None = None, *,
                           round_tag: str | None = None,
@@ -897,6 +964,9 @@ def entries_from_any(doc: Mapping[str, Any], path: str | None = None, *,
                                          t=t)
     if "summary" in doc and "by_category" in doc:
         return entries_from_op_table(doc, path, round_tag=round_tag, t=t)
+    if doc.get("commbench"):
+        return entries_from_commbench(doc, path, round_tag=round_tag,
+                                      t=t, device_hint=device_hint)
     if "stall_total_sync_s" in doc:
         return entries_from_roundbench(doc, path, round_tag=round_tag,
                                        t=t, device_hint=device_hint)

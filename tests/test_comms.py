@@ -1,0 +1,411 @@
+"""Communication-efficient rounds (PR 19): the quantization kernels
+(``ops/quant.py``), the codec registry and error-feedback machinery
+(``parallel/comms.py``), and the trainer's compressed τ-boundary
+exchange — codec ``none`` bit-identity, overlap parity, τ plumbing
+through all three strategies, residual checkpoint/resume, elastic
+re-tier, and the cross-replica audit (including bitflip rollback)
+under a lossy codec."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.models import lenet
+from sparknet_tpu.ops import quant
+from sparknet_tpu.parallel import (
+    DistributedTrainer, TrainerConfig, comms, make_mesh, make_pod_mesh,
+)
+from sparknet_tpu.proto import load_solver_prototxt_with_net
+from sparknet_tpu.utils import faults
+
+SOLVER_TXT = 'base_lr: 0.005\nmomentum: 0.9\nlr_policy: "fixed"\n'
+
+
+def _sp(batch=16):
+    return load_solver_prototxt_with_net(SOLVER_TXT, lenet(batch, batch))
+
+
+def _batch(r, tau=2, gb=16):
+    """Learnable class-signal batches (test_parallel.synth idiom) — a
+    convergence assert on pure noise would test memorization, not
+    learning."""
+    rng = np.random.default_rng(900 + r)
+    labels = rng.integers(0, 10, size=tau * gb)
+    x = rng.normal(scale=0.3, size=(tau * gb, 1, 28, 28)).astype(np.float32)
+    for k in range(10):
+        x[labels == k, :, k % 28, :] += 2.0
+    return {"data": x.reshape(tau, gb, 1, 28, 28),
+            "label": labels.astype(np.float32).reshape(tau, gb)}
+
+
+def _run(tr, rounds=3, tau=2, gb=16):
+    losses = [tr.train_round(_batch(r, tau, gb)) for r in range(rounds)]
+    tr.drain()
+    jax.block_until_ready(tr.params)
+    return losses
+
+
+def _params_np(tr):
+    return {k: [np.asarray(b) for b in v] for k, v in tr.params.items()}
+
+
+def _assert_bit_identical(pa, pb, msg=""):
+    for name in pa:
+        for i, x in enumerate(pa[name]):
+            np.testing.assert_array_equal(
+                x, pb[name][i], err_msg=f"{msg} param {name}[{i}]")
+
+
+# ---------------------------------------------------------------------------
+# quant kernels
+# ---------------------------------------------------------------------------
+
+def test_int8_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(scale=0.3, size=(8, 16)), jnp.float32)
+    q, s = quant.quantize_int8(x)
+    assert q.dtype == jnp.int8
+    err = np.abs(np.asarray(quant.dequantize_int8(q, s) - x))
+    # per-tensor scale: error within half a quantization step
+    assert err.max() <= float(np.asarray(s).ravel()[0]) * 0.5 + 1e-7
+
+
+def test_int8_zero_tensor_is_safe():
+    x = jnp.zeros((4, 4), jnp.float32)
+    q, s = quant.quantize_int8(x)
+    out = np.asarray(quant.dequantize_int8(q, s))
+    assert np.isfinite(np.asarray(s)).all()
+    np.testing.assert_array_equal(out, np.zeros((4, 4), np.float32))
+
+
+def test_int8_per_channel_scale_shapes():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8, 3, 5, 5)),
+                    jnp.float32)
+    q, s = quant.quantize_int8(x, keep_axes=(0, 1))
+    assert s.shape == (4, 8, 1, 1, 1)
+    # channels with very different magnitude quantize independently:
+    # scaling one channel up must not change another's error
+    big = x.at[0, 0].multiply(100.0)
+    _, s2 = quant.quantize_int8(big, keep_axes=(0, 1))
+    np.testing.assert_allclose(np.asarray(s2[0, 1]), np.asarray(s[0, 1]))
+
+
+def test_bf16_roundtrip_relative_error():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+    back = np.asarray(quant.dequantize_bf16(quant.quantize_bf16(x)))
+    # bf16 keeps 8 mantissa bits -> relative error < 2^-8
+    np.testing.assert_allclose(back, np.asarray(x), rtol=2 ** -8)
+
+
+# ---------------------------------------------------------------------------
+# codec registry + error feedback
+# ---------------------------------------------------------------------------
+
+def test_registry_unknown_codec_raises():
+    with pytest.raises(ValueError, match="unknown comm codec"):
+        comms.get_codec("flac")
+    assert {"none", "bf16", "int8", "int8_channel"} <= set(
+        comms.codec_names())
+
+
+def test_registry_duplicate_needs_allow_replace():
+    c = comms.get_codec("int8")
+    with pytest.raises(ValueError, match="already registered"):
+        comms.register_codec(c)
+    comms.register_codec(c, allow_replace=True)   # idempotent re-register
+
+
+def _delta_tree(scale=1e-3):
+    rng = np.random.default_rng(7)
+    return {
+        "conv": [jnp.asarray(rng.normal(scale=scale, size=(4, 8, 1, 5, 5)),
+                             jnp.float32)],
+        "bias": [jnp.asarray(rng.normal(scale=scale / 10, size=(4, 8)),
+                             jnp.float32)],
+    }
+
+
+@pytest.mark.parametrize("name", ["none", "bf16", "int8", "int8_channel"])
+def test_error_feedback_invariant_exact(name):
+    """decoded + residual == delta, bit for bit: the residual IS the
+    deferred compression error, nothing may leak."""
+    delta = _delta_tree()
+    _, decoded, residual = comms.roundtrip_tree(comms.get_codec(name),
+                                                delta)
+    recon = jax.tree_util.tree_map(lambda d, r: d + r, decoded, residual)
+    for a, b in zip(jax.tree_util.tree_leaves(recon),
+                    jax.tree_util.tree_leaves(delta)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_residual_dropper_violates_invariant():
+    """A codec that throws residuals away must FAIL the invariant the
+    commbench gate checks — proves the gate can catch the bug class."""
+    int8 = comms.get_codec("int8")
+    dropres = comms.Codec("int8_dropres_t", encode=int8.encode,
+                          decode=int8.decode, keep_residual=False)
+    delta = _delta_tree()
+    _, decoded, residual = comms.roundtrip_tree(dropres, delta)
+    assert all(np.all(np.asarray(r) == 0.0)
+               for r in jax.tree_util.tree_leaves(residual))
+    recon = jax.tree_util.tree_map(lambda d, r: d + r, decoded, residual)
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(recon),
+                        jax.tree_util.tree_leaves(delta)))
+
+
+def test_error_feedback_accumulation_stays_bounded():
+    """Feeding the same delta T times with the residual carried forward:
+    the cumulative decoded mass tracks T×delta with error bounded by ONE
+    quantization step, independent of T (without EF it grows ~T)."""
+    codec = comms.get_codec("int8")
+    delta = {"w": [jnp.full((8, 8), 3.7e-4, jnp.float32)]}
+    res = jax.tree_util.tree_map(jnp.zeros_like, delta)
+    total = jax.tree_util.tree_map(jnp.zeros_like, delta)
+    for _ in range(32):
+        fed = jax.tree_util.tree_map(lambda d, r: d + r, delta, res)
+        _, decoded, res = comms.roundtrip_tree(codec, fed)
+        total = jax.tree_util.tree_map(lambda t, d: t + d, total, decoded)
+    want = 32 * 3.7e-4
+    got = np.asarray(total["w"][0])
+    step = np.abs(np.asarray(delta["w"][0])).max() / quant.INT8_LEVELS
+    assert np.abs(got - want).max() <= step + 1e-7
+
+
+def test_exchange_bytes_int8_shrinks_3x():
+    params = {"conv1": [jnp.zeros((16, 1, 5, 5), jnp.float32),
+                        jnp.zeros((16,), jnp.float32)]}
+    none_b = comms.exchange_bytes(comms.get_codec("none"), params, 4)
+    int8_b = comms.exchange_bytes(comms.get_codec("int8"), params, 4)
+    bf16_b = comms.exchange_bytes(comms.get_codec("bf16"), params, 4)
+    assert none_b / int8_b >= 3.0
+    assert none_b / bf16_b == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# trainer: codec none bit-identity, overlap parity, convergence, audit
+# ---------------------------------------------------------------------------
+
+def test_sync_strategy_rejects_codec():
+    with pytest.raises(ValueError, match="gradient"):
+        DistributedTrainer(_sp(), make_mesh(4),
+                           TrainerConfig(strategy="sync", tau=1,
+                                         comm_codec="int8"), seed=0)
+
+
+def test_codec_none_bit_identical_and_overlap_inert():
+    mesh = make_mesh(4)
+    base = DistributedTrainer(_sp(), mesh,
+                              TrainerConfig(strategy="local_sgd", tau=2),
+                              seed=0)
+    l0 = _run(base)
+    for overlap in (False, True):
+        tr = DistributedTrainer(
+            _sp(), mesh,
+            TrainerConfig(strategy="local_sgd", tau=2, comm_codec="none",
+                          comm_overlap=overlap), seed=0)
+        assert _run(tr) == l0
+        _assert_bit_identical(_params_np(base), _params_np(tr),
+                              f"overlap={overlap}")
+
+
+def test_int8_overlap_bit_parity_and_stall_accounting():
+    mesh = make_mesh(4)
+    sync = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2,
+                                   comm_codec="int8"), seed=0)
+    over = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2,
+                                   comm_codec="int8", comm_overlap=True),
+        seed=0)
+    assert _run(sync) == _run(over)
+    _assert_bit_identical(_params_np(sync), _params_np(over), "int8 overlap")
+    # the synchronous run charges host stall to the three comm components
+    assert sum(sync.stall_s[k] for k in
+               ("comm_encode", "comm_allreduce", "comm_decode")) > 0.0
+
+
+def test_codec_none_overlap_parity_at_harvest_lag():
+    mesh = make_mesh(4)
+    base = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2,
+                                   harvest_lag=1), seed=0)
+    tr = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2,
+                                   harvest_lag=1, comm_codec="none",
+                                   comm_overlap=True), seed=0)
+    # the first harvest under lag 1 is the NaN placeholder in BOTH runs —
+    # assert_array_equal treats the NaNs as equal, list == would not
+    np.testing.assert_array_equal(_run(base, rounds=4), _run(tr, rounds=4))
+    _assert_bit_identical(_params_np(base), _params_np(tr), "lagged")
+
+
+@pytest.mark.parametrize("name", ["bf16", "int8", "int8_channel"])
+def test_lossy_codec_converges_near_full_precision(name):
+    mesh = make_mesh(4)
+    full = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2), seed=0)
+    comp = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2,
+                                   comm_codec=name), seed=0)
+    lf = _run(full, rounds=5)
+    lc = _run(comp, rounds=5)
+    assert np.isfinite(lc).all()
+    assert lc[-1] < lc[0]                      # it learns
+    assert abs(lc[-1] - lf[-1]) < 0.1          # and lands where full does
+
+
+def test_comm_config_from_env(monkeypatch):
+    from sparknet_tpu.parallel import comm_config_from_env
+    monkeypatch.setenv("SPARKNET_TAU", "7")
+    monkeypatch.setenv("SPARKNET_COMM_CODEC", "int8")
+    monkeypatch.setenv("SPARKNET_COMM_OVERLAP", "1")
+    cfg = comm_config_from_env(TrainerConfig(strategy="local_sgd", tau=2))
+    assert (cfg.tau, cfg.comm_codec, cfg.comm_overlap) == (7, "int8", True)
+    monkeypatch.delenv("SPARKNET_TAU")
+    monkeypatch.delenv("SPARKNET_COMM_CODEC")
+    monkeypatch.delenv("SPARKNET_COMM_OVERLAP")
+    base = TrainerConfig(strategy="local_sgd", tau=2)
+    assert comm_config_from_env(base) == base
+
+
+def test_hierarchical_codec_round():
+    mesh = make_pod_mesh(2, 2)
+    tr = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="hierarchical", tau=2,
+                                   comm_codec="int8"), seed=0)
+    losses = _run(tr, rounds=3)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # residual tier = hosts, not chips
+    assert jax.tree_util.tree_leaves(tr.comm_residual)[0].shape[0] == 2
+
+
+def test_audit_uniform_under_codec_and_catches_bitflip(tmp_path):
+    tr = DistributedTrainer(
+        _sp(), make_mesh(4),
+        TrainerConfig(strategy="local_sgd", tau=2, comm_codec="int8",
+                      audit_every=1, checkpoint_dir=str(tmp_path / "ck")),
+        seed=0)
+    tr.train_round(_batch(0))
+    fps = tr.audit_params()
+    assert np.unique(fps).size == 1            # decode left params replicated
+    tr._inject_bitflip(1)
+    assert np.unique(tr.audit_params()).size == 2
+
+
+@pytest.mark.parametrize("strategy,mesh_fn", [
+    ("sync", lambda: make_mesh(4)),
+    ("local_sgd", lambda: make_mesh(4)),
+    ("hierarchical", lambda: make_pod_mesh(2, 2)),
+])
+def test_tau_plumbs_through_all_strategies(strategy, mesh_fn):
+    tr = DistributedTrainer(_sp(), mesh_fn(),
+                            TrainerConfig(strategy=strategy, tau=3), seed=0)
+    tr.train_round(_batch(0, tau=3))
+    tr.train_round(_batch(1, tau=3))
+    tr.drain()
+    assert tr.iter == 6                        # τ local steps per round
+
+
+# ---------------------------------------------------------------------------
+# residuals are trainer state: checkpoint / resume / elastic / rollback
+# ---------------------------------------------------------------------------
+
+def _res_np(tr):
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(tr.comm_residual)]
+
+
+def test_residual_checkpoint_resume_bit_exact(tmp_path):
+    mesh = make_mesh(4)
+    cfg = TrainerConfig(strategy="local_sgd", tau=2, comm_codec="int8")
+    a = DistributedTrainer(_sp(), mesh, cfg, seed=0)
+    _run(a, rounds=2)
+    assert any(np.abs(r).max() > 0 for r in _res_np(a))  # EF is live
+    a.snapshot(str(tmp_path / "snap"))
+
+    b = DistributedTrainer(_sp(), mesh, cfg, seed=1)
+    b.restore(str(tmp_path / "snap"))
+    for ra, rb in zip(_res_np(a), _res_np(b)):
+        np.testing.assert_array_equal(ra, rb)
+    # the continuation is bit-exact, so the residual restore is complete
+    la = a.train_round(_batch(2))
+    lb = b.train_round(_batch(2))
+    a.drain(), b.drain()
+    assert la == lb
+    _assert_bit_identical(_params_np(a), _params_np(b), "resumed")
+
+
+def test_residual_elastic_retier(tmp_path):
+    a = DistributedTrainer(
+        _sp(), make_mesh(4),
+        TrainerConfig(strategy="local_sgd", tau=2, comm_codec="int8"),
+        seed=0)
+    _run(a, rounds=2)
+    a.snapshot(str(tmp_path / "snap"))
+    b = DistributedTrainer(
+        _sp(), make_mesh(2),
+        TrainerConfig(strategy="local_sgd", tau=2, comm_codec="int8",
+                      elastic=True), seed=0)
+    b.restore(str(tmp_path / "snap"))
+    res = _res_np(b)
+    assert res[0].shape[0] == 2                # re-tiered 4 -> 2
+    for i, ra in enumerate(_res_np(a)):
+        np.testing.assert_array_equal(res[i], ra[:2])  # rows i mod 4
+    assert np.isfinite(_run(b, rounds=1)).all()
+
+
+def test_codec_change_resets_residuals(tmp_path, capsys):
+    mesh = make_mesh(4)
+    a = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2,
+                                   comm_codec="int8"), seed=0)
+    _run(a, rounds=2)
+    a.snapshot(str(tmp_path / "snap"))
+    b = DistributedTrainer(
+        _sp(), mesh, TrainerConfig(strategy="local_sgd", tau=2,
+                                   comm_codec="bf16"), seed=0)
+    b.restore(str(tmp_path / "snap"))
+    assert all(np.all(r == 0.0) for r in _res_np(b))
+
+
+@pytest.mark.chaos
+def test_bitflip_rollback_bit_for_bit_under_int8(tmp_path, monkeypatch):
+    """The guard/audit rollback contract survives compression: a flipped
+    replica under the int8 codec is caught by the audit, rolled back
+    (params AND error-feedback residuals restored from the round
+    checkpoint), and the finished run is bit-for-bit equal to
+    fault-free — the satellite fix of PR 19."""
+    def make(d):
+        return DistributedTrainer(
+            _sp(), make_mesh(4),
+            TrainerConfig(strategy="local_sgd", tau=2, comm_codec="int8",
+                          audit_every=1, checkpoint_dir=str(d)), seed=0)
+
+    monkeypatch.delenv("SPARKNET_FAULT", raising=False)
+    faults.reset_injector()
+    clean = make(tmp_path / "clean")
+    while clean.round < 4:
+        clean.train_round(_batch(clean.round))
+    clean.drain()
+    assert clean.audit_trips == 0
+
+    monkeypatch.setenv("SPARKNET_FAULT", "bitflip_params@rank:1@round:3")
+    monkeypatch.setenv("SPARKNET_FAULT_ATTEMPT", "0")
+    faults.reset_injector()
+    try:
+        tr = make(tmp_path / "chaos")
+        while tr.round < 4:
+            tr.train_round(_batch(tr.round))
+        tr.drain()
+        assert tr.audit_trips == 1
+        _assert_bit_identical(_params_np(clean), _params_np(tr), "rollback")
+        for rc, rt in zip(_res_np(clean), _res_np(tr)):
+            np.testing.assert_array_equal(rc, rt)
+    finally:
+        monkeypatch.delenv("SPARKNET_FAULT", raising=False)
+        monkeypatch.delenv("SPARKNET_FAULT_ATTEMPT", raising=False)
+        faults.reset_injector()

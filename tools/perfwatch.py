@@ -184,6 +184,13 @@ _STAGE_LABELS = {
         "(trainer_stall_seconds{component=audit_fetch})",
     "stall_checkpoint_s": "checkpoint (ckpt_write_seconds)",
     "ckpt_write_mean_s": "checkpoint (ckpt_write_seconds)",
+    "stall_comm_encode_s":
+        "trainer.comm_encode (trainer_stall_seconds{component=comm_encode})",
+    "stall_comm_allreduce_s":
+        "trainer.comm_allreduce "
+        "(trainer_stall_seconds{component=comm_allreduce})",
+    "stall_comm_decode_s":
+        "trainer.comm_decode (trainer_stall_seconds{component=comm_decode})",
 }
 
 

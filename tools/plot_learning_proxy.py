@@ -21,6 +21,11 @@ Colors are the first three categorical slots of the repo's chart
 palette (blue/orange/aqua), the subset documented to pass all-pairs
 colorblind validation on a light surface.
 
+When the RESULTS file carries a ``sweep`` key (written by
+``tools/tausweep.py``, PR 19), a second figure ``<out>_sweep.png``
+renders the τ × codec grid: accuracy vs wall clock and vs iteration,
+color = codec, linestyle = τ, with per-cell wire bytes in the legend.
+
 Usage:
   python tools/plot_learning_proxy.py                     # RESULTS_learning_proxy.json
   python tools/plot_learning_proxy.py --in RESULTS_learning_proxy_fullscale.json \
@@ -146,6 +151,76 @@ def render(results, out_path):
             "dropped": dropped}
 
 
+# codec -> categorical color; τ -> linestyle (identity never color-alone:
+# the legend carries both fields textually)
+SWEEP_CODEC_COLORS = {"none": "#2a78d6", "bf16": "#eb6834",
+                      "int8": "#1baf7a", "int8_channel": "#8a63d2"}
+SWEEP_TAU_STYLES = ("solid", (0, (5, 2)), (0, (1, 1)), (0, (3, 1, 1, 1)))
+
+
+def render_sweep(sweep, out_path):
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (ax_wall, ax_iter) = plt.subplots(
+        1, 2, figsize=(11.5, 4.6), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    for ax in (ax_wall, ax_iter):
+        ax.set_facecolor(SURFACE)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        for side in ("left", "bottom"):
+            ax.spines[side].set_color(GRID)
+        ax.grid(True, color=GRID, linewidth=0.8)
+        ax.set_axisbelow(True)
+        ax.tick_params(colors=TEXT_2, labelsize=9)
+        ax.set_ylabel("held-out accuracy", color=TEXT_2, fontsize=10)
+
+    taus = sweep.get("config", {}).get("taus", [])
+    for key, cell in sorted(sweep.get("cells", {}).items()):
+        curve = cell.get("curve") or []
+        if not curve:
+            continue
+        color = SWEEP_CODEC_COLORS.get(cell["codec"], TEXT_2)
+        style = SWEEP_TAU_STYLES[
+            taus.index(cell["tau"]) % len(SWEEP_TAU_STYLES)
+            if cell["tau"] in taus else 0]
+        mb = cell.get("exchange_bytes_per_round", 0) / 1e6
+        label = (f"τ={cell['tau']} {cell['codec']} "
+                 f"({mb:.2f} MB/round)")
+        iters = [r["iter"] for r in curve]
+        acc = [r["test_acc"] for r in curve]
+        walls = [r["wall_s"] for r in curve]
+        ax_iter.plot(iters, acc, color=color, linestyle=style,
+                     linewidth=2, label=label)
+        ax_wall.plot(walls, acc, color=color, linestyle=style,
+                     linewidth=2, label=label)
+
+    ax_wall.set_xlabel("wall-clock seconds", color=TEXT_2, fontsize=10)
+    ax_iter.set_xlabel("iteration", color=TEXT_2, fontsize=10)
+    ax_wall.set_title("τ × codec sweep — accuracy vs wall clock",
+                      color=TEXT, fontsize=11, loc="left")
+    ax_iter.set_title("same cells vs iteration",
+                      color=TEXT, fontsize=11, loc="left")
+    ax_wall.legend(loc="lower right", fontsize=8, frameon=False,
+                   labelcolor=TEXT)
+
+    cfg = sweep.get("config", {})
+    boost = cfg.get("snr_boost", 1.0)
+    boost_txt = "" if boost == 1.0 else f", SNR x{boost:g}"
+    note = (f"cifar10_full @ 1/{cfg.get('scale', '?')} schedule, "
+            f"base_lr {cfg.get('base_lr', '?')}, batch "
+            f"{cfg.get('batch', '?')}, {cfg.get('workers', '?')} workers"
+            f"{boost_txt}, {sweep.get('device', '?')} — delta exchange "
+            f"w/ error feedback (parallel/comms.py)")
+    fig.text(0.01, 0.01, note, fontsize=7.5, color=TEXT_2)
+    fig.tight_layout(rect=(0, 0.04, 1, 1))
+    fig.savefig(out_path, facecolor=SURFACE)
+    plt.close(fig)
+    return {"out": out_path}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render the accuracy-vs-wall-clock figure")
@@ -159,9 +234,15 @@ def main(argv=None) -> int:
     with open(args.inp) as f:
         results = json.load(f)
     info = render(results, out)
+    sweep_fig = None
+    if results.get("sweep", {}).get("cells"):
+        sweep_fig = render_sweep(
+            results["sweep"],
+            os.path.splitext(out)[0] + "_sweep.png")["out"]
     final = results.get("final", {})
     print(json.dumps({
         "figure": info["out"],
+        "sweep_figure": sweep_fig,
         "acc_1x": final.get("acc_1x"),
         "acc_8way": final.get("acc_8way"),
         "acc_hier": final.get("acc_hier"),

@@ -209,6 +209,22 @@ maybe_roundbench() {
   fi
 }
 
+# ~15-second comm-codec parity gate (tools/commbench.py) — opt-in via
+# SPARKNET_COMMBENCH=1.  Fails the gate unless codec "none" (overlap on
+# or off) is bit-identical to the pre-codec trainer, every real codec
+# satisfies the error-feedback invariant while a planted
+# residual-dropping codec is caught, int8/bf16 delta exchange converges
+# inside the declared loss band, overlapped dispatch is bit-identical
+# with less measured comm stall, and the int8 wire shrink is >= 3x.  (A
+# fast in-tree smoke of the same contracts runs inside tier-1:
+# tests/test_comms.py.)
+maybe_commbench() {
+  if [ "${SPARKNET_COMMBENCH:-}" = "1" ]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python tools/commbench.py --out /tmp/_commbench.json
+  fi
+}
+
 # ~7-second vertical-fusion parity gate (tools/fusebench.py) — opt-in
 # via SPARKNET_FUSEBENCH=1.  Fails the gate unless fused execution
 # (SPARKNET_FUSE=all) reproduces per-layer execution bit-for-bit in the
@@ -265,6 +281,7 @@ case "${1:-}" in
   --feedbench) SPARKNET_FEEDBENCH=1 maybe_feedbench ;;
   --recordbench) SPARKNET_RECORDBENCH=1 maybe_recordbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
+  --commbench) SPARKNET_COMMBENCH=1 maybe_commbench ;;
   --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
   --fleetservesmoke) SPARKNET_FLEETSERVESMOKE=1 maybe_fleetservesmoke ;;
   --obssmoke) SPARKNET_OBSSMOKE=1 maybe_obssmoke ;;
@@ -276,14 +293,15 @@ case "${1:-}" in
              && maybe_rollsmoke \
              && maybe_feedbench && maybe_recordbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
+             && maybe_commbench \
              && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
              && maybe_perfgate ;;
   "")      maybe_lint && run_tier1 && maybe_soak && maybe_fleetsoak \
              && maybe_podsoak && maybe_netsoak && maybe_rollsmoke \
              && maybe_feedbench && maybe_recordbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
-             && maybe_roundbench && maybe_obssmoke \
+             && maybe_roundbench && maybe_commbench && maybe_obssmoke \
              && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--netsoak|--rollsmoke|--feedbench|--recordbench|--roundbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--netsoak|--rollsmoke|--feedbench|--recordbench|--roundbench|--commbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac

@@ -1,0 +1,314 @@
+"""τ × codec sweep: the paper's communication-period knob, judged by
+the learning proxy.
+
+SparkNet's central empirical claim is that the communication period τ
+trades per-iteration progress against round overhead, with a broad
+sweet spot (paper Fig. 6).  PR 19 adds a second axis to that trade:
+HOW MUCH each τ-boundary exchange costs on the wire.  This driver runs
+the full grid — τ ∈ {--taus} × codec ∈ {--codecs} — through the same
+8-way vmapped local-SGD machinery as ``tools/learning_proxy.py`` (the
+single-chip restatement of the mesh trainer's ``local_sgd`` strategy),
+with the τ-boundary exchange routed through the SAME codec registry
+the trainer uses (``parallel/comms.py``): each round's weight delta
+against the last broadcast reference is encoded, decoded, averaged,
+and the per-worker compression error is carried forward as an
+error-feedback residual — exactly the trainer's compressed-exchange
+semantics (``DistributedTrainer._build_comm_programs``), restated for
+one chip so the whole grid fits a CPU rig in minutes.
+
+Every cell emits the learning-proxy judge's row shape (iter, lr,
+train_loss, train_acc, test_acc, wall_s) so the accuracy trajectory
+plots on a wall-clock x-axis, plus the analytic per-round exchange
+bytes (``comms.exchange_bytes`` over the real encode).  The verdict
+per τ: does each lossy codec land inside ``--band`` of codec ``none``
+at the SAME τ ("τ-matched band") while shrinking the wire?
+
+Results merge into the learning-proxy RESULTS file under a ``sweep``
+key (existing curves untouched); ``tools/plot_learning_proxy.py``
+renders the sweep panel alongside the headline figure.
+
+Usage:
+  python tools/tausweep.py [--taus 2,10] [--codecs none,bf16,int8]
+      [--scale 200] [--out RESULTS_learning_proxy.json]
+  (add --platform cpu to force the host backend)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--taus", default="2,10",
+                    help="comma list of communication periods")
+    ap.add_argument("--codecs", default="none,bf16,int8",
+                    help="comma list of comms.py codec names")
+    ap.add_argument("--scale", type=int, default=200,
+                    help="schedule divisor vs the published 70k config")
+    ap.add_argument("--batch", type=int, default=100,
+                    help="per-worker batch (the published config's 100 "
+                         "costs ~46ms/image on a 1-core CPU rig — shrink "
+                         "it there, it is recorded in the sweep config)")
+    ap.add_argument("--base-lr", type=float, default=0.001,
+                    help="base learning rate (the published 0.001 needs "
+                         "~750 iters before accuracy moves; a short CPU "
+                         "grid can raise it — recorded in the config)")
+    ap.add_argument("--snr-boost", type=float, default=1.0,
+                    help="scale the generator's class-signal-to-noise "
+                         "ratio: template amp x this, distractor amp "
+                         "and pixel noise / this.  1.0 = the published "
+                         "hard-SNR generator, whose chance-level "
+                         "plateau runs ~50k samples — a 1-core CPU "
+                         "grid cannot cross it, so boost SNR there "
+                         "(recorded in the sweep config)")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--n-train", type=int, default=2000)
+    ap.add_argument("--n-test", type=int, default=400)
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="iters between eval rows (0 = max_iter//5)")
+    ap.add_argument("--band", type=float, default=0.05,
+                    help="τ-matched accuracy band vs codec none")
+    ap.add_argument("--out", default="RESULTS_learning_proxy.json")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+    taus = [int(t) for t in args.taus.split(",")]
+    codec_names = args.codecs.split(",")
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from learning_proxy import build
+    from sparknet_tpu.data.synthgen import synth_splits
+    from sparknet_tpu.models import cifar10_full
+    from sparknet_tpu.parallel import comms
+    from sparknet_tpu.solvers.lr_policies import learning_rate
+
+    tree_map = jax.tree_util.tree_map
+
+    # the published schedule, proportionally scaled (learning_proxy.py)
+    S = args.scale
+    max_iter = 70000 // S
+    steps = (60000 // S, 65000 // S)
+    batch = args.batch
+    sp_text = (
+        f"base_lr: {args.base_lr}\nmomentum: 0.9\nweight_decay: 0.004\n"
+        'lr_policy: "multistep"\ngamma: 0.1\n'
+        f"stepvalue: {steps[0]}\nstepvalue: {steps[1]}\n"
+        f"max_iter: {max_iter}\n")
+    eval_every = args.eval_every or max(max_iter // 5, 1)
+
+    t0 = time.time()
+    data_kw = {}
+    if args.snr_boost != 1.0:
+        data_kw = dict(amp=0.9 * args.snr_boost,
+                       distract_amp=0.7 / args.snr_boost,
+                       noise=1.15 / args.snr_boost)
+    train_x, train_y, test_x, test_y = synth_splits(args.n_train,
+                                                    args.n_test,
+                                                    **data_kw)
+    train_q = np.clip(np.round(train_x), 0, 255).astype(np.uint8)
+    test_q = np.clip(np.round(test_x), 0, 255).astype(np.uint8)
+    mean = train_q.astype(np.float32).mean(axis=0, keepdims=True)
+    dev = jax.devices()[0]
+    print(f"# {dev.platform}/{dev.device_kind}; generated "
+          f"{args.n_train}+{args.n_test} images in {time.time() - t0:.1f}s",
+          flush=True)
+    tx = jax.device_put(jnp.asarray(train_q))
+    ty = jax.device_put(jnp.asarray(train_y, jnp.float32))
+    vx = jax.device_put(jnp.asarray(test_q))
+    vy = jax.device_put(jnp.asarray(test_y, jnp.float32))
+    mean_d = jax.device_put(jnp.asarray(mean))
+
+    def prep(img_u8):
+        return img_u8.astype(jnp.float32) - mean_d
+
+    sp, train_net, test_net, params0, state0, local_update, _ = build(
+        sp_text, cifar10_full(batch, batch))
+
+    @jax.jit
+    def accuracy(params, x, y):
+        n = x.shape[0]
+        nb = n // batch
+
+        def body(c, i):
+            sl = lambda a: lax.dynamic_slice_in_dim(a, i * batch, batch)
+            out = test_net.apply(
+                params, {"data": prep(sl(x)), "label": sl(y)},
+                train=False)
+            return c + out.blobs["accuracy"], 0.0
+
+        total, _ = lax.scan(body, jnp.zeros(()), jnp.arange(nb))
+        return total / nb
+
+    W = args.workers
+    part = args.n_train // W
+    vm_update = jax.vmap(local_update, in_axes=(0, 0, None, 0, 0))
+
+    def make_rounds(codec, tau):
+        """Compiled chunk of rounds with the compressed τ-boundary
+        exchange: τ local steps per worker, then delta-vs-reference
+        encode/decode with error feedback (the trainer's
+        _build_comm_programs semantics on a stacked worker axis)."""
+
+        def rounds(wparams, wstate, ref, res, it0, idxs, rng):
+            """idxs: [n_rounds, tau, W, batch] partition-local."""
+            def round_body(carry, round_idx):
+                wparams, wstate, ref, res, it, rng = carry
+
+                def step(c, step_idx):
+                    wparams, wstate, it, rng = c
+                    rng, sub = jax.random.split(rng)
+                    subs = jax.random.split(sub, W)
+                    offs = jnp.arange(W)[:, None] * part
+                    b = {"data": prep(tx[step_idx + offs])[:, None],
+                         "label": ty[step_idx + offs][:, None]}
+                    wparams, wstate, loss = vm_update(wparams, wstate, it,
+                                                      b, subs)
+                    return (wparams, wstate, it + 1, rng), jnp.mean(loss)
+
+                (wparams, wstate, it, rng), losses = lax.scan(
+                    step, (wparams, wstate, it, rng), round_idx)
+                delta = tree_map(lambda l, r, e: l - r[None] + e,
+                                 wparams, ref, res)
+                _, decoded, res = comms.roundtrip_tree(codec, delta)
+                ref = tree_map(lambda r, d: r + jnp.mean(d, axis=0),
+                               ref, decoded)
+                wparams = tree_map(
+                    lambda r, x: jnp.broadcast_to(r[None], x.shape),
+                    ref, wparams)
+                return (wparams, wstate, ref, res, it, rng), \
+                    jnp.mean(losses)
+
+            (wparams, wstate, ref, res, it, _), losses = lax.scan(
+                round_body, (wparams, wstate, ref, res, it0, rng), idxs)
+            return wparams, wstate, ref, res, jnp.mean(losses)
+
+        return jax.jit(rounds)
+
+    bytes_none = comms.exchange_bytes(comms.get_codec("none"), params0, W)
+
+    def run_cell(codec_name, tau, key):
+        codec = comms.get_codec(codec_name)
+        rounds_fn = make_rounds(codec, tau)
+        stack = lambda x: jnp.broadcast_to(x[None], (W,) + x.shape)
+        wparams = tree_map(stack, params0)
+        wstate = tree_map(stack, state0)
+        ref = params0
+        res = tree_map(lambda x: jnp.zeros((W,) + x.shape, jnp.float32),
+                       params0)
+        rng = jax.random.PRNGKey(key)
+        rng_idx = np.random.default_rng(11)   # same batches per cell
+        rounds_per_eval = max(eval_every // tau, 1)
+        curve = []
+        it = 0
+        t_run = time.time()
+        while it < max_iter:
+            n_rounds = min(rounds_per_eval, (max_iter - it) // tau)
+            if n_rounds == 0:
+                break
+            idxs = rng_idx.integers(0, part,
+                                    size=(n_rounds, tau, W, batch))
+            rng, sub = jax.random.split(rng)
+            wparams, wstate, ref, res, loss = rounds_fn(
+                wparams, wstate, ref, res, it, jnp.asarray(idxs), sub)
+            it += n_rounds * tau
+            row = {"iter": it,
+                   "lr": float(learning_rate(sp, it - 1)),
+                   "train_loss": float(loss),
+                   "train_acc": float(accuracy(
+                       ref, tx[:args.n_test], ty[:args.n_test])),
+                   "test_acc": float(accuracy(ref, vx, vy)),
+                   "wall_s": round(time.time() - t_run, 1)}
+            curve.append(row)
+            print(f"tau{tau:<3d} {codec_name:12s} iter {it:5d} "
+                  f"loss {row['train_loss']:.3f} "
+                  f"test_acc {row['test_acc']:.3f} "
+                  f"({row['wall_s']}s)", flush=True)
+        cell_bytes = comms.exchange_bytes(codec, params0, W)
+        # final_acc averages the last two eval rows: the multistep x0.1
+        # drops land in the final fifth of the schedule, so the tail
+        # mean spans the converged region and damps single-row eval
+        # noise that would otherwise dominate the band verdict
+        tail = [r["test_acc"] for r in curve[-2:]]
+        return {
+            "tau": tau, "codec": codec_name, "curve": curve,
+            "final_acc": float(np.mean(tail)),
+            "wall_s": round(time.time() - t_run, 1),
+            "rounds": max_iter // tau,
+            "exchange_bytes_per_round": cell_bytes,
+            "bytes_shrink_x": round(bytes_none / cell_bytes, 3),
+        }
+
+    cells = {}
+    for ti, tau in enumerate(taus):
+        for name in codec_names:
+            # same init, rng stream, and batch sequence for every codec
+            # at a given τ: the codec is the ONLY difference inside a
+            # τ-matched comparison
+            cells[f"tau{tau}_{name}"] = run_cell(name, tau, 500 + 10 * ti)
+
+    # τ-matched band verdict: every lossy codec vs none at the SAME τ
+    band_ok = {}
+    for tau in taus:
+        base = cells.get(f"tau{tau}_none")
+        if base is None:
+            continue
+        for name in codec_names:
+            if name == "none":
+                continue
+            cell = cells[f"tau{tau}_{name}"]
+            drift = abs(cell["final_acc"] - base["final_acc"])
+            band_ok[f"tau{tau}_{name}"] = {
+                "drift": round(drift, 4),
+                "ok": bool(drift <= args.band),
+            }
+
+    sweep = {
+        "config": {
+            "scale": S, "max_iter": max_iter, "stepvalues": list(steps),
+            "base_lr": args.base_lr,
+            "snr_boost": args.snr_boost,
+            "batch": batch, "n_train": args.n_train,
+            "n_test": args.n_test, "workers": W,
+            "taus": taus, "codecs": codec_names, "band": args.band,
+        },
+        "device": f"{dev.platform}/{dev.device_kind}",
+        "exchange_bytes_none": bytes_none,
+        "cells": cells,
+        "band_ok": band_ok,
+    }
+
+    results = {}
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            results = json.load(f)
+    results["sweep"] = sweep
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+
+    summary = {
+        "final_acc": {k: c["final_acc"] for k, c in cells.items()},
+        "wall_s": {k: c["wall_s"] for k, c in cells.items()},
+        "bytes_shrink_x": {k: c["bytes_shrink_x"]
+                           for k, c in cells.items()},
+        "band_ok": band_ok,
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if all(v["ok"] for v in band_ok.values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
