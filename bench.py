@@ -35,7 +35,10 @@ BENCH_FEED_BATCH, BENCH_FEED_ITERS, BENCH_FEED_DELAY_S (per-batch host
 decode stand-in, see measure_feed); round-overhead tier (outer-loop
 host stalls with ckpt+guard+audit on, sync vs async — see
 measure_round_overhead): BENCH_ROUND=0 to skip, BENCH_ROUND_N/_TAU/
-_LAG/_BATCH/_EVERY; serving tier (closed-loop latency/QPS through the
+_LAG/_BATCH/_EVERY; sharded-round tier (dp vs tensor-sharded boundary
+bytes + wall with bit-parity assert — see measure_shard_round):
+BENCH_SHARD=0 to skip, BENCH_SHARD_N/_TAU/_BATCH; serving tier
+(closed-loop latency/QPS through the
 inference engine — see measure_serving): BENCH_SERVING=0 to skip,
 BENCH_SERVE_MODEL/_CLIENTS/_WINDOW/_SECONDS; vertical fusion:
 BENCH_FUSE=off|auto|all|<plan.json> pins SPARKNET_FUSE for the child
@@ -576,6 +579,71 @@ def run_child() -> None:
                 / max(async_["stall_total_s_per_round"], 1e-6), 1),
         }
 
+    def measure_shard_round() -> dict:
+        """The hybrid-sharding leg: τ-boundary broadcast bytes and round
+        wall for the replicated round (TrainerConfig.shard="off") vs the
+        tensor-sharded one ("auto" — parallel/partition.py's rule table
+        shards FC/inner-product weights across chips).  Both legs run the
+        same seed and feed with codec none, so the sharded round is
+        bit-identical to dp by the reduce-scatter/pmean identity — and
+        the leg ASSERTS it (``parity_ok``) instead of trusting it.
+        Bytes are analytic layout accounting
+        (``partition.boundary_bytes_per_chip``), not a wire sniff, so
+        the shrink claim is reproducible on any backend.  Knobs:
+        BENCH_SHARD_N (timed rounds), BENCH_SHARD_TAU,
+        BENCH_SHARD_BATCH; BENCH_SHARD=0 skips the leg."""
+        from sparknet_tpu.parallel import (
+            DistributedTrainer, TrainerConfig, make_mesh, partition,
+        )
+
+        rounds_n = int(os.environ.get("BENCH_SHARD_N", 4))
+        tau = int(os.environ.get("BENCH_SHARD_TAU", 4))
+        rbatch = int(os.environ.get("BENCH_SHARD_BATCH", BATCH))
+        mesh = make_mesh()
+        workers = int(mesh.shape["data"])
+        if workers < 2:
+            return {"skipped": f"{workers} worker(s): nothing to shard"}
+        feed = {"data": rng.normal(size=(tau, rbatch) + in_shape
+                                   ).astype(np.float32),
+                "label": rng.integers(0, classes, size=(tau, rbatch)
+                                      ).astype(np.float32)}
+
+        def leg(shard: str) -> tuple:
+            cfg = TrainerConfig(strategy="local_sgd", tau=tau,
+                                shard=shard)
+            tr = DistributedTrainer(sp, mesh, cfg, seed=0)
+            losses = [tr.train_round(feed)]    # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(rounds_n):
+                losses.append(tr.train_round(feed))
+            dt = time.perf_counter() - t0
+            out = {"img_s": round(rbatch * tau * rounds_n / dt, 1),
+                   "round_s": round(dt / rounds_n, 4)}
+            return tr, out, losses
+
+        dp_tr, dp, dp_losses = leg("off")
+        sh_tr, sh, sh_losses = leg("auto")
+        plan = sh_tr.shard_plan
+        if plan is None:
+            return {"skipped": "no shardable leaves for this model"}
+        dp["boundary_bytes_per_chip"] = partition.boundary_bytes_per_chip(
+            dp_tr.params, None)
+        sh["boundary_bytes_per_chip"] = partition.boundary_bytes_per_chip(
+            sh_tr.params, plan)
+        parity_ok = all(
+            np.float32(a).tobytes() == np.float32(b).tobytes()
+            for a, b in zip(dp_losses, sh_losses))
+        shrink = round(dp["boundary_bytes_per_chip"]
+                       / max(sh["boundary_bytes_per_chip"], 1), 2)
+        _log(f"shard_round[{sh_tr.shard_plan_id}]: dp {dp['round_s']}s "
+             f"/ {dp['boundary_bytes_per_chip']} B vs sharded "
+             f"{sh['round_s']}s / {sh['boundary_bytes_per_chip']} B "
+             f"per chip ({shrink}x, parity {'OK' if parity_ok else 'FAILED'})")
+        return {"batch": rbatch, "tau": tau, "rounds": rounds_n,
+                "workers": workers, "dtype": "f32",
+                "plan": sh_tr.shard_plan_id, "dp": dp, "sharded": sh,
+                "bytes_shrink_x": shrink, "parity_ok": parity_ok}
+
     def measure_serving() -> dict:
         """The serving-plane leg: closed-loop latency/QPS through the
         dynamic micro-batching engine (parallel/serving.py) — batch=1
@@ -630,6 +698,13 @@ def run_child() -> None:
         except Exception as e:  # this tier must not sink the bench either
             _log(f"round_overhead measurement failed: {e}")
             round_overhead = {"error": str(e)}
+    shard_round = None
+    if os.environ.get("BENCH_SHARD", "1") != "0":
+        try:
+            shard_round = measure_shard_round()
+        except Exception as e:  # this tier must not sink the bench either
+            _log(f"shard_round measurement failed: {e}")
+            shard_round = {"error": str(e)}
     serving = None
     if os.environ.get("BENCH_SERVING", "1") != "0":
         try:
@@ -675,6 +750,7 @@ def run_child() -> None:
         "feed_in_loop": feed,
         "feed_records": feed_records,
         "round_overhead": round_overhead,
+        "shard_round": shard_round,
         "serving": serving,
         "provenance": perfledger.provenance(fp),
     }
@@ -717,6 +793,8 @@ _CONFIG_ENVS = ("BENCH_PLATFORM", "BENCH_MODEL", "BENCH_BATCH",
                 "SPARKNET_FEED_DEPTH", "SPARKNET_FEED_PUTTERS",
                 "BENCH_ROUND_N", "BENCH_ROUND_TAU", "BENCH_ROUND_LAG",
                 "BENCH_ROUND_BATCH", "BENCH_ROUND_EVERY",
+                "BENCH_SHARD_N", "BENCH_SHARD_TAU", "BENCH_SHARD_BATCH",
+                "SPARKNET_SHARD",
                 "SPARKNET_ASYNC_CKPT",
                 "BENCH_SERVE_MODEL", "BENCH_SERVE_CLIENTS",
                 "BENCH_SERVE_WINDOW", "BENCH_SERVE_SECONDS",

@@ -7,6 +7,8 @@ from .trainer import (
     device_crop_mirror_mean,
 )
 from . import comms
+from . import partition
+from .partition import ShardPlan, resolve_plan, shard_plan_id
 from .cluster import init_cluster, is_multi_host, local_batch_slice
 from .resilience import (
     ElasticPolicy,

@@ -168,3 +168,29 @@ def exchange_bytes(codec: Codec, params, n_tier: int) -> int:
     payload = jax.eval_shape(lambda t: encode_tree(codec, t), stacked)
     return int(sum(leaf.size * jnp.dtype(leaf.dtype).itemsize
                    for leaf in jax.tree_util.tree_leaves(payload)))
+
+
+def sharded_exchange_bytes(codec: Codec, params, n_tier: int,
+                           plan=None) -> int:
+    """``exchange_bytes`` under a partition plan
+    (``parallel.partition.ShardPlan``): the analytic wire bytes when
+    the τ-boundary exchange is shard-local — each position moves only
+    its own shard's slice of the sharded leaves (the codec-``none``
+    fused round's reduce-scatter, and the hierarchical strategy's
+    per-shard DCN average), while replicated leaves ride in full as
+    before.  ``plan=None`` degenerates to :func:`exchange_bytes`
+    exactly, so ledger comparisons across the dp/sharded fingerprint
+    axis share one accounting."""
+    if plan is None:
+        return exchange_bytes(codec, params, n_tier)
+    shard = {}
+    for name, blobs in params.items():
+        row = []
+        for i, b in enumerate(blobs):
+            dim = plan.dim_of(f"{name}/{i}")
+            shape = list(b.shape)
+            if dim is not None:
+                shape[dim] //= plan.n_shards
+            row.append(jax.ShapeDtypeStruct(tuple(shape), jnp.float32))
+        shard[name] = row
+    return exchange_bytes(codec, shard, n_tier)

@@ -94,8 +94,15 @@ def put_global(value, sharding: NamedSharding) -> jax.Array:
         value.shape, sharding, lambda idx: value[idx])
 
 
-def put_global_tree(tree, sharding: NamedSharding):
-    return jax.tree_util.tree_map(lambda x: put_global(x, sharding), tree)
+def put_global_tree(tree, sharding):
+    """Place a host pytree on the mesh.  ``sharding`` is either a single
+    NamedSharding applied to every leaf (the replicated classic) or a
+    matching pytree of NamedShardings — the hybrid-sharding path, where
+    each parameter leaf carries its own placement from the partition
+    rule table (``parallel/partition.py``)."""
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(lambda x: put_global(x, sharding), tree)
+    return jax.tree_util.tree_map(put_global, tree, sharding)
 
 
 def stage_local(local_value, sharding: NamedSharding) -> jax.Array:

@@ -32,7 +32,15 @@ optimizer state.  Incarnations shrink until ``min_workers``; a
 boundary (the only membership boundary an SPMD job has).  Note local
 mode renumbers ranks 0..n-1 after a drop — ranks are fungible slots; in
 ssh mode the *host* is what is dropped, which is the real-world
-semantics.
+semantics.  The contract extends to tensor-sharded runs unchanged:
+under a partition rule table (``TrainerConfig.shard``, SPARKNET_SHARD
+in the child env) the relaunched incarnation resolves a FRESH plan for
+its new world size at trainer init, and because checkpoint blobs always
+carry full logical leaves (per-shard npz tiles are a write-side split —
+``utils/checkpoint.py``), the elastic resume re-slices them onto the
+new plan bit-exactly; no runner-side shard bookkeeping exists to go
+stale (pinned by tests/test_resilience.py::
+test_elastic_retile_sharded_matches_native_2worker_run_bit_for_bit).
 
 **Host-granular attribution** — on a pod, the failure unit is the
 *host*: all R ranks placed on a preempted machine expire together, and

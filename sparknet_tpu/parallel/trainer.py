@@ -205,6 +205,27 @@ class TrainerConfig:
     # exchange already rides inside the one compiled round with zero
     # host stall to hide.
     comm_overlap: bool = False
+    # Hybrid model+data sharding (parallel/partition.py; ROADMAP item 2).
+    # "off" keeps pure data parallelism — the pre-plan code path byte for
+    # byte.  "auto" resolves the zoo default rule table (FC/inner-product
+    # weights shard across the mesh's fast axis — chips on a pod mesh,
+    # the data axis on a flat mesh — convs and biases stay replicated);
+    # anything else is the path of a versioned JSON rule table.  Params
+    # then LIVE sharded between rounds (HBM / shard factor), the round
+    # bodies gather shards on entry (tiled all_gather — exact) and
+    # reduce-scatter at the τ boundary (each position receives only its
+    # own shard's bytes), so losses and logical params stay bit-identical
+    # to the replicated baseline at codec "none" — by construction:
+    # psum_scatter(tiled)/n is bitwise pmean-then-slice, and slicing is
+    # not arithmetic.
+    shard: str = "off"
+    # Per-shard round checkpoints: with a live shard plan, write the
+    # sharded leaves as one npz tile per shard (common leaves + manifest
+    # unchanged), all fanned through the same (async) writer and each
+    # sha256-pinned in the manifest.  Restore joins tiles back to full
+    # logical leaves, so a checkpoint written at world N re-tiles onto
+    # world M bit-exactly (the elastic contract survives sharding).
+    shard_checkpoint: bool = False
 
 
 class TrainingDivergedError(RuntimeError):
@@ -266,7 +287,9 @@ def comm_config_from_env(base: TrainerConfig | None = None) -> TrainerConfig:
     """``base`` (or a default TrainerConfig) with the communication
     round shape taken from the registered knobs where they are set:
     ``SPARKNET_TAU`` (steps per round — the paper's swept frontier knob),
-    ``SPARKNET_COMM_CODEC`` and ``SPARKNET_COMM_OVERLAP``.  Unset knobs
+    ``SPARKNET_COMM_CODEC``, ``SPARKNET_COMM_OVERLAP``, ``SPARKNET_SHARD``
+    (partition rule table: off | auto | path) and
+    ``SPARKNET_SHARD_CKPT`` (per-shard round checkpoints).  Unset knobs
     leave ``base``'s fields untouched, so an explicitly-constructed
     config still wins; drivers (tools/train, commbench, sweep harnesses)
     call this so one env var re-shapes a whole launched grid without
@@ -282,12 +305,20 @@ def comm_config_from_env(base: TrainerConfig | None = None) -> TrainerConfig:
     if knobs.is_set("SPARKNET_COMM_OVERLAP"):
         cfg = dataclasses.replace(
             cfg, comm_overlap=knobs.get_bool("SPARKNET_COMM_OVERLAP", False))
+    shard = knobs.get_str("SPARKNET_SHARD", "")
+    if shard:
+        cfg = dataclasses.replace(cfg, shard=shard)
+    if knobs.is_set("SPARKNET_SHARD_CKPT"):
+        cfg = dataclasses.replace(
+            cfg, shard_checkpoint=knobs.get_bool("SPARKNET_SHARD_CKPT",
+                                                 False))
     return cfg
 
 
 class DistributedTrainer:
-    """Owns replicated params + (per-device or shared) solver state and a
-    compiled per-round train step over a device mesh."""
+    """Owns params (replicated, or per-leaf sharded under a partition
+    rule table — ``TrainerConfig.shard``) + (per-device or shared) solver
+    state and a compiled per-round train step over a device mesh."""
 
     def __init__(self, sp: SolverParameter, mesh=None,
                  config: TrainerConfig | None = None, *, seed: int = 0):
@@ -336,9 +367,29 @@ class DistributedTrainer:
         rep = replicated(self.mesh)
         # same-seed host-side init staged onto the (possibly multi-host)
         # mesh — explicit per-host replication (SURVEY.md §7.3)
+        host_params = self.train_net.init(init_rng)
+        # hybrid model+data sharding: resolve the partition rule table
+        # against this net's shapes at init (parallel/partition.py).
+        # None = pure DP — every code path below is then the pre-plan
+        # trainer byte for byte.  Shards live on the fast axis: chips on
+        # a pod mesh, the one data axis on a flat mesh.
+        from . import partition
+        if self.config.strategy == "hierarchical":
+            shard_axis, n_shards = CHIP_AXIS, self.n_chips
+        else:
+            shard_axis, n_shards = DATA_AXIS, self.n_workers
+        self.shard_plan = partition.resolve_plan(
+            self.config.shard, host_params, axis=shard_axis,
+            n_shards=n_shards)
+        self.shard_plan_id = partition.shard_plan_id(self.shard_plan)
+        # per-leaf resident placement: a params-shaped pytree of
+        # NamedShardings under a plan, one replicated sharding without
+        self._params_sharding = (
+            self.shard_plan.sharding_tree(self.mesh, host_params)
+            if self.shard_plan is not None else rep)
         self.params: WeightCollection = put_global_tree(
-            self.train_net.init(init_rng), rep)
-        state0 = self.rule.init(self.params)
+            host_params, self._params_sharding)
+        state0 = self.rule.init(host_params)
         if self.config.strategy == "sync":
             self.state = put_global_tree(state0, rep)
         else:
@@ -554,9 +605,15 @@ class DistributedTrainer:
 
         def sync_body(params, state, it, batches, rng, lr_scale):
             """Per-step grad pmean (P2PSync semantics)."""
+            params = maybe_gather(params)
             (params, state, it, _), losses = lax.scan(
                 make_psum_step(DATA_AXIS, lr_scale),
                 (params, state, it, rng), split_micro(batches))
+            if plan is not None:
+                # every position computed the same full update (per-step
+                # grad pmean); each keeps only its resident shard — a
+                # slice, zero communication, exact
+                params = plan.take_shard(params, DATA_AXIS)
             return params, state, jnp.mean(losses)
 
         # compressed exchange (comm_codec != "none"): the τ-boundary
@@ -566,8 +623,40 @@ class DistributedTrainer:
         # programs built by _build_comm_programs do the averaging outside
         compressed = self._codec is not None
 
+        # hybrid sharding: params enter the round in their resident
+        # (per-leaf sharded) layout, are widened to full leaves by a
+        # tiled all_gather (pure data movement — exact), and leave the
+        # round shard-local again at the τ boundary.  plan=None keeps
+        # the replicated P() contract untouched.
+        plan = self.shard_plan
+
+        def maybe_gather(params):
+            return params if plan is None else plan.gather(params)
+
+        def shard_boundary_mean(params, axis):
+            """τ-boundary average under a plan: sharded leaves reduce-
+            scatter (each position RECEIVES only its own shard's bytes
+            — the broadcast shrink this refactor exists for), replicated
+            leaves pmean as before.  psum_scatter(tiled)/n is bitwise
+            identical to pmean-then-slice, so the parity contract
+            holds."""
+            out = {}
+            for name, blobs in params.items():
+                row = []
+                for i, b in enumerate(blobs):
+                    dim = plan.dim_of(f"{name}/{i}")
+                    if dim is None:
+                        row.append(lax.pmean(b, axis))
+                    else:
+                        row.append(lax.psum_scatter(
+                            b, axis, scatter_dimension=dim, tiled=True)
+                            / plan.n_shards)
+                out[name] = row
+            return out
+
         def local_sgd_body(params, state, it, batches, rng, lr_scale):
             """τ local steps, then weight averaging (SparkNet semantics)."""
+            params = maybe_gather(params)
             state = jax.tree_util.tree_map(lambda x: x[0], state)
             rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
 
@@ -589,7 +678,10 @@ class DistributedTrainer:
                 # the broadcast → reduce → scalarDivide of the reference's
                 # outer loop (ImageNetApp.scala:102,178-179), as one ICI
                 # collective:
-                params = lax.pmean(params, DATA_AXIS)
+                if plan is None:
+                    params = lax.pmean(params, DATA_AXIS)
+                else:
+                    params = shard_boundary_mean(params, DATA_AXIS)
             else:
                 params = jax.tree_util.tree_map(lambda x: x[None], params)
             state = jax.tree_util.tree_map(lambda x: x[None], state)
@@ -603,6 +695,7 @@ class DistributedTrainer:
             semantics: re-averaged per step over chips inside the psum
             step, averaged with the weights at the τ boundary over
             hosts."""
+            params = maybe_gather(params)
             state = jax.tree_util.tree_map(lambda x: x[0], state)
             rng = jax.random.fold_in(rng, lax.axis_index(HOST_AXIS))
             (params, state, it, _), losses = lax.scan(
@@ -613,7 +706,15 @@ class DistributedTrainer:
                 # the cross-host averaging rides DCN once per τ steps —
                 # the broadcast → reduce → scalarDivide of the reference's
                 # outer loop (ImageNetApp.scala:102,178-179)
-                params = lax.pmean(params, HOST_AXIS)
+                if plan is None:
+                    params = lax.pmean(params, HOST_AXIS)
+                else:
+                    # slice the resident chip shard FIRST, then average
+                    # over hosts: the DCN collective moves only shard
+                    # bytes, and slice-then-mean == mean-then-slice
+                    # elementwise, so parity holds
+                    params = plan.take_shard(params, CHIP_AXIS)
+                    params = lax.pmean(params, HOST_AXIS)
             else:
                 # chips within a host already agree (per-step chip psum);
                 # stack one copy per HOST for the compressed DCN exchange
@@ -626,13 +727,18 @@ class DistributedTrainer:
         body = bodies[strategy]
         state_spec = (P() if strategy == "sync"
                       else self._state_tier()[1])
-        params_out_spec = self._state_tier()[1] if compressed else P()
+        # params in/out specs derive from the partition rule table: a
+        # per-leaf pytree of PartitionSpecs under a plan, P() without
+        params_in_spec = (P() if plan is None
+                          else plan.spec_tree(self.params))
+        params_out_spec = (self._state_tier()[1] if compressed
+                           else params_in_spec)
         # batches: [tau, global_batch, ...] sharded on the batch axis
         batch_spec = P(None, self._batch_axes)
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(), state_spec, P(), batch_spec, P(), P()),
+            in_specs=(params_in_spec, state_spec, P(), batch_spec, P(), P()),
             out_specs=(params_out_spec, state_spec, P()),
             **_SM_NOCHECK,
         )
@@ -688,7 +794,12 @@ class DistributedTrainer:
         # still needs them after encode ran)
         encode = jax.jit(enc, donate_argnums=(0, 2))
         exchange = jax.jit(lambda t: t, out_shardings=rep)
-        decode = jax.jit(dec, out_shardings=rep)
+        # every replica decodes the same gathered payload, so the full
+        # logical result is identical everywhere; under a shard plan the
+        # output lands straight in the per-leaf resident placement (each
+        # position stores only its shard of the identical value — the
+        # audit's shard invariant holds under every codec)
+        decode = jax.jit(dec, out_shardings=self._params_sharding)
         return encode, exchange, decode
 
     def _run_comm_round(self, batches, rng):
@@ -846,7 +957,7 @@ class DistributedTrainer:
                 t0 = time.perf_counter()
                 fps = self.audit_params()
                 self.stall_s["audit_fetch"] += time.perf_counter() - t0
-                if np.unique(fps).size > 1:
+                if not self._audit_ok(fps):
                     # round dropped BEFORE it runs; self.round rewinds to
                     # the rollback point, so a while-trainer.round driver
                     # replays
@@ -1039,7 +1150,7 @@ class DistributedTrainer:
             t0 = time.perf_counter()
             fps = np.asarray(e["fps"])
             self.stall_s["audit_fetch"] += time.perf_counter() - t0
-            if np.unique(fps).size > 1:
+            if not self._audit_ok(fps):
                 self._pending.clear()
                 self.flush_checkpoints()
                 self._audit_trip(round_idx, fps)
@@ -1108,8 +1219,9 @@ class DistributedTrainer:
         for mpath in glob.glob(os.path.join(directory, "manifest_*.json")):
             r = _manifest_round(mpath)
             if r > round_idx:
-                for p in (mpath, os.path.join(directory,
-                                              f"ckpt_round_{r:08d}.npz")):
+                # the glob sweeps per-shard tiles along with the main npz
+                for p in (mpath, *glob.glob(os.path.join(
+                        directory, f"ckpt_round_{r:08d}*.npz"))):
                     try:
                         os.remove(p)
                     except OSError:
@@ -1121,26 +1233,95 @@ class DistributedTrainer:
         float param leaves to uint32 and tree-sums them (mod 2**32 — any
         single flipped bit changes the sum), then one all_gather over the
         batch axes returns every replica's fingerprint, replicated, so
-        all processes reach the same verdict without extra traffic."""
+        all processes reach the same verdict without extra traffic.
+
+        Under a shard plan each position holds full copies of the
+        replicated leaves but only ITS shard of the sharded ones, so one
+        scalar per position can no longer be compared mesh-wide.  The
+        fingerprint becomes a [n_pos, 2] matrix — column 0 sums the
+        replicated leaves (must be unanimous mesh-wide, as before),
+        column 1 sums the resident shard content (one uint32 per shard,
+        gathered in the same single all_gather; compared within the
+        groups of positions that hold the same shard — see
+        ``_audit_culprits``)."""
         axes = self._batch_axes
+        plan = self.shard_plan
+
+        def leaf_sum(leaf):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                f32 = (leaf if leaf.dtype == jnp.float32
+                       else leaf.astype(jnp.float32))
+                bits = lax.bitcast_convert_type(f32, jnp.uint32)
+            elif jnp.issubdtype(leaf.dtype, jnp.integer):
+                bits = leaf.astype(jnp.uint32)
+            else:
+                return None
+            return jnp.sum(bits, dtype=jnp.uint32)
 
         def fingerprint(params):
-            total = jnp.zeros((), jnp.uint32)
-            for leaf in jax.tree_util.tree_leaves(params):
-                if jnp.issubdtype(leaf.dtype, jnp.floating):
-                    f32 = (leaf if leaf.dtype == jnp.float32
-                           else leaf.astype(jnp.float32))
-                    bits = lax.bitcast_convert_type(f32, jnp.uint32)
-                elif jnp.issubdtype(leaf.dtype, jnp.integer):
-                    bits = leaf.astype(jnp.uint32)
-                else:
-                    continue
-                total = total + jnp.sum(bits, dtype=jnp.uint32)
-            return lax.all_gather(total, axes).reshape(-1)
+            if plan is None:
+                total = jnp.zeros((), jnp.uint32)
+                for leaf in jax.tree_util.tree_leaves(params):
+                    s = leaf_sum(leaf)
+                    if s is not None:
+                        total = total + s
+                return lax.all_gather(total, axes).reshape(-1)
+            total_rep = jnp.zeros((), jnp.uint32)
+            total_shard = jnp.zeros((), jnp.uint32)
+            for name, blobs in params.items():
+                for i, leaf in enumerate(blobs):
+                    s = leaf_sum(leaf)
+                    if s is None:
+                        continue
+                    if plan.dim_of(f"{name}/{i}") is None:
+                        total_rep = total_rep + s
+                    else:
+                        total_shard = total_shard + s
+            pair = jnp.stack([total_rep, total_shard])
+            return lax.all_gather(pair, axes).reshape(-1, 2)
 
-        mapped = shard_map(fingerprint, mesh=self.mesh, in_specs=(P(),),
+        params_spec = (P() if plan is None
+                       else plan.spec_tree(self.params))
+        mapped = shard_map(fingerprint, mesh=self.mesh,
+                           in_specs=(params_spec,),
                            out_specs=P(), **_SM_NOCHECK)
         return jax.jit(mapped)
+
+    def _audit_groups(self) -> list[list[int]]:
+        """Mesh positions (flattened in batch-axes order) that hold
+        identical shard content: on the pod mesh every host replicates
+        each chip's shard (group = one chip column across hosts); on a
+        flat mesh every position owns a distinct shard (singleton
+        groups — the shard column is then self-consistent by definition
+        and only the replicated column can trip)."""
+        if self.config.strategy == "hierarchical":
+            return [[h * self.n_chips + c for h in range(self.n_hosts)]
+                    for c in range(self.n_chips)]
+        return [[i] for i in range(self.n_workers)]
+
+    def _audit_culprits(self, fps: np.ndarray) -> list[int]:
+        """Positions whose fingerprints disagree with their comparison
+        group's majority.  1-D fps = the replicated-params legacy shape
+        (one scalar per position, one mesh-wide group); 2-D fps = the
+        sharded shape (column 0 mesh-wide, column 1 per shard group)."""
+        fps = np.asarray(fps)
+        if fps.ndim == 1:
+            checks = [(list(range(fps.shape[0])), fps)]
+        else:
+            checks = [(list(range(fps.shape[0])), fps[:, 0])]
+            checks += [(g, fps[:, 1]) for g in self._audit_groups()]
+        culprits: set[int] = set()
+        for group, col in checks:
+            sel = col[group]
+            vals, counts = np.unique(sel, return_counts=True)
+            if vals.size <= 1:
+                continue
+            majority = vals[int(np.argmax(counts))]
+            culprits.update(g for g, f in zip(group, sel) if f != majority)
+        return sorted(culprits)
+
+    def _audit_ok(self, fps) -> bool:
+        return not self._audit_culprits(np.asarray(fps))
 
     def audit_params(self) -> np.ndarray:
         """Per-replica parameter fingerprints, one uint32 per mesh
@@ -1157,18 +1338,18 @@ class DistributedTrainer:
         guard's rollback path, RNG replay and all."""
         self.audit_trips += 1
         self.guard_trips += 1
-        vals, counts = np.unique(fps, return_counts=True)
-        majority = vals[int(np.argmax(counts))]
-        culprits = [i for i, f in enumerate(fps) if f != majority]
+        fps = np.asarray(fps)
+        culprits = self._audit_culprits(fps)
+        fps_hex = [hex(int(f)) for f in fps.reshape(-1)]
         self._m_audit.inc()
         rec = telemetry.get_recorder()
         rec.record("audit_mismatch", round=round_idx, culprits=culprits,
-                   fingerprints=[hex(int(f)) for f in fps],
+                   fingerprints=fps_hex,
                    last_ok=self._last_audit_ok)
         rec.dump("audit_mismatch")
         print(f"audit: round {round_idx} REJECTED — cross-replica param "
               f"fingerprints diverge (replicas {culprits} vs the "
-              f"majority: {[hex(int(f)) for f in fps]}); rolling back to "
+              f"majority: {fps_hex}); rolling back to "
               f"a round <= {self._last_audit_ok} checkpoint "
               f"(trip {self.guard_trips}/{self.config.guard_max_trips})",
               file=sys.stderr, flush=True)
@@ -1241,10 +1422,16 @@ class DistributedTrainer:
                     has_batch_axis[t] = node.impl.top_has_batch_axis(
                         node.lp, i)
 
+            plan = self.shard_plan
+
             def worker(params, batch, valid):
                 # one zipPartitions worker: score the local rows, zero out
                 # invalid (padding) batches, sum across the mesh — the
                 # result is replicated so every host can fetch it
+                if plan is not None:
+                    # widen resident shards to full leaves for the
+                    # forward (tiled all_gather — exact)
+                    params = plan.gather(params)
                 out = net.apply(params, batch, train=False)
                 v = valid[0]
 
@@ -1257,9 +1444,12 @@ class DistributedTrainer:
                 return jax.tree_util.tree_map(
                     lambda t: lax.psum(t, self._batch_axes), scores)
 
+            params_spec = (P() if plan is None
+                           else plan.spec_tree(self.params))
             self._test_fwd = jax.jit(shard_map(
                 worker, mesh=self.mesh,
-                in_specs=(P(), P(self._batch_axes), P(self._batch_axes)),
+                in_specs=(params_spec, P(self._batch_axes),
+                          P(self._batch_axes)),
                 out_specs=P(), **_SM_NOCHECK))
         sharding = NamedSharding(self.mesh, P(self._batch_axes))
         local_workers = max(self.n_workers // jax.process_count(), 1)
@@ -1309,8 +1499,17 @@ class DistributedTrainer:
         if jax.process_count() > 1 and self.config.strategy != "sync":
             state = jax.jit(lambda t: t,
                             out_shardings=replicated(self.mesh))(state)
+        params = self.params
+        if self.shard_plan is not None:
+            # blobs always carry FULL logical leaves: a restore at ANY
+            # world size just re-slices per the new plan, which is what
+            # keeps the elastic re-tile contract bit-exact.  (The
+            # per-shard npz layout is a WRITE-side split of this same
+            # full blob — see _save_round_checkpoint_impl.)
+            params = jax.jit(lambda t: t,
+                             out_shardings=replicated(self.mesh))(params)
         blob: dict[str, Any] = {
-            "params": self.params,
+            "params": params,
             "state": state,
             "iter": self.iter,
             "round": self.round,
@@ -1319,6 +1518,8 @@ class DistributedTrainer:
             "n_workers": self.n_workers,
             "lr_scale": np.float64(self.lr_scale),
         }
+        if self.shard_plan is not None:
+            blob["shard_plan"] = self.shard_plan_id  # provenance stamp
         if self.config.strategy == "hierarchical":
             blob["n_hosts"] = self.n_hosts  # state is per-host
         if self.comm_residual is not None:
@@ -1380,7 +1581,11 @@ class DistributedTrainer:
                         f"not re-tile; set TrainerConfig.elastic=True)")
                 state = self._retier_state(state, self.n_hosts)
         rep = replicated(self.mesh)
-        self.params = put_global_tree(blob["params"], rep)
+        # full logical params land in this trainer's resident placement:
+        # under a shard plan each leaf is sliced per-device by its
+        # NamedSharding (put_global's callback), which IS the elastic
+        # re-tile — deterministic, arithmetic-free, world-size agnostic
+        self.params = put_global_tree(blob["params"], self._params_sharding)
         if self.config.strategy == "sync":
             self.state = put_global_tree(state, rep)
         else:
@@ -1499,10 +1704,39 @@ class DistributedTrainer:
             "tau": self.config.tau,
             "data_cursor": self.data_cursor,
         }
+        # per-shard checkpoint layout (TrainerConfig.shard_checkpoint):
+        # sharded param leaves split into one npz tile per shard, the
+        # main npz keeps everything else; the manifest pins every tile's
+        # sha256 and the split dims, and appears LAST — so a torn multi-
+        # file write is indistinguishable from no checkpoint at all
+        plan = self.shard_plan
+        shard_ckpt = plan is not None and self.config.shard_checkpoint
+        shard_dims = plan.dims_dict() if shard_ckpt else None
+        n_shards = plan.n_shards if shard_ckpt else 0
+        if plan is not None:
+            manifest["shard_plan"] = self.shard_plan_id
 
         def job() -> None:
+            from ..utils.checkpoint import split_sharded_tree
             check_fence(directory, fence_token)
-            save_checkpoint(path, blob)
+            shard_paths: list[str] = []
+            if shard_ckpt:
+                common, parts = split_sharded_tree(
+                    jax.tree_util.tree_map(np.asarray, blob["params"]),
+                    shard_dims, n_shards)
+                save_checkpoint(path, {**blob, "params": common})
+                shard_entries = []
+                for k, part in enumerate(parts):
+                    sname = f"ckpt_round_{round_now:08d}.shard{k:02d}.npz"
+                    spath = os.path.join(directory, sname)
+                    save_checkpoint(spath, part)
+                    shard_paths.append(spath)
+                    shard_entries.append(
+                        {"file": sname, "sha256": _sha256_file(spath)})
+                manifest["shards"] = shard_entries
+                manifest["shard_dims"] = shard_dims
+            else:
+                save_checkpoint(path, blob)
             # torn-write chaos window: the npz is durable, the manifest is
             # not yet — crash_in_ckpt kills HERE; resume must treat the
             # orphan npz as if the checkpoint never happened
@@ -1529,7 +1763,7 @@ class DistributedTrainer:
             try:
                 check_fence(directory, fence_token)
             except CheckpointFencedError:
-                for p in (tmp, path):
+                for p in (tmp, path, *shard_paths):
                     try:
                         os.remove(p)
                     except OSError:
@@ -1561,8 +1795,10 @@ class DistributedTrainer:
              glob.glob(os.path.join(directory, "manifest_*.json"))),
             reverse=True)
         for r in rounds[keep:]:
+            # the glob sweeps per-shard tiles along with the main npz
             for p in (os.path.join(directory, f"manifest_{r:08d}.json"),
-                      os.path.join(directory, f"ckpt_round_{r:08d}.npz")):
+                      *glob.glob(os.path.join(
+                          directory, f"ckpt_round_{r:08d}*.npz"))):
                 try:
                     os.remove(p)
                 except OSError:
@@ -1618,6 +1854,26 @@ class DistributedTrainer:
                         f"{manifest['sha256'][:12]}…, file {got[:12]}…)",
                         path)
                 blob = load_checkpoint(path)
+                shard_entries = manifest.get("shards") or []
+                if shard_entries:
+                    # per-shard layout: verify every tile against the
+                    # manifest, then join back to full logical leaves (a
+                    # corrupt/missing tile fails the WHOLE checkpoint —
+                    # fall through to the next-older manifest)
+                    from ..utils.checkpoint import join_sharded_tree
+                    parts = []
+                    for s in shard_entries:
+                        spath = os.path.join(directory, s["file"])
+                        sgot = _sha256_file(spath)
+                        if sgot != s["sha256"]:
+                            raise CheckpointError(
+                                f"shard sha256 mismatch (manifest "
+                                f"{s['sha256'][:12]}…, file "
+                                f"{sgot[:12]}…)", spath)
+                        parts.append(load_checkpoint(spath))
+                    blob["params"] = join_sharded_tree(
+                        blob["params"], parts,
+                        manifest.get("shard_dims") or {})
             except (OSError, json.JSONDecodeError, KeyError,
                     CheckpointError) as e:
                 print(f"resume: skipping {os.path.basename(mpath)}: {e}",

@@ -200,6 +200,77 @@ def load_checkpoint(path: str, verify: bool = True) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# Sharded-blob split/join (hybrid model+data sharding checkpoint plane)
+# ---------------------------------------------------------------------------
+
+def split_sharded_tree(params: dict, shard_dims: dict[str, int],
+                       n_shards: int):
+    """Split a WeightCollection (``dict[name, list[leaf]]``) into a
+    ``common`` part plus ``n_shards`` per-shard parts, per a partition
+    plan's ``shard_dims`` map (``"name/idx" -> axis``).
+
+    The parts keep the ``{name: {str(idx): leaf}}`` shape (dicts all the
+    way down, so ``_flatten`` round-trips them without list-hole
+    surgery) and together cover every leaf exactly once: unsharded
+    leaves land in ``common``, sharded leaves are split into equal tiles
+    along their plan dim, tile *k* in part *k*.  Inverse:
+    :func:`join_sharded_tree` — bit-exact by construction (pure
+    ``np.split``/``np.concatenate``, no arithmetic)."""
+    common: dict[str, dict[str, np.ndarray]] = {}
+    shards: list[dict[str, dict[str, np.ndarray]]] = [
+        {} for _ in range(n_shards)]
+    for name, blobs in params.items():
+        for i, leaf in enumerate(blobs):
+            leaf = np.asarray(leaf)
+            dim = shard_dims.get(f"{name}/{i}")
+            if dim is None:
+                common.setdefault(name, {})[str(i)] = leaf
+            else:
+                if leaf.shape[dim] % n_shards:
+                    raise CheckpointError(
+                        f"leaf {name}/{i} dim {dim} size {leaf.shape[dim]} "
+                        f"not divisible into {n_shards} shards")
+                for k, tile in enumerate(np.split(leaf, n_shards, axis=dim)):
+                    shards[k].setdefault(name, {})[str(i)] = tile
+    return common, shards
+
+
+def join_sharded_tree(common: dict, shards: list, shard_dims: dict[str, int],
+                      ) -> dict:
+    """Inverse of :func:`split_sharded_tree`: reassemble the
+    WeightCollection (``dict[name, list[leaf]]``) from a common part and
+    per-shard parts written at ANY world size — the full logical leaf is
+    identical whatever n it was tiled by, which is what lets elastic
+    re-form re-tile to a new world bit-exactly."""
+    merged: dict[str, dict[int, np.ndarray]] = {}
+    for name, idx_map in common.items():
+        for i, leaf in idx_map.items():
+            merged.setdefault(name, {})[int(i)] = np.asarray(leaf)
+    by_leaf: dict[tuple[str, int], list[np.ndarray]] = {}
+    for part in shards:
+        for name, idx_map in part.items():
+            for i, tile in idx_map.items():
+                by_leaf.setdefault((name, int(i)), []).append(
+                    np.asarray(tile))
+    for (name, i), tiles in by_leaf.items():
+        dim = shard_dims.get(f"{name}/{i}")
+        if dim is None:
+            raise CheckpointError(
+                f"shard files carry leaf {name}/{i} but the manifest's "
+                f"shard_dims does not — mismatched checkpoint halves")
+        merged.setdefault(name, {})[i] = np.concatenate(tiles, axis=dim)
+    out: dict[str, list[np.ndarray]] = {}
+    for name, idx_map in merged.items():
+        n = max(idx_map) + 1
+        if sorted(idx_map) != list(range(n)):
+            raise CheckpointError(
+                f"layer {name}: blob indices {sorted(idx_map)} have holes "
+                f"— common/shard parts do not cover the collection")
+        out[name] = [idx_map[i] for i in range(n)]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Async checkpoint tier (the zero-stall outer-loop piece)
 # ---------------------------------------------------------------------------
 

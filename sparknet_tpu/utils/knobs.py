@@ -482,6 +482,19 @@ _register(
          "Set to 1 to dispatch the encode/exchange/decode tail without "
          "host blocking (overlapped averaging; bit-identical results).",
          "sparknet_tpu/parallel/trainer.py"),
+    # --- hybrid model+data sharding (partition rule tables) ---
+    Knob("SPARKNET_SHARD", "str", "",
+         "Partition rule table for driver-built trainers: off (pure data "
+         "parallelism, the default), auto (zoo defaults: FC/inner-product "
+         "weights shard across chips, convs replicate), or the path of a "
+         "versioned JSON rule table (parallel/partition.py).",
+         "sparknet_tpu/parallel/trainer.py"),
+    Knob("SPARKNET_SHARD_CKPT", "bool", "",
+         "Set to 1 to write round checkpoints in the per-shard layout "
+         "(one npz tile per shard + the common npz, every file sha256-"
+         "pinned in the manifest); only meaningful with a live shard "
+         "plan.",
+         "sparknet_tpu/parallel/trainer.py"),
     # --- CI gates (read by the tier-1 runner, not by library code) ---
     Knob("SPARKNET_LINT", "bool", "1",
          "Set to 0 to skip the sparklint gate in tools/run_tier1.sh "
@@ -550,6 +563,11 @@ _register(
     Knob("SPARKNET_COMMBENCH", "bool", "",
          "Set to 1 to run the comm-codec parity gate (codec-none "
          "bit-identity, EF invariant, overlap stall) in run_tier1.sh.",
+         "tools/run_tier1.sh"),
+    Knob("SPARKNET_SHARDSMOKE", "bool", "",
+         "Set to 1 to run the hybrid-sharding parity gate (sharded-vs-"
+         "replicated bit-parity, per-shard checkpoint roundtrip, elastic "
+         "re-tile, boundary-bytes shrink) in run_tier1.sh.",
          "tools/run_tier1.sh"),
     # --- tombstones: window closed, any surviving mention fails lint ---
     Knob("SPARKNET_LRN_CUMSUM", "bool", "",

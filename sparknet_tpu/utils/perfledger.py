@@ -50,7 +50,7 @@ LEDGER_RELPATH = os.path.join("perf", "LEDGER.jsonl")
 # fingerprint fields, in canonical key order
 FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
                       "backend", "fuse_plan", "replicas", "tune_plan",
-                      "feed_source", "tau", "comm_codec")
+                      "feed_source", "tau", "comm_codec", "sharding")
 
 # entries written before the vertical fusion pass existed carry no
 # fuse_plan field; they were structurally unfused, so they pool with
@@ -70,9 +70,12 @@ FINGERPRINT_FIELDS = ("model", "dtype", "batch", "world", "device",
 # commbench configs, trainer captures) stamp it explicitly — the pooled
 # default τ=1 only covers captures whose round shape never mattered to
 # their metrics (serving, feed, fusion).
+# Entries before hybrid sharding (r20) all ran pure data parallelism:
+# they read as sharding="dp" so the committed history keeps gating,
+# while plan captures band under their shard_plan_id.
 _FINGERPRINT_DEFAULTS = {"fuse_plan": "off", "replicas": 1,
                          "tune_plan": "off", "feed_source": "lmdb",
-                         "tau": 1, "comm_codec": "none"}
+                         "tau": 1, "comm_codec": "none", "sharding": "dp"}
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -112,7 +115,8 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
                 tune_plan: str | None = None,
                 feed_source: str | None = None,
                 tau: int | None = None,
-                comm_codec: str | None = None) -> dict[str, Any]:
+                comm_codec: str | None = None,
+                sharding: str | None = None) -> dict[str, Any]:
     """Canonical config fingerprint.  ``backend`` defaults to the
     platform half of ``device`` (``"tpu/TPU v5 lite"`` -> ``"tpu"``) —
     the field the baseline isolation hinges on.  ``fuse_plan`` is the
@@ -130,7 +134,10 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
     per averaging round) and ``comm_codec`` (the weight-delta wire
     format) shape the round's collective traffic: a τ=10 int8 capture
     and a τ=1 full-precision one are different communication programs
-    and must band separately."""
+    and must band separately.  ``sharding`` is the partition plan id
+    (``parallel.partition.shard_plan_id()``): "dp" is pure data
+    parallelism (the historical default), a plan hash is a different
+    resident layout with different round collectives — never pooled."""
     if backend is None and device:
         backend = str(device).split("/", 1)[0]
     return {"model": model or "unknown", "dtype": dtype or "unknown",
@@ -143,7 +150,8 @@ def fingerprint(model: str | None = None, dtype: str | None = None,
             "tune_plan": tune_plan or "off",
             "feed_source": feed_source or "lmdb",
             "tau": int(tau) if tau is not None else 1,
-            "comm_codec": comm_codec or "none"}
+            "comm_codec": comm_codec or "none",
+            "sharding": sharding or "dp"}
 
 
 def fp_key(fp: Mapping[str, Any]) -> str:
@@ -543,6 +551,35 @@ def entries_from_bench(doc: Mapping[str, Any], path: str | None = None, *,
                                if v is not None},
                               round_tag=round_tag, t=t, **prov))
 
+    sr = doc.get("shard_round") or {}
+    if sr and not sr.get("error"):
+        # dp vs sharded band separately: the `sharding` fingerprint
+        # field keys each leg against its own history, so the sharded
+        # round's smaller wire bytes never "regress" the dp baseline
+        for mode, shard_id in (("dp", "dp"),
+                               ("sharded", sr.get("plan") or "sharded")):
+            leg = sr.get(mode) or {}
+            if not leg or leg.get("error"):
+                continue
+            fp = fingerprint(model=model, dtype=sr.get("dtype", "f32"),
+                             batch=sr.get("batch"),
+                             world=sr.get("workers"), device=device,
+                             tau=sr.get("tau"), sharding=shard_id)
+            metrics = {
+                "shard_round_s": leg.get("round_s"),
+                "shard_boundary_bytes": leg.get(
+                    "boundary_bytes_per_chip"),
+            }
+            if mode == "sharded":
+                metrics["shard_bytes_shrink_x"] = sr.get(
+                    "bytes_shrink_x")
+            out.append(make_entry(
+                "bench_shard", path, fp,
+                {k: v for k, v in metrics.items() if v is not None},
+                round_tag=round_tag, t=t,
+                notes=None if sr.get("parity_ok", True)
+                else "shard parity FAILED", **prov))
+
     serving = doc.get("serving") or {}
     if serving and not serving.get("error"):
         out.extend(entries_from_serving(serving, path,
@@ -812,6 +849,50 @@ def entries_from_commbench(doc: Mapping[str, Any],
     return out
 
 
+def entries_from_shardbench(doc: Mapping[str, Any],
+                            path: str | None = None, *,
+                            round_tag: str | None = None,
+                            t: float | None = None,
+                            device_hint: str | None = None) -> list[dict]:
+    """tools/shardbench.py hybrid-sharding gate reports: one entry on
+    the ``sharding="dp"`` fingerprint (the replicated baseline's round
+    wall and analytic boundary bytes) and one on the sharded plan's
+    fingerprint (its round wall, per-chip boundary bytes, and the
+    headline ``shard_bytes_shrink_x`` — higher is better).  The two
+    fingerprints band independently, so the ledger keeps both histories
+    without the sharded leg masquerading as a dp speedup."""
+    if not doc.get("shardbench"):
+        return []
+    prov = _prov_fields(doc)
+    world = doc.get("devices")
+    tau = doc.get("tau")
+    note = None if doc.get("ok") else "shardbench gate FAILED"
+    out: list[dict] = []
+    for mode, shard_id in (("dp", "dp"),
+                           ("sharded", doc.get("plan") or "sharded")):
+        leg = doc.get(mode) or {}
+        if not leg:
+            continue
+        fp = fingerprint(model=doc.get("model") or "lenet", dtype="f32",
+                         batch=doc.get("batch"), world=world,
+                         device=device_hint, tau=tau, sharding=shard_id)
+        metrics = {
+            "shard_round_s": leg.get("round_s"),
+            "shard_boundary_bytes": leg.get("boundary_bytes_per_chip"),
+            "shard_exchange_bytes": leg.get("exchange_bytes"),
+        }
+        if mode == "sharded":
+            metrics["shard_bytes_shrink_x"] = doc.get(
+                "shard_bytes_shrink_x")
+            metrics["shard_caffenet_shrink_x"] = (
+                doc.get("caffenet") or {}).get("shrink_x")
+        out.append(make_entry(
+            "shardbench", path, fp,
+            {k: v for k, v in metrics.items() if v is not None},
+            round_tag=round_tag, t=t, notes=note, **prov))
+    return out
+
+
 def entries_from_op_table(doc: Mapping[str, Any],
                           path: str | None = None, *,
                           round_tag: str | None = None,
@@ -967,6 +1048,9 @@ def entries_from_any(doc: Mapping[str, Any], path: str | None = None, *,
     if doc.get("commbench"):
         return entries_from_commbench(doc, path, round_tag=round_tag,
                                       t=t, device_hint=device_hint)
+    if doc.get("shardbench"):
+        return entries_from_shardbench(doc, path, round_tag=round_tag,
+                                       t=t, device_hint=device_hint)
     if "stall_total_sync_s" in doc:
         return entries_from_roundbench(doc, path, round_tag=round_tag,
                                        t=t, device_hint=device_hint)

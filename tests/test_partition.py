@@ -1,0 +1,328 @@
+"""Hybrid model+data sharding (PR 20): the regex partition rule table
+(``parallel/partition.py``), the trainer's tensor-sharded round —
+bit parity against the replicated baseline for every strategy, codec
+composition, the shard-aware audit with bitflip rollback — per-shard
+checkpoint tiles, and the knob plumbing."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from sparknet_tpu.models import lenet
+from sparknet_tpu.parallel import (
+    DistributedTrainer, TrainerConfig, comms, make_mesh, make_pod_mesh,
+    partition,
+)
+from sparknet_tpu.parallel.trainer import comm_config_from_env
+from sparknet_tpu.proto import load_solver_prototxt_with_net
+
+SOLVER_TXT = 'base_lr: 0.005\nmomentum: 0.9\nlr_policy: "fixed"\n'
+
+
+def _sp(batch=16):
+    return load_solver_prototxt_with_net(SOLVER_TXT, lenet(batch, batch))
+
+
+def _batch(r, tau=2, gb=16):
+    rng = np.random.default_rng(900 + r)
+    return {"data": rng.normal(size=(tau, gb, 1, 28, 28)
+                               ).astype(np.float32),
+            "label": rng.integers(0, 10, size=(tau, gb)
+                                  ).astype(np.float32)}
+
+
+def _run(tr, rounds=2, tau=2, gb=16):
+    losses = [tr.train_round(_batch(r, tau, gb)) for r in range(rounds)]
+    tr.drain()
+    jax.block_until_ready(tr.params)
+    return losses
+
+
+def _params_np(tr):
+    return {k: [np.asarray(b) for b in v] for k, v in tr.params.items()}
+
+
+def _assert_bit_identical(pa, pb, msg=""):
+    for name in pa:
+        for i, x in enumerate(pa[name]):
+            np.testing.assert_array_equal(
+                x, pb[name][i], err_msg=f"{msg} param {name}[{i}]")
+
+
+# ---------------------------------------------------------------------------
+# rule grammar
+# ---------------------------------------------------------------------------
+
+def _leaves(**shapes):
+    """{name: [leaf, ...]} WeightCollection stand-in from name->shapes."""
+    return {name: [np.zeros(s, np.float32) for s in blobs]
+            for name, blobs in shapes.items()}
+
+
+def test_first_match_wins():
+    rules = ((r"(^|/)ip1/0$", 0), (r"(^|/)ip", 1), (r".*", None))
+    dims, fallbacks, unmatched = partition.match_partition_rules(
+        rules, _leaves(ip1=[(8, 4), (8,)], ip2=[(4, 8), (4,)]), 2)
+    # ip1/0 hits rule 0 (dim 0); ip1/1 and ip2/* fall through to rule 1
+    # (dim 1 — ip2/0 has one, the biases do not and fall back)
+    assert dims == {"ip1/0": 0, "ip2/0": 1}
+    assert set(fallbacks) == {"ip1/1", "ip2/1"}
+    assert unmatched == []
+
+
+def test_scalar_leaves_never_partitioned():
+    dims, fallbacks, _ = partition.match_partition_rules(
+        ((r".*", 0),), {"bn1": [np.float32(1.0) * np.zeros(())]}, 2)
+    assert dims == {} and fallbacks == ["bn1/0"]
+
+
+def test_non_divisible_dim_falls_back():
+    dims, fallbacks, _ = partition.match_partition_rules(
+        partition.DEFAULT_RULES, _leaves(ip1=[(10, 4)]), 4)
+    assert dims == {} and fallbacks == ["ip1/0"]
+
+
+def test_unmatched_leaves_collected_all_at_once():
+    # a table with no catch-all leaves every non-matching leaf undecided
+    dims, fb, unmatched = partition.match_partition_rules(
+        ((r"(^|/)ip1/0$", 0),),
+        _leaves(conv1=[(4, 1, 5, 5), (4,)], ip1=[(8, 4)]), 2)
+    assert dims == {"ip1/0": 0} and fb == []
+    assert unmatched == ["conv1/0", "conv1/1"]
+
+
+def test_resolve_plan_modes(tmp_path):
+    leaves = _leaves(ip1=[(8, 4), (8,)], conv1=[(4, 1, 5, 5)])
+    for mode in ("", "off", "dp", "0"):
+        assert partition.resolve_plan(mode, leaves, axis="data",
+                                      n_shards=4) is None
+    # single shard -> None even under "auto"
+    assert partition.resolve_plan("auto", leaves, axis="data",
+                                  n_shards=1) is None
+    plan = partition.resolve_plan("auto", leaves, axis="data", n_shards=4)
+    assert plan is not None and plan.dims_dict() == {"ip1/0": 0}
+    assert plan.table_id == f"auto-v{partition.RULE_TABLE_VERSION}"
+    # a holey custom table raises, naming every undecided leaf
+    holey = tmp_path / "holey.json"
+    holey.write_text(json.dumps(
+        {"version": 1, "rules": [{"pattern": r"(^|/)ip1/0$", "dim": 0}]}))
+    with pytest.raises(ValueError, match="conv1/0"):
+        partition.resolve_plan(str(holey), leaves, axis="data", n_shards=4)
+
+
+def test_rule_table_version_refused(tmp_path):
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps({"version": 2, "rules": [
+        {"pattern": ".*", "dim": None}]}))
+    with pytest.raises(ValueError, match="version 2"):
+        partition.load_rule_table(str(p))
+    p.write_text(json.dumps({"version": 1, "rules": [
+        {"pattern": "(unclosed", "dim": None}]}))
+    with pytest.raises(Exception):   # bad regex surfaces at load
+        partition.load_rule_table(str(p))
+
+
+def test_json_table_load_and_plan_id_stability(tmp_path):
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps({"version": 1, "rules": [
+        {"pattern": r"(^|/)ip[^/]*/0$", "dim": 0},
+        {"pattern": ".*", "dim": None}]}))
+    leaves = _leaves(ip1=[(8, 4)], conv1=[(4, 1, 5, 5)])
+    a = partition.resolve_plan(str(p), leaves, axis="data", n_shards=4)
+    b = partition.resolve_plan(str(p), leaves, axis="data", n_shards=4)
+    assert a.table_id.startswith("table:")
+    assert a.plan_id() == b.plan_id()           # content-hash stability
+    assert partition.shard_plan_id(a) == a.plan_id()
+    assert partition.shard_plan_id(None) == "dp"
+    # a different shard count is a different placement -> different id
+    c = partition.resolve_plan(str(p), leaves, axis="data", n_shards=2)
+    assert c.plan_id() != a.plan_id()
+
+
+def test_boundary_bytes_shrink_accounting():
+    leaves = _leaves(ip1=[(8, 4), (8,)], conv1=[(4, 1, 5, 5)])
+    plan = partition.resolve_plan("auto", leaves, axis="data", n_shards=4)
+    full = partition.boundary_bytes_per_chip(leaves, None)
+    shard = partition.boundary_bytes_per_chip(leaves, plan)
+    # only ip1/0 (8*4*4 = 128 B) shrinks, to a quarter
+    assert full - shard == 128 - 128 // 4
+    # the codec-wire accounting agrees on the same plan
+    none = comms.get_codec("none")
+    assert (comms.sharded_exchange_bytes(none, leaves, 4, plan)
+            < comms.exchange_bytes(none, leaves, 4))
+    assert (comms.sharded_exchange_bytes(none, leaves, 4, None)
+            == comms.exchange_bytes(none, leaves, 4))
+
+
+# ---------------------------------------------------------------------------
+# the tensor-sharded round: bit parity with the replicated baseline
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["local_sgd", "sync"])
+def test_sharded_round_bit_identical_flat_mesh(strategy):
+    mesh = make_mesh(4)
+    dp = DistributedTrainer(_sp(), mesh,
+                            TrainerConfig(strategy=strategy, tau=2,
+                                          shard="off"), seed=0)
+    sh = DistributedTrainer(_sp(), mesh,
+                            TrainerConfig(strategy=strategy, tau=2,
+                                          shard="auto"), seed=0)
+    assert sh.shard_plan is not None
+    assert sh.shard_plan.dims_dict() == {"ip1/0": 0}
+    assert "ip2/0" in sh.shard_plan.fallbacks   # 10 rows % 4 != 0
+    la, lb = _run(dp), _run(sh)
+    assert la == lb
+    _assert_bit_identical(_params_np(dp), _params_np(sh),
+                          f"{strategy} sharded")
+
+
+def test_sharded_round_bit_identical_hierarchical():
+    pod = make_pod_mesh(2, 2)
+    dp = DistributedTrainer(_sp(), pod,
+                            TrainerConfig(strategy="hierarchical", tau=2,
+                                          shard="off"), seed=0)
+    sh = DistributedTrainer(_sp(), pod,
+                            TrainerConfig(strategy="hierarchical", tau=2,
+                                          shard="auto"), seed=0)
+    assert sh.shard_plan is not None and sh.shard_plan.axis == "chip"
+    la, lb = _run(dp), _run(sh)
+    assert la == lb
+    _assert_bit_identical(_params_np(dp), _params_np(sh), "hierarchical")
+
+
+def test_sharded_compose_with_int8_codec_bit_identical():
+    mesh = make_mesh(4)
+    dp = DistributedTrainer(_sp(), mesh,
+                            TrainerConfig(strategy="local_sgd", tau=2,
+                                          comm_codec="int8", shard="off"),
+                            seed=0)
+    sh = DistributedTrainer(_sp(), mesh,
+                            TrainerConfig(strategy="local_sgd", tau=2,
+                                          comm_codec="int8",
+                                          shard="auto"), seed=0)
+    la, lb = _run(dp), _run(sh)
+    assert la == lb
+    _assert_bit_identical(_params_np(dp), _params_np(sh), "int8+shard")
+
+
+def test_sharded_eval_matches_replicated():
+    mesh = make_mesh(4)
+    dp = DistributedTrainer(_sp(), mesh,
+                            TrainerConfig(tau=2, shard="off"), seed=0)
+    sh = DistributedTrainer(_sp(), mesh,
+                            TrainerConfig(tau=2, shard="auto"), seed=0)
+    _run(dp, rounds=1)
+    _run(sh, rounds=1)
+    fa = iter([{"data": _batch(9)["data"][0],
+                "label": _batch(9)["label"][0]}] * 2)
+    fb = iter([{"data": _batch(9)["data"][0],
+                "label": _batch(9)["label"][0]}] * 2)
+    sa, sb = dp.test(fa, num_steps=2), sh.test(fb, num_steps=2)
+    assert sa == sb
+
+
+# ---------------------------------------------------------------------------
+# sharded safety plane: audit, rollback, per-shard checkpoints
+# ---------------------------------------------------------------------------
+
+def test_audit_under_sharding_catches_bitflip_and_rolls_back(tmp_path):
+    cfg = TrainerConfig(strategy="local_sgd", tau=2, shard="auto",
+                        checkpoint_dir=str(tmp_path), checkpoint_every=1,
+                        audit_every=1)
+    tr = DistributedTrainer(_sp(), make_mesh(4), cfg, seed=0)
+    for r in range(2):
+        tr.train_round(_batch(r))
+    fps = tr.audit_params()
+    assert np.asarray(fps).shape == (4, 2)    # [replicated, shard] columns
+    assert tr._audit_ok(fps)
+    tr._inject_bitflip(2)
+    fps2 = tr.audit_params()
+    assert tr._audit_culprits(fps2) == [2]
+    # the next round's pre-round audit trips and rolls back
+    assert np.isnan(tr.train_round(_batch(2)))
+    assert tr.audit_trips == 1
+    assert tr._audit_ok(tr.audit_params())
+    assert np.isfinite(tr.train_round(_batch(2)))   # replay succeeds
+
+
+def test_per_shard_checkpoint_roundtrip(tmp_path):
+    cfg = TrainerConfig(strategy="local_sgd", tau=2, shard="auto",
+                        shard_checkpoint=True,
+                        checkpoint_dir=str(tmp_path), checkpoint_every=1)
+    tr = DistributedTrainer(_sp(), make_mesh(4), cfg, seed=0)
+    for r in range(2):
+        tr.train_round(_batch(r))
+    tr.flush_checkpoints()
+    tiles = sorted(p.name for p in tmp_path.glob(
+        "ckpt_round_00000002.shard*.npz"))
+    assert len(tiles) == 4, tiles
+    manifest = json.loads(
+        (tmp_path / "manifest_00000002.json").read_text())
+    assert manifest["shard_plan"] == tr.shard_plan_id
+    assert set(manifest["shard_dims"]) == {"ip1/0"}
+    assert len(manifest["shards"]) == 4
+    # fresh trainer reassembles the tiles bit-exactly and continues
+    tr2 = DistributedTrainer(_sp(), make_mesh(4), cfg, seed=99)
+    assert tr2.resumed is not None
+    _assert_bit_identical(_params_np(tr), _params_np(tr2), "resume")
+    la = tr.train_round(_batch(2))
+    lb = tr2.train_round(_batch(2))
+    assert la == lb
+
+
+def test_shard_checkpoint_corrupt_tile_is_skipped(tmp_path):
+    cfg = TrainerConfig(strategy="local_sgd", tau=2, shard="auto",
+                        shard_checkpoint=True,
+                        checkpoint_dir=str(tmp_path), checkpoint_every=1)
+    tr = DistributedTrainer(_sp(), make_mesh(4), cfg, seed=0)
+    for r in range(2):
+        tr.train_round(_batch(r))
+    tr.flush_checkpoints()
+    # rot one tile of the NEWEST checkpoint: resume must fall back to
+    # the previous intact one, not assemble a corrupt params tree
+    tile = tmp_path / "ckpt_round_00000002.shard01.npz"
+    tile.write_bytes(b"rotten" + tile.read_bytes()[6:])
+    tr2 = DistributedTrainer(_sp(), make_mesh(4), cfg, seed=99)
+    assert tr2.resumed is not None
+    assert tr2.round == 1
+
+
+# ---------------------------------------------------------------------------
+# knob plumbing + manifest/ledger stamps
+# ---------------------------------------------------------------------------
+
+def test_comm_config_from_env_shard_knobs(monkeypatch):
+    base = TrainerConfig()
+    assert base.shard == "off" and base.shard_checkpoint is False
+    cfg = comm_config_from_env(base)
+    assert cfg.shard == "off"            # unset knobs leave base alone
+    monkeypatch.setenv("SPARKNET_SHARD", "auto")
+    monkeypatch.setenv("SPARKNET_SHARD_CKPT", "1")
+    cfg = comm_config_from_env(base)
+    assert cfg.shard == "auto" and cfg.shard_checkpoint is True
+
+
+def test_trainer_stamps_plan_id():
+    tr = DistributedTrainer(_sp(), make_mesh(4),
+                            TrainerConfig(shard="auto"), seed=0)
+    assert tr.shard_plan_id.startswith("shard:")
+    blob = tr._host_blob()
+    assert blob["shard_plan"] == tr.shard_plan_id
+    dp = DistributedTrainer(_sp(), make_mesh(4),
+                            TrainerConfig(shard="off"), seed=0)
+    assert dp.shard_plan_id == "dp"
+    assert "shard_plan" not in dp._host_blob()
+
+
+def test_perfledger_sharding_fingerprint_field():
+    from sparknet_tpu.utils import perfledger
+    fp = perfledger.fingerprint(model="lenet", dtype="f32", batch=16,
+                                world=4)
+    assert fp["sharding"] == "dp"        # historical default keeps gating
+    fp2 = perfledger.fingerprint(model="lenet", dtype="f32", batch=16,
+                                 world=4, sharding="shard:abc")
+    assert fp2["sharding"] == "shard:abc"
+    assert perfledger.fp_key(fp) != perfledger.fp_key(fp2)

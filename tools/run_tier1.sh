@@ -225,6 +225,23 @@ maybe_commbench() {
   fi
 }
 
+# ~15-second hybrid-sharding parity gate (tools/shardbench.py) — opt-in
+# via SPARKNET_SHARDSMOKE=1.  Runs a 2x2-able CPU mesh dryrun and fails
+# the gate unless shard="auto" is bit-identical to the replicated
+# trainer for all three strategies (codec none) AND composed with the
+# int8 exchange, the per-shard checkpoint tiles roundtrip bit-exactly,
+# a world-N checkpoint re-tiles onto world-M, the shard-aware audit
+# catches a planted one-bit flip with the right culprit and rolls back,
+# and the analytic τ-boundary bytes shrink (>= 2x on caffenet-class
+# shapes at 8 shards).  (A fast in-tree smoke of the same contracts
+# runs inside tier-1: tests/test_partition.py.)
+maybe_shardsmoke() {
+  if [ "${SPARKNET_SHARDSMOKE:-}" = "1" ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python tools/shardbench.py --out /tmp/_shardbench.json
+  fi
+}
+
 # ~7-second vertical-fusion parity gate (tools/fusebench.py) — opt-in
 # via SPARKNET_FUSEBENCH=1.  Fails the gate unless fused execution
 # (SPARKNET_FUSE=all) reproduces per-layer execution bit-for-bit in the
@@ -282,6 +299,7 @@ case "${1:-}" in
   --recordbench) SPARKNET_RECORDBENCH=1 maybe_recordbench ;;
   --roundbench) SPARKNET_ROUNDBENCH=1 maybe_roundbench ;;
   --commbench) SPARKNET_COMMBENCH=1 maybe_commbench ;;
+  --shardsmoke) SPARKNET_SHARDSMOKE=1 maybe_shardsmoke ;;
   --servesmoke) SPARKNET_SERVESMOKE=1 maybe_servesmoke ;;
   --fleetservesmoke) SPARKNET_FLEETSERVESMOKE=1 maybe_fleetservesmoke ;;
   --obssmoke) SPARKNET_OBSSMOKE=1 maybe_obssmoke ;;
@@ -293,15 +311,16 @@ case "${1:-}" in
              && maybe_rollsmoke \
              && maybe_feedbench && maybe_recordbench && maybe_servesmoke \
              && maybe_fleetservesmoke && maybe_roundbench \
-             && maybe_commbench \
+             && maybe_commbench && maybe_shardsmoke \
              && maybe_obssmoke && maybe_fusebench && maybe_tunebench \
              && maybe_perfgate ;;
   "")      maybe_lint && run_tier1 && maybe_soak && maybe_fleetsoak \
              && maybe_podsoak && maybe_netsoak && maybe_rollsmoke \
              && maybe_feedbench && maybe_recordbench \
              && maybe_servesmoke && maybe_fleetservesmoke \
-             && maybe_roundbench && maybe_commbench && maybe_obssmoke \
+             && maybe_roundbench && maybe_commbench && maybe_shardsmoke \
+             && maybe_obssmoke \
              && maybe_fusebench && maybe_tunebench && maybe_perfgate ;;
-  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--netsoak|--rollsmoke|--feedbench|--recordbench|--roundbench|--commbench|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
+  *) echo "usage: $0 [--chaos|--lint|--soak|--fleetsoak|--podsoak|--netsoak|--rollsmoke|--feedbench|--recordbench|--roundbench|--commbench|--shardsmoke|--servesmoke|--fleetservesmoke|--obssmoke|--fusebench|--tunebench|--perfgate|--all]" >&2
      exit 2 ;;
 esac
